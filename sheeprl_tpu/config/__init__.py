@@ -1,0 +1,13 @@
+from sheeprl_tpu.config.instantiate import instantiate, locate
+from sheeprl_tpu.config.loader import MISSING, ConfigError, Composer, compose, default_config_dir, search_paths
+
+__all__ = [
+    "MISSING",
+    "ConfigError",
+    "Composer",
+    "compose",
+    "default_config_dir",
+    "search_paths",
+    "instantiate",
+    "locate",
+]

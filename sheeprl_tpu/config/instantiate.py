@@ -1,0 +1,70 @@
+"""`_target_`-driven object instantiation.
+
+Parity with `hydra.utils.instantiate` as the reference uses it (optimizers,
+env wrappers, metric objects, e.g. sheeprl/cli.py:101,149, ppo.py:184,199):
+a config node with a ``_target_`` key names a callable by dotted path; the
+remaining keys are its kwargs. ``_partial_: true`` returns a functools.partial
+instead of calling.
+"""
+
+from __future__ import annotations
+
+import functools
+import importlib
+from typing import Any, Mapping
+
+
+def locate(path: str) -> Any:
+    """Import a dotted path to an object (module.attr[.attr...])."""
+    parts = path.split(".")
+    for split in range(len(parts) - 1, 0, -1):
+        module_name = ".".join(parts[:split])
+        try:
+            module = importlib.import_module(module_name)
+        except ImportError:
+            continue
+        obj = module
+        try:
+            for attr in parts[split:]:
+                obj = getattr(obj, attr)
+        except AttributeError:
+            continue
+        return obj
+    raise ImportError(f"Cannot locate object at dotted path: '{path}'")
+
+
+def _instantiate_children(value: Any) -> Any:
+    """Recursively instantiate ``_target_`` nodes anywhere in a config subtree
+    (full-recursive semantics, like hydra.utils.instantiate's default)."""
+    if isinstance(value, Mapping):
+        if "_target_" in value:
+            return instantiate(value)
+        return {k: _instantiate_children(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return type(value)(_instantiate_children(v) for v in value)
+    return value
+
+
+def instantiate(node: Any, *args: Any, **overrides: Any) -> Any:
+    """Instantiate a ``_target_`` config node (recursively for nested nodes)."""
+    if isinstance(node, Mapping) and "_target_" in node:
+        kwargs = {}
+        partial = False
+        target = None
+        for k, v in node.items():
+            if k == "_target_":
+                target = v
+            elif k == "_partial_":
+                partial = bool(v)
+            elif k.startswith("_"):
+                continue
+            else:
+                kwargs[k] = _instantiate_children(v)
+        kwargs.update(overrides)
+        fn = locate(target)
+        if partial:
+            return functools.partial(fn, *args, **kwargs)
+        return fn(*args, **kwargs)
+    if overrides or args:
+        raise ValueError("Cannot pass args/kwargs when instantiating a non-_target_ node")
+    return node

@@ -1,0 +1,391 @@
+"""Hydra-lite YAML config composition.
+
+The reference framework composes its runtime config with Hydra 1.3
+(sheeprl/configs/config.yaml + ~100 group files, search-path plugin in
+hydra_plugins/sheeprl_search_path.py). Hydra is not available in this
+environment, and a full dependency on it is unnecessary: this module
+implements the subset of composition semantics the framework needs, natively:
+
+- a root config with a ``defaults`` list,
+- config groups (``algo/``, ``env/``, ``exp/``, ...) selected as
+  ``- group: option`` entries or CLI ``group=option`` overrides,
+- ``_self_`` ordering, same-group includes (``- dreamer_v3``),
+- ``override /group: option`` directives (used heavily by ``exp/`` files),
+- package targeting: ``# @package _global_`` headers and ``@pkg`` suffixes
+  (e.g. ``/optim@world_model.optimizer: adam``),
+- ``${a.b.c}`` interpolation with ``${now:...}`` resolver,
+- dotted CLI value overrides (``algo.gamma=0.9``) and ``+key=value`` adds,
+- mandatory ``???`` markers (an unselected mandatory group raises),
+- user-extensible search path via the ``SHEEPRL_SEARCH_PATH`` env var
+  (parity with the reference's hydra_plugins/sheeprl_search_path.py).
+
+Composition output is a plain :class:`sheeprl_tpu.utils.utils.dotdict`.
+"""
+
+from __future__ import annotations
+
+import copy
+import datetime
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import yaml
+
+from sheeprl_tpu.utils.utils import dotdict, get_by_path, set_by_path
+
+MISSING = "???"
+
+_PACKAGE_RE = re.compile(r"^#\s*@package\s+(\S+)\s*$", re.MULTILINE)
+_INTERP_RE = re.compile(r"\$\{([^${}]+)\}")
+
+
+class ConfigError(Exception):
+    pass
+
+
+class MandatoryValueError(ConfigError):
+    pass
+
+
+def default_config_dir() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "configs")
+
+
+def search_paths() -> List[str]:
+    """Config roots, highest priority first. Users prepend their own roots via
+    SHEEPRL_SEARCH_PATH (a ``:``-separated list of directories)."""
+    paths = []
+    env = os.environ.get("SHEEPRL_SEARCH_PATH", "")
+    for entry in env.split(":"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        # Accept both plain paths and hydra-style "file://<path>" entries.
+        if entry.startswith("file://"):
+            entry = entry[len("file://") :]
+        if os.path.isdir(entry):
+            paths.append(entry)
+    paths.append(default_config_dir())
+    return paths
+
+
+@dataclass
+class _Entry:
+    """One node of the expanded defaults tree."""
+
+    group: str  # group path relative to config root, "" for same-dir include
+    option: str
+    package: str  # absolute package ("" == global)
+    content: Dict[str, Any] = field(default_factory=dict)
+
+
+def _strip_ext(name: str) -> str:
+    return name[:-5] if isinstance(name, str) and name.endswith(".yaml") else name
+
+
+def _join_pkg(parent: str, child: str) -> str:
+    if child.startswith("_global_"):
+        rest = child[len("_global_") :].lstrip(".")
+        return rest
+    if not parent:
+        return child
+    if not child:
+        return parent
+    return f"{parent}.{child}"
+
+
+class Composer:
+    def __init__(self, roots: Optional[Sequence[str]] = None):
+        self.roots = list(roots) if roots else search_paths()
+        # compose() walks the tree repeatedly (choices fixed point + expand);
+        # files are immutable within one compose, so parse each path once.
+        self._file_cache: Dict[str, Tuple[Dict[str, Any], List[Any], Optional[str]]] = {}
+
+    # ------------------------------------------------------------------ files
+    def _find_file(self, group: str, option: str) -> Optional[str]:
+        option = _strip_ext(option)
+        for root in self.roots:
+            path = os.path.join(root, group, option + ".yaml") if group else os.path.join(root, option + ".yaml")
+            if os.path.isfile(path):
+                return path
+        return None
+
+    def is_group(self, name: str) -> bool:
+        return any(os.path.isdir(os.path.join(root, name)) for root in self.roots)
+
+    def _load_file(self, group: str, option: str) -> Tuple[Dict[str, Any], List[Any], Optional[str]]:
+        """Returns (content-without-defaults, defaults list, package header)."""
+        path = self._find_file(group, option)
+        if path is None:
+            raise ConfigError(f"Config file not found: group='{group}' option='{option}' (roots={self.roots})")
+        cached = self._file_cache.get(path)
+        if cached is not None:
+            return cached
+        with open(path) as fp:
+            text = fp.read()
+        pkg_match = _PACKAGE_RE.search(text)
+        pkg_header = pkg_match.group(1) if pkg_match else None
+        content = yaml.safe_load(text) or {}
+        if not isinstance(content, dict):
+            raise ConfigError(f"Config file {path} must contain a mapping at top level")
+        defaults = content.pop("defaults", [])
+        self._file_cache[path] = (content, defaults, pkg_header)
+        return content, defaults, pkg_header
+
+    # -------------------------------------------------------------- expansion
+    def _expand(
+        self,
+        group: str,
+        option: str,
+        parent_pkg: str,
+        choices: Dict[str, str],
+        out: List[_Entry],
+        seen: Optional[set] = None,
+    ) -> None:
+        """DFS-expand a config file into an ordered list of merge entries."""
+        seen = seen or set()
+        key = (group, option)
+        if key in seen:
+            raise ConfigError(f"Cyclic defaults detected at {key}")
+        seen = seen | {key}
+
+        content, defaults, pkg_header = self._load_file(group, option)
+        if pkg_header is not None:
+            own_pkg = "" if pkg_header == "_global_" else _join_pkg("", pkg_header)
+        else:
+            own_pkg = parent_pkg
+
+        entries: List[Any] = list(defaults)
+        if not any(e == "_self_" for e in entries):
+            entries.insert(0, "_self_")
+
+        for raw in entries:
+            if raw == "_self_":
+                out.append(_Entry(group, option, own_pkg, content))
+                continue
+            if isinstance(raw, str):
+                # Same-group include, e.g. "- dreamer_v3" inside algo/.
+                self._expand(group, _strip_ext(raw), own_pkg, choices, out, seen)
+                continue
+            if not isinstance(raw, dict) or len(raw) != 1:
+                raise ConfigError(f"Malformed defaults entry {raw!r} in {group}/{option}")
+            k, v = next(iter(raw.items()))
+            k = k.strip()
+            is_override = k.startswith("override ")
+            if is_override:
+                k = k[len("override ") :].strip()
+            at_pkg = None
+            if "@" in k:
+                k, at_pkg = k.split("@", 1)
+            absolute = k.startswith("/")
+            g = k.lstrip("/")
+            full_group = g if (absolute or not group) else f"{group}/{g}"
+            if is_override:
+                # Choice already recorded during the choices pass; skip here.
+                continue
+            # Choices are scoped by the *absolute* package when the entry
+            # targets one, so an override for /optim@algo.actor.optimizer does
+            # not clobber the /optim@algo.critic.optimizer slot.
+            if at_pkg is not None:
+                choice_key = f"{full_group}@{_join_pkg(own_pkg, at_pkg)}"
+            else:
+                choice_key = full_group
+            sel = choices.get(choice_key, v)
+            if sel is None:
+                continue
+            sel = _strip_ext(sel)
+            if sel == MISSING:
+                raise MandatoryValueError(
+                    f"You must specify '{full_group}', e.g. with the CLI override '{full_group}=<option>'"
+                )
+            if at_pkg is not None:
+                pkg = _join_pkg(own_pkg, at_pkg)
+            else:
+                pkg = _join_pkg(own_pkg, os.path.basename(full_group))
+            self._expand(full_group, sel, pkg, choices, out, seen)
+
+    def _collect_choices(
+        self,
+        group: str,
+        option: str,
+        parent_pkg: str,
+        choices: Dict[str, str],
+        cli_choices: Dict[str, str],
+        seen: Optional[set] = None,
+    ) -> None:
+        """Walk the defaults tree recording `override` directives (walk order:
+        later wins) so that a second expansion pass can use the final
+        selections. Choice keys are ``group`` or ``group@absolute.package``.
+        CLI choices always win."""
+        seen = seen or set()
+        key = (group, option)
+        if key in seen:
+            return
+        seen = seen | {key}
+        try:
+            _, defaults, pkg_header = self._load_file(group, option)
+        except ConfigError:
+            return
+        if pkg_header is not None:
+            own_pkg = "" if pkg_header == "_global_" else _join_pkg("", pkg_header)
+        else:
+            own_pkg = parent_pkg
+        for raw in defaults:
+            if raw == "_self_" or isinstance(raw, str):
+                if isinstance(raw, str) and raw != "_self_":
+                    self._collect_choices(group, _strip_ext(raw), own_pkg, choices, cli_choices, seen)
+                continue
+            if not isinstance(raw, dict) or len(raw) != 1:
+                continue
+            k, v = next(iter(raw.items()))
+            k = k.strip()
+            is_override = k.startswith("override ")
+            if is_override:
+                k = k[len("override ") :].strip()
+            at_pkg = None
+            if "@" in k:
+                k, at_pkg = k.split("@", 1)
+            g = k.lstrip("/")
+            full_group = g if (k.startswith("/") or not group) else f"{group}/{g}"
+            if at_pkg is not None:
+                choice_key = f"{full_group}@{_join_pkg(own_pkg, at_pkg)}"
+                child_pkg = _join_pkg(own_pkg, at_pkg)
+            else:
+                choice_key = full_group
+                child_pkg = _join_pkg(own_pkg, os.path.basename(full_group))
+            if is_override:
+                if choice_key not in cli_choices:
+                    choices[choice_key] = _strip_ext(v)
+                continue
+            sel = cli_choices.get(choice_key, choices.get(choice_key, _strip_ext(v) if v else v))
+            if sel and sel != MISSING:
+                self._collect_choices(full_group, sel, child_pkg, choices, cli_choices, seen)
+
+    # ---------------------------------------------------------------- compose
+    def compose(self, config_name: str = "config", overrides: Sequence[str] = ()) -> dotdict:
+        cli_choices, dotted, adds = self._parse_overrides(overrides)
+
+        # Fixed-point choice collection: overrides discovered in newly selected
+        # files may change selections which expose further overrides.
+        choices: Dict[str, str] = {}
+        for _ in range(8):
+            before = dict(choices)
+            self._collect_choices("", config_name, "", choices, cli_choices)
+            if choices == before:
+                break
+        choices.update(cli_choices)
+
+        out: List[_Entry] = []
+        self._expand("", config_name, "", choices, out)
+
+        result: Dict[str, Any] = {}
+        for entry in out:
+            node = copy.deepcopy(entry.content)
+            if entry.package:
+                wrapped: Dict[str, Any] = {}
+                set_by_path(wrapped, entry.package, node)
+                node = wrapped
+            _deep_merge(result, node)
+
+        for path, value in dotted:
+            set_by_path(result, path, value)
+        for path, value in adds:
+            set_by_path(result, path, value)
+
+        result = _resolve_interpolations(result)
+        return dotdict(result)
+
+    def _parse_overrides(self, overrides: Sequence[str]):
+        cli_choices: Dict[str, str] = {}
+        dotted: List[Tuple[str, Any]] = []
+        adds: List[Tuple[str, Any]] = []
+        for ov in overrides:
+            if "=" not in ov:
+                raise ConfigError(f"Override '{ov}' must be of the form key=value")
+            k, v = ov.split("=", 1)
+            k = k.strip()
+            if k.startswith("+"):
+                adds.append((k[1:], _parse_value(v)))
+                continue
+            group_key = k.split("@", 1)[0]
+            full_key = k.lstrip("/")  # keeps any @pkg suffix for scoped choices
+            if "." not in group_key and (self.is_group(group_key) or self._find_file(group_key, _strip_ext(v)) is not None):
+                cli_choices[full_key] = _strip_ext(v)
+            elif "/" in group_key and self.is_group(group_key.lstrip("/").rsplit("/", 1)[0]):
+                cli_choices[full_key] = _strip_ext(v)
+            else:
+                dotted.append((k, _parse_value(v)))
+        return cli_choices, dotted, adds
+
+
+def _parse_value(text: str) -> Any:
+    try:
+        return yaml.safe_load(text)
+    except yaml.YAMLError:
+        return text
+
+
+def _deep_merge(dst: Dict[str, Any], src: Dict[str, Any]) -> Dict[str, Any]:
+    for k, v in src.items():
+        if isinstance(v, dict) and isinstance(dst.get(k), dict):
+            _deep_merge(dst[k], v)
+        else:
+            dst[k] = v
+    return dst
+
+
+def _resolve_interpolations(root: Dict[str, Any]) -> Dict[str, Any]:
+    """Resolve ${a.b.c} references and ${now:fmt} resolver calls."""
+
+    resolving: set = set()
+
+    def resolve_value(value: Any) -> Any:
+        if isinstance(value, str):
+            return resolve_str(value)
+        if isinstance(value, dict):
+            return {k: resolve_value(v) for k, v in value.items()}
+        if isinstance(value, list):
+            return [resolve_value(v) for v in value]
+        return value
+
+    def resolve_str(text: str) -> Any:
+        m = _INTERP_RE.fullmatch(text)
+        if m:
+            return resolve_expr(m.group(1))
+        # Embedded interpolation inside a larger string: substitute textually.
+        def sub(match: "re.Match[str]") -> str:
+            val = resolve_expr(match.group(1))
+            return str(val)
+
+        prev = None
+        while prev != text and _INTERP_RE.search(text):
+            prev = text
+            text = _INTERP_RE.sub(sub, text)
+        return text
+
+    def resolve_expr(expr: str) -> Any:
+        expr = expr.strip()
+        if expr.startswith("now:"):
+            return datetime.datetime.now().strftime(expr[len("now:") :])
+        if expr.startswith("oc.env:"):
+            parts = expr[len("oc.env:") :].split(",", 1)
+            return os.environ.get(parts[0], parts[1] if len(parts) > 1 else None)
+        if expr in resolving:
+            raise ConfigError(f"Interpolation cycle detected at ${{{expr}}}")
+        resolving.add(expr)
+        try:
+            target = get_by_path(root, expr, default=ConfigError)
+            if target is ConfigError:
+                raise ConfigError(f"Interpolation key not found: ${{{expr}}}")
+            return resolve_value(copy.deepcopy(target))
+        finally:
+            resolving.discard(expr)
+
+    return resolve_value(root)
+
+
+def compose(config_name: str = "config", overrides: Sequence[str] = (), roots: Optional[Sequence[str]] = None) -> dotdict:
+    """Compose the framework config. Main entry used by the CLI and tests."""
+    return Composer(roots).compose(config_name, overrides)

@@ -1,0 +1,61 @@
+"""Optimizer factories with reference-parity hyperparameter names.
+
+The reference instantiates `torch.optim.*` from `configs/optim/*.yaml`
+(optim/adam.yaml etc.). Here each factory returns an `optax.GradientTransformation`
+accepting the same hyperparameter names, so the YAML surface is unchanged.
+Gradient clipping is applied by the algorithms (optax.clip_by_global_norm
+chained in front), matching where the reference calls fabric.clip_gradients.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import optax
+
+from sheeprl_tpu.optim.rmsprop_tf import rmsprop_tf  # noqa: F401 (re-export)
+
+
+def adam(
+    lr: float = 2e-4,
+    eps: float = 1e-4,
+    weight_decay: float = 0.0,
+    betas: Sequence[float] = (0.9, 0.999),
+) -> optax.GradientTransformation:
+    # torch.optim.Adam semantics: L2 penalty folded into the gradient BEFORE
+    # the moment estimates (not AdamW's decoupled decay).
+    if weight_decay and weight_decay > 0:
+        return optax.chain(
+            optax.add_decayed_weights(weight_decay),
+            optax.scale_by_adam(b1=betas[0], b2=betas[1], eps=eps),
+            optax.scale(-lr),
+        )
+    return optax.adam(lr, b1=betas[0], b2=betas[1], eps=eps)
+
+
+def sgd(
+    lr: float = 2e-4,
+    momentum: float = 0.0,
+    weight_decay: float = 0.0,
+    nesterov: bool = False,
+    dampening: float = 0.0,
+) -> optax.GradientTransformation:
+    del dampening  # torch-parity kwarg; optax.sgd has no dampening (0 default matches)
+    tx = optax.sgd(lr, momentum=momentum or None, nesterov=nesterov)
+    if weight_decay and weight_decay > 0:
+        tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
+    return tx
+
+
+def rmsprop(
+    lr: float = 7e-4,
+    alpha: float = 0.99,
+    eps: float = 1e-5,
+    weight_decay: float = 0.0,
+    momentum: float = 0.0,
+    centered: bool = False,
+) -> optax.GradientTransformation:
+    tx = optax.rmsprop(lr, decay=alpha, eps=eps, centered=centered, momentum=momentum or None)
+    if weight_decay and weight_decay > 0:
+        tx = optax.chain(optax.add_decayed_weights(weight_decay), tx)
+    return tx

@@ -1,0 +1,72 @@
+"""TF-semantics RMSprop.
+
+The reference ships a custom `RMSpropTF` optimizer (sheeprl/optim/rmsprop_tf.py:14-156)
+for DreamerV1/V2 parity with the original TF implementations. The two semantic
+differences from standard RMSprop are:
+  1. epsilon is added *inside* the square root: update = g / sqrt(ms + eps),
+  2. the squared-gradient accumulator is initialized to **one**, not zero.
+This module implements those semantics as an optax transformation.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class RmspropTFState(NamedTuple):
+    ms: optax.Updates
+    mom: optax.Updates
+    mg: optax.Updates
+
+
+def scale_by_rms_tf(
+    alpha: float = 0.99,
+    eps: float = 1e-8,
+    momentum: float = 0.0,
+    centered: bool = False,
+) -> optax.GradientTransformation:
+    def init_fn(params):
+        ms = jax.tree_util.tree_map(jnp.ones_like, params)  # TF init: acc = 1
+        mom = jax.tree_util.tree_map(jnp.zeros_like, params)
+        mg = jax.tree_util.tree_map(jnp.zeros_like, params) if centered else ()
+        return RmspropTFState(ms=ms, mom=mom, mg=mg)
+
+    def update_fn(updates, state, params=None):
+        del params
+        ms = jax.tree_util.tree_map(lambda m, g: alpha * m + (1 - alpha) * g * g, state.ms, updates)
+        if centered:
+            mg = jax.tree_util.tree_map(lambda m, g: alpha * m + (1 - alpha) * g, state.mg, updates)
+            denom = jax.tree_util.tree_map(lambda m, a: jnp.sqrt(m - a * a + eps), ms, mg)  # eps inside sqrt
+        else:
+            mg = ()
+            denom = jax.tree_util.tree_map(lambda m: jnp.sqrt(m + eps), ms)  # eps inside sqrt
+        scaled = jax.tree_util.tree_map(lambda g, d: g / d, updates, denom)
+        if momentum > 0:
+            mom = jax.tree_util.tree_map(lambda b, s: momentum * b + s, state.mom, scaled)
+            out = mom
+        else:
+            mom = state.mom
+            out = scaled
+        return out, RmspropTFState(ms=ms, mom=mom, mg=mg)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def rmsprop_tf(
+    lr: float = 7e-4,
+    alpha: float = 0.99,
+    eps: float = 1e-5,
+    weight_decay: float = 0.0,
+    momentum: float = 0.0,
+    centered: bool = False,
+) -> optax.GradientTransformation:
+    parts = []
+    if weight_decay and weight_decay > 0:
+        parts.append(optax.add_decayed_weights(weight_decay))
+    parts.append(scale_by_rms_tf(alpha=alpha, eps=eps, momentum=momentum, centered=centered))
+    parts.append(optax.scale(-lr))
+    return optax.chain(*parts)

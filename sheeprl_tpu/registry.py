@@ -1,0 +1,56 @@
+"""Algorithm and evaluation registries.
+
+Decorator-driven maps from algorithm name to its entrypoint module, matching
+the reference's registry contract (sheeprl/utils/registry.py:11-108): each
+algorithm module registers a ``main(runtime, cfg)`` entrypoint and,
+separately, an evaluation function. The ``decoupled`` flag marks algorithms
+whose training loop runs a player/trainer process split.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+algorithm_registry: Dict[str, "AlgorithmEntry"] = {}
+evaluation_registry: Dict[str, "EvaluationEntry"] = {}
+
+
+@dataclass
+class AlgorithmEntry:
+    name: str
+    module: str
+    entrypoint: Callable[..., Any]
+    decoupled: bool = False
+
+
+@dataclass
+class EvaluationEntry:
+    name: str
+    module: str
+    entrypoint: Callable[..., Any]
+
+
+def register_algorithm(name: Optional[str] = None, decoupled: bool = False):
+    def decorator(fn: Callable[..., Any]):
+        # Default name = module file basename, exactly like the reference
+        # (sheeprl/utils/registry.py:21): sheeprl_tpu.algos.ppo.ppo_decoupled
+        # registers as "ppo_decoupled", avoiding sibling collisions.
+        algo_name = name or fn.__module__.split(".")[-1]
+        if algo_name in algorithm_registry and algorithm_registry[algo_name].module != fn.__module__:
+            raise ValueError(f"Algorithm '{algo_name}' already registered by {algorithm_registry[algo_name].module}")
+        algorithm_registry[algo_name] = AlgorithmEntry(algo_name, fn.__module__, fn, decoupled)
+        return fn
+
+    return decorator
+
+
+def register_evaluation(algorithms):
+    names = [algorithms] if isinstance(algorithms, str) else list(algorithms)
+
+    def decorator(fn: Callable[..., Any]):
+        for algo_name in names:
+            evaluation_registry[algo_name] = EvaluationEntry(algo_name, fn.__module__, fn)
+        return fn
+
+    return decorator

@@ -1,0 +1,157 @@
+"""Host-side helpers shared across the framework.
+
+Capability parity with the reference's ``sheeprl/utils/utils.py`` (dotdict,
+Ratio replay-ratio controller, polynomial_decay, config snapshotting), built
+for a JAX/TPU runtime: everything here runs on the host and never traces.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Any, Dict, Mapping
+
+import yaml
+
+
+class dotdict(dict):
+    """A dictionary supporting dot notation access and recursive wrapping.
+
+    Mirrors the runtime config object of the reference (sheeprl/utils/utils.py:34-60):
+    after composition the config becomes a plain dict subclass that algorithms may
+    mutate freely.
+    """
+
+    __getattr__ = dict.get
+    __setattr__ = dict.__setitem__
+    __delattr__ = dict.__delitem__
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        for k, v in self.items():
+            if isinstance(v, dict) and not isinstance(v, dotdict):
+                self[k] = dotdict(v)
+
+    def __getstate__(self):
+        return dict(self)
+
+    def __setstate__(self, state):
+        self.update(state)
+
+    def as_dict(self) -> Dict[str, Any]:
+        out = {}
+        for k, v in self.items():
+            out[k] = v.as_dict() if isinstance(v, dotdict) else v
+        return out
+
+
+def get_by_path(cfg: Mapping[str, Any], path: str, default: Any = None) -> Any:
+    """Fetch ``a.b.c`` style path from a nested mapping."""
+    node: Any = cfg
+    for part in path.split("."):
+        if not isinstance(node, Mapping) or part not in node:
+            return default
+        node = node[part]
+    return node
+
+
+def set_by_path(cfg: Dict[str, Any], path: str, value: Any) -> None:
+    """Set ``a.b.c`` style path in a nested dict, creating intermediate dicts."""
+    parts = path.split(".")
+    node = cfg
+    for part in parts[:-1]:
+        nxt = node.get(part)
+        if not isinstance(nxt, dict):
+            nxt = dotdict() if isinstance(cfg, dotdict) else {}
+            node[part] = nxt
+        node = nxt
+    node[parts[-1]] = value
+
+
+def polynomial_decay(
+    current_step: int,
+    *,
+    initial: float = 1.0,
+    final: float = 0.0,
+    max_decay_steps: int = 100,
+    power: float = 1.0,
+) -> float:
+    """Polynomial decay schedule (reference: sheeprl/utils/utils.py:133-144)."""
+    if current_step > max_decay_steps or initial == final:
+        return final
+    return (initial - final) * ((1 - current_step / max_decay_steps) ** power) + final
+
+
+class Ratio:
+    """Replay-ratio controller: given a monotonically increasing policy-step
+    counter, return how many gradient steps to run so that the long-run ratio
+    gradient_steps / policy_steps approaches ``ratio``.
+
+    Semantics match the reference (sheeprl/utils/utils.py:259-300), which in
+    turn follows Hafner's DreamerV3 `when.Ratio`.
+    """
+
+    def __init__(self, ratio: float, pretrain_steps: int = 0):
+        if pretrain_steps < 0:
+            raise ValueError(f"'pretrain_steps' must be non-negative, got {pretrain_steps}")
+        if ratio < 0:
+            raise ValueError(f"'ratio' must be non-negative, got {ratio}")
+        self._pretrain_steps = pretrain_steps
+        self._ratio = ratio
+        self._prev: float | None = None
+
+    def __call__(self, step: int) -> int:
+        if self._ratio == 0:
+            return 0
+        if self._prev is None:
+            self._prev = step
+            repeats = int(step * self._ratio)
+            if self._pretrain_steps > 0:
+                if step < self._pretrain_steps:
+                    warnings.warn(
+                        "The number of pretrain steps is greater than the number of current steps. "
+                        f"This could lead to a higher ratio than the one specified ({self._ratio}). "
+                        "Setting the 'pretrain_steps' equal to the number of current steps."
+                    )
+                    self._pretrain_steps = step
+                repeats = int(self._pretrain_steps * self._ratio)
+            return repeats
+        repeats = int((step - self._prev) * self._ratio)
+        self._prev += repeats / self._ratio
+        return repeats
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"_ratio": self._ratio, "_prev": self._prev, "_pretrain_steps": self._pretrain_steps}
+
+    def load_state_dict(self, state: Mapping[str, Any]) -> "Ratio":
+        self._ratio = state["_ratio"]
+        self._prev = state["_prev"]
+        self._pretrain_steps = state["_pretrain_steps"]
+        return self
+
+
+def save_configs(cfg: dotdict, log_dir: str) -> None:
+    """Snapshot the resolved config as YAML in the run directory.
+
+    This file is the contract for resume/eval (reference: save_configs,
+    sheeprl/utils/utils.py:255).
+    """
+    os.makedirs(log_dir, exist_ok=True)
+    with open(os.path.join(log_dir, "config.yaml"), "w") as fp:
+        yaml.safe_dump(cfg.as_dict() if isinstance(cfg, dotdict) else dict(cfg), fp, sort_keys=False)
+
+
+def load_yaml(path: str) -> Dict[str, Any]:
+    with open(path) as fp:
+        return yaml.safe_load(fp) or {}
+
+
+def print_config(cfg: Mapping[str, Any], fields=("algo", "buffer", "checkpoint", "env", "fabric", "metric")) -> None:
+    """Print the selected top-level config sections as YAML."""
+    for field in fields:
+        section = cfg.get(field)
+        if section is None:
+            continue
+        print(f"── {field} " + "─" * max(0, 60 - len(field)))
+        body = section.as_dict() if isinstance(section, dotdict) else section
+        print(yaml.safe_dump(body, sort_keys=False, default_flow_style=None).rstrip())

@@ -1,0 +1,166 @@
+"""Config composition engine tests.
+
+Covers the Hydra semantics the reference relies on: defaults-list ordering,
+exp overlays with `override /group:` directives, @pkg targeting, _self_
+position, interpolation, CLI group/dotted overrides, mandatory groups, and
+the SHEEPRL_SEARCH_PATH extension mechanism.
+"""
+
+import os
+
+import pytest
+
+from sheeprl_tpu.config import ConfigError, compose, instantiate
+from sheeprl_tpu.config.loader import MandatoryValueError
+
+
+def test_missing_exp_raises():
+    with pytest.raises(MandatoryValueError, match="exp"):
+        compose(overrides=[])
+
+
+def _write(tmp_path, rel, text):
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(text)
+    return path
+
+
+@pytest.fixture()
+def toy_root(tmp_path):
+    _write(
+        tmp_path,
+        "config.yaml",
+        """
+# @package _global_
+defaults:
+  - _self_
+  - algo: base
+  - env: alpha
+  - exp: ???
+seed: 42
+run_name: ${algo.name}_${env.id}
+""",
+    )
+    _write(tmp_path, "algo/base.yaml", "name: base\ngamma: 0.9\nnested:\n  units: ${algo.gamma}\n")
+    _write(
+        tmp_path,
+        "algo/big.yaml",
+        "defaults:\n  - base\n  - /optim@inner.optimizer: fast\n  - _self_\nname: big\nextra: 1\n",
+    )
+    _write(tmp_path, "optim/fast.yaml", "lr: 0.01\n")
+    _write(tmp_path, "env/alpha.yaml", "id: alpha\nn: 1\n")
+    _write(tmp_path, "env/beta.yaml", "id: beta\nn: 2\n")
+    _write(
+        tmp_path,
+        "exp/run.yaml",
+        """
+# @package _global_
+defaults:
+  - override /algo: big
+  - override /env: beta
+  - _self_
+algo:
+  gamma: 0.5
+""",
+    )
+    return str(tmp_path)
+
+
+def test_exp_overlay_overrides_groups(toy_root):
+    cfg = compose(overrides=["exp=run"], roots=[toy_root])
+    assert cfg.algo.name == "big"
+    assert cfg.env.id == "beta"
+    assert cfg.algo.extra == 1
+    # exp's _self_ merges last over the groups
+    assert cfg.algo.gamma == 0.5
+    # @pkg targeting relative to the containing file's package (algo)
+    assert cfg.algo.inner.optimizer.lr == 0.01
+    # interpolation picks up final (overridden) values
+    assert cfg.algo.nested.units == 0.5
+    assert cfg.run_name == "big_beta"
+
+
+def test_cli_group_and_dotted_overrides(toy_root):
+    cfg = compose(overrides=["exp=run", "env=alpha", "algo.gamma=0.7", "+algo.added=3"], roots=[toy_root])
+    assert cfg.env.id == "alpha"
+    assert cfg.algo.gamma == 0.7
+    assert cfg.algo.added == 3
+
+
+def test_value_types_parsed(toy_root):
+    cfg = compose(overrides=["exp=run", "algo.keys=[a,b]", "algo.flag=True", "algo.none=null"], roots=[toy_root])
+    assert cfg.algo["keys"] == ["a", "b"]
+    assert cfg.algo.flag is True
+    assert cfg.algo.none is None
+
+
+def test_search_path_env_var(toy_root, tmp_path_factory, monkeypatch):
+    user_root = tmp_path_factory.mktemp("user_configs")
+    (user_root / "exp").mkdir()
+    (user_root / "exp" / "custom.yaml").write_text("# @package _global_\nseed: 7\n")
+    monkeypatch.setenv("SHEEPRL_SEARCH_PATH", str(user_root))
+    from sheeprl_tpu.config.loader import Composer, search_paths
+
+    composer = Composer([str(user_root), toy_root])
+    cfg = composer.compose(overrides=["exp=custom"])
+    assert cfg.seed == 7
+    assert str(user_root) in search_paths()
+
+
+def test_interpolation_cycle_detected(tmp_path):
+    _write(tmp_path, "config.yaml", "a: ${b}\nb: ${a}\n")
+    with pytest.raises(ConfigError, match="cycle"):
+        compose(roots=[str(tmp_path)])
+
+
+def test_instantiate_target():
+    node = {"_target_": "collections.OrderedDict", "a": 1}
+    obj = instantiate(node)
+    assert obj == {"a": 1}
+    partial_node = {"_target_": "operator.add", "_partial_": True}
+    fn = instantiate(partial_node)
+    assert fn(2, 3) == 5
+
+
+def test_real_tree_with_extra_root(tmp_path):
+    exp_dir = tmp_path / "exp"
+    exp_dir.mkdir()
+    (exp_dir / "smoke.yaml").write_text(
+        "# @package _global_\ndefaults:\n  - override /env: dummy\n  - _self_\n"
+        "algo:\n  name: smoke\n  total_steps: 1\n  per_rank_batch_size: 2\nbuffer:\n  size: 4\n"
+    )
+    from sheeprl_tpu.config.loader import Composer, default_config_dir
+
+    cfg = Composer([str(tmp_path), default_config_dir()]).compose(overrides=["exp=smoke"])
+    assert cfg.algo.name == "smoke"
+    assert cfg.env.id == "discrete_dummy"
+    assert cfg.checkpoint.keep_last == 5
+    assert cfg.exp_name == "smoke_discrete_dummy"
+    assert cfg.metric.logger.root_dir.endswith("smoke/discrete_dummy")
+
+
+def test_pkg_scoped_override_does_not_clobber_sibling_slots(tmp_path):
+    _write(tmp_path, "config.yaml", "defaults:\n  - _self_\n  - algo: multi\n  - exp: swap\n")
+    _write(
+        tmp_path,
+        "algo/multi.yaml",
+        "defaults:\n  - _self_\n  - /optim@a.optimizer: fast\n  - /optim@b.optimizer: fast\nname: multi\n",
+    )
+    _write(tmp_path, "optim/fast.yaml", "lr: 0.01\n")
+    _write(tmp_path, "optim/slow.yaml", "lr: 0.0001\n")
+    _write(tmp_path, "exp/swap.yaml", "# @package _global_\ndefaults:\n  - override /optim@algo.a.optimizer: slow\n")
+    cfg = compose(overrides=[], roots=[str(tmp_path)])
+    assert cfg.algo.a.optimizer.lr == 0.0001
+    assert cfg.algo.b.optimizer.lr == 0.01
+
+
+def test_instantiate_recurses_into_plain_containers():
+    node = {
+        "_target_": "collections.OrderedDict",
+        "metrics": {"m1": {"_target_": "operator.add", "_partial_": True}},
+        "lst": [{"_target_": "operator.mul", "_partial_": True}],
+    }
+    obj = instantiate(node)
+    assert obj["metrics"]["m1"](1, 2) == 3
+    assert obj["lst"][0](3, 4) == 12
