@@ -21,7 +21,12 @@ def locate(path: str) -> Any:
         module_name = ".".join(parts[:split])
         try:
             module = importlib.import_module(module_name)
-        except ImportError:
+        except ImportError as e:
+            # Only swallow "this prefix isn't a module"; a module that exists
+            # but fails on a transitive import is a real error the user must
+            # see (e.g. missing optional dependency inside an env module).
+            if e.name is not None and not (module_name == e.name or module_name.startswith(e.name + ".")):
+                raise
             continue
         obj = module
         try:

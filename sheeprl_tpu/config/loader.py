@@ -135,6 +135,35 @@ class Composer:
         return content, defaults, pkg_header
 
     # -------------------------------------------------------------- expansion
+    @staticmethod
+    def _parse_entry(raw: Any, group: str, own_pkg: str):
+        """Parse one defaults-list dict entry into
+        (is_override, full_group, choice_key, child_pkg, default_option).
+
+        Single source of truth for both the choices walk and the expansion
+        walk — the choice_key computed here must be identical in both, or
+        `override` directives silently stop applying.
+        """
+        if not isinstance(raw, dict) or len(raw) != 1:
+            raise ConfigError(f"Malformed defaults entry {raw!r} in group '{group}'")
+        k, v = next(iter(raw.items()))
+        k = k.strip()
+        is_override = k.startswith("override ")
+        if is_override:
+            k = k[len("override ") :].strip()
+        at_pkg = None
+        if "@" in k:
+            k, at_pkg = k.split("@", 1)
+        absolute = k.startswith("/")
+        g = k.lstrip("/")
+        full_group = g if (absolute or not group) else f"{group}/{g}"
+        if at_pkg is not None:
+            child_pkg = _join_pkg(own_pkg, at_pkg)
+            choice_key = f"{full_group}@{child_pkg}"
+        else:
+            child_pkg = _join_pkg(own_pkg, os.path.basename(full_group))
+            choice_key = full_group
+        return is_override, full_group, choice_key, child_pkg, _strip_ext(v) if isinstance(v, str) else v
     def _expand(
         self,
         group: str,
@@ -169,30 +198,11 @@ class Composer:
                 # Same-group include, e.g. "- dreamer_v3" inside algo/.
                 self._expand(group, _strip_ext(raw), own_pkg, choices, out, seen)
                 continue
-            if not isinstance(raw, dict) or len(raw) != 1:
-                raise ConfigError(f"Malformed defaults entry {raw!r} in {group}/{option}")
-            k, v = next(iter(raw.items()))
-            k = k.strip()
-            is_override = k.startswith("override ")
-            if is_override:
-                k = k[len("override ") :].strip()
-            at_pkg = None
-            if "@" in k:
-                k, at_pkg = k.split("@", 1)
-            absolute = k.startswith("/")
-            g = k.lstrip("/")
-            full_group = g if (absolute or not group) else f"{group}/{g}"
+            is_override, full_group, choice_key, child_pkg, default_opt = self._parse_entry(raw, group, own_pkg)
             if is_override:
                 # Choice already recorded during the choices pass; skip here.
                 continue
-            # Choices are scoped by the *absolute* package when the entry
-            # targets one, so an override for /optim@algo.actor.optimizer does
-            # not clobber the /optim@algo.critic.optimizer slot.
-            if at_pkg is not None:
-                choice_key = f"{full_group}@{_join_pkg(own_pkg, at_pkg)}"
-            else:
-                choice_key = full_group
-            sel = choices.get(choice_key, v)
+            sel = choices.get(choice_key, default_opt)
             if sel is None:
                 continue
             sel = _strip_ext(sel)
@@ -200,11 +210,7 @@ class Composer:
                 raise MandatoryValueError(
                     f"You must specify '{full_group}', e.g. with the CLI override '{full_group}=<option>'"
                 )
-            if at_pkg is not None:
-                pkg = _join_pkg(own_pkg, at_pkg)
-            else:
-                pkg = _join_pkg(own_pkg, os.path.basename(full_group))
-            self._expand(full_group, sel, pkg, choices, out, seen)
+            self._expand(full_group, sel, child_pkg, choices, out, seen)
 
     def _collect_choices(
         self,
@@ -237,29 +243,15 @@ class Composer:
                 if isinstance(raw, str) and raw != "_self_":
                     self._collect_choices(group, _strip_ext(raw), own_pkg, choices, cli_choices, seen)
                 continue
-            if not isinstance(raw, dict) or len(raw) != 1:
+            try:
+                is_override, full_group, choice_key, child_pkg, default_opt = self._parse_entry(raw, group, own_pkg)
+            except ConfigError:
                 continue
-            k, v = next(iter(raw.items()))
-            k = k.strip()
-            is_override = k.startswith("override ")
-            if is_override:
-                k = k[len("override ") :].strip()
-            at_pkg = None
-            if "@" in k:
-                k, at_pkg = k.split("@", 1)
-            g = k.lstrip("/")
-            full_group = g if (k.startswith("/") or not group) else f"{group}/{g}"
-            if at_pkg is not None:
-                choice_key = f"{full_group}@{_join_pkg(own_pkg, at_pkg)}"
-                child_pkg = _join_pkg(own_pkg, at_pkg)
-            else:
-                choice_key = full_group
-                child_pkg = _join_pkg(own_pkg, os.path.basename(full_group))
             if is_override:
                 if choice_key not in cli_choices:
-                    choices[choice_key] = _strip_ext(v)
+                    choices[choice_key] = default_opt
                 continue
-            sel = cli_choices.get(choice_key, choices.get(choice_key, _strip_ext(v) if v else v))
+            sel = cli_choices.get(choice_key, choices.get(choice_key, default_opt))
             if sel and sel != MISSING:
                 self._collect_choices(full_group, sel, child_pkg, choices, cli_choices, seen)
 
@@ -289,7 +281,15 @@ class Composer:
                 node = wrapped
             _deep_merge(result, node)
 
+        _sentinel = object()
         for path, value in dotted:
+            # Hydra semantics: a plain override must target an existing key;
+            # typos should fail loudly. New keys require the '+key=value' form.
+            if get_by_path(result, path, _sentinel) is _sentinel:
+                raise ConfigError(
+                    f"Could not override '{path}': no such key in the composed config. "
+                    f"Use '+{path}={value}' to add a new key."
+                )
             set_by_path(result, path, value)
         for path, value in adds:
             set_by_path(result, path, value)

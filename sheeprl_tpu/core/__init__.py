@@ -1,0 +1,31 @@
+from sheeprl_tpu.core.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    batch_sharding,
+    build_mesh,
+    local_batch_size,
+    replicate,
+    replicated_sharding,
+    shard_batch,
+)
+from sheeprl_tpu.core.precision import Precision, resolve_precision
+from sheeprl_tpu.core.prng import KeySequence, make_streams, seed_everything
+from sheeprl_tpu.core.runtime import Runtime, get_single_device_runtime
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "batch_sharding",
+    "build_mesh",
+    "local_batch_size",
+    "replicate",
+    "replicated_sharding",
+    "shard_batch",
+    "Precision",
+    "resolve_precision",
+    "KeySequence",
+    "make_streams",
+    "seed_everything",
+    "Runtime",
+    "get_single_device_runtime",
+]

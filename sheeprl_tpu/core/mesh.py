@@ -1,0 +1,90 @@
+"""Device mesh construction and sharding helpers.
+
+This is the substrate layer that replaces Lightning Fabric's strategy system
+(reference L0, SURVEY §1): instead of DDP process groups over NCCL/Gloo, a
+single `jax.sharding.Mesh` spans every chip (ICI within a slice, DCN across
+slices), and parallelism is expressed as sharding annotations that XLA lowers
+to collectives.
+
+Axes:
+  - ``data``: batch (data-parallel) axis — replaces DDP gradient allreduce.
+  - ``model``: optional tensor-parallel axis for wide layers (the reference
+    has no TP at all; the 4096-wide RSSM stacks make it worthwhile on TPU).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def build_mesh(
+    devices: Optional[Sequence[jax.Device]] = None,
+    data_axis_size: Optional[int] = None,
+    model_axis_size: int = 1,
+) -> Mesh:
+    """Build a 2-D (data, model) mesh over the given devices.
+
+    ``data_axis_size=None`` uses all devices divided by ``model_axis_size``.
+    """
+    devices = list(devices) if devices is not None else jax.devices()
+    n = len(devices)
+    if model_axis_size < 1:
+        raise ValueError(f"model_axis_size must be >= 1, got {model_axis_size}")
+    if n % model_axis_size != 0:
+        raise ValueError(f"model_axis_size {model_axis_size} does not divide device count {n}")
+    if data_axis_size is None:
+        data_axis_size = n // model_axis_size
+    if data_axis_size * model_axis_size > n:
+        raise ValueError(
+            f"Requested mesh {data_axis_size}x{model_axis_size} exceeds available devices ({n})"
+        )
+    used = devices[: data_axis_size * model_axis_size]
+    arr = np.asarray(used).reshape(data_axis_size, model_axis_size)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for a batch-leading array: leading dim split over `data`."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(tree: Any, mesh: Mesh, axis: int = 0) -> Any:
+    """Device-put a host pytree with its ``axis`` dim sharded over `data`.
+
+    This is the H2D infeed primitive: the analog of the reference's
+    `to_tensor`/`get_tensor` bridge (sheeprl/data/buffers.py:1158-1180), but
+    placing each shard directly on its device (no gather on one chip).
+    """
+
+    def _put(x):
+        x = np.asarray(x)
+        spec = [None] * x.ndim
+        if x.ndim > axis:
+            spec[axis] = DATA_AXIS
+        return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+
+    return jax.tree_util.tree_map(_put, tree)
+
+
+def replicate(tree: Any, mesh: Mesh) -> Any:
+    """Device-put a host pytree fully replicated over the mesh (params)."""
+    sharding = replicated_sharding(mesh)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)
+
+
+def local_batch_size(global_batch: int, mesh: Mesh) -> int:
+    data = mesh.shape[DATA_AXIS]
+    if global_batch % data != 0:
+        raise ValueError(f"Global batch {global_batch} not divisible by data axis {data}")
+    return global_batch // data

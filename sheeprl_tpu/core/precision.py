@@ -1,0 +1,45 @@
+"""Precision policies.
+
+Keeps the reference's Fabric precision surface (``32-true``, ``bf16-mixed``,
+``bf16-true``; configs/fabric/default.yaml) but maps it onto the JAX/TPU
+model: parameters in fp32 unless bf16-true, compute (activations/matmuls) in
+bf16 for both bf16 modes — bf16 is the MXU-native dtype. Reductions that the
+reference keeps in fp32 (Moments quantiles, λ-returns, losses) stay fp32 in
+the algorithms regardless of policy, matching its dtype-preserving LayerNorm
+behavior (sheeprl/models/models.py:521-525).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class Precision:
+    name: str
+    param_dtype: jnp.dtype
+    compute_dtype: jnp.dtype
+
+    @property
+    def is_mixed(self) -> bool:
+        return self.param_dtype != self.compute_dtype
+
+
+_POLICIES = {
+    "32-true": ("float32", "float32"),
+    "bf16-mixed": ("float32", "bfloat16"),
+    "bf16-true": ("bfloat16", "bfloat16"),
+    # torch-style aliases accepted for config compatibility
+    "16-mixed": ("float32", "bfloat16"),
+    "32": ("float32", "float32"),
+}
+
+
+def resolve_precision(name: str) -> Precision:
+    try:
+        param, compute = _POLICIES[str(name)]
+    except KeyError:
+        raise ValueError(f"Unknown precision '{name}'. Valid: {sorted(_POLICIES)}") from None
+    return Precision(str(name), jnp.dtype(param), jnp.dtype(compute))

@@ -1,0 +1,183 @@
+"""The Runtime: the framework's substrate object, replacing Lightning Fabric.
+
+Where the reference passes a ``fabric`` into every algorithm ``main(fabric,
+cfg)`` (sheeprl/cli.py:199), this framework passes a :class:`Runtime`. It
+owns:
+
+- accelerator/device selection (cpu | tpu | auto),
+- multi-host initialization (jax.distributed; DCN between hosts, ICI within),
+- the device :class:`~jax.sharding.Mesh` (data × model axes),
+- the precision policy,
+- seeding and the root PRNG key,
+- rank-zero-gated printing/logging helpers.
+
+Unlike Fabric there is no module wrapping / DDP setup: parallelism is sharding
+metadata on jitted functions, so "setup_module" has no equivalent — algorithms
+jit their train steps with shardings derived from `runtime.mesh`.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+
+from sheeprl_tpu.core import mesh as mesh_lib
+from sheeprl_tpu.core.precision import Precision, resolve_precision
+from sheeprl_tpu.core.prng import seed_everything
+
+_TPU_PLATFORMS = ("tpu", "axon")
+
+
+class Runtime:
+    def __init__(
+        self,
+        devices: int | str = 1,
+        num_nodes: int = 1,
+        strategy: str = "auto",
+        accelerator: str = "auto",
+        precision: str = "32-true",
+        model_axis: int = 1,
+    ) -> None:
+        self.requested_devices = devices
+        self.num_nodes = num_nodes
+        self.strategy = strategy
+        self.accelerator = accelerator
+        self.precision: Precision = resolve_precision(precision)
+        self.model_axis = int(model_axis)
+        self._mesh: Optional[mesh_lib.Mesh] = None
+        self._launched = False
+        self.seed: Optional[int] = None
+        self.root_key: Optional[jax.Array] = None
+
+    # ------------------------------------------------------------ lifecycle
+    def launch(self) -> "Runtime":
+        """Initialize multi-host (if configured) and build the mesh."""
+        if self._launched:
+            return self
+        if self.num_nodes > 1 and jax.process_count() == 1:
+            # On TPU pods jax.distributed.initialize() auto-detects the
+            # coordinator from platform metadata; no env var is required.
+            # Failure must be loud — silently training per-host with a
+            # halved world is worse than crashing.
+            jax.distributed.initialize()
+        self._mesh = mesh_lib.build_mesh(
+            devices=self._select_devices(),
+            data_axis_size=None,
+            model_axis_size=self.model_axis,
+        )
+        self._launched = True
+        return self
+
+    def _select_devices(self) -> Sequence[jax.Device]:
+        if self.accelerator == "cpu":
+            devs = jax.devices("cpu")
+        elif self.accelerator in _TPU_PLATFORMS:
+            devs = [d for d in jax.devices() if d.platform in _TPU_PLATFORMS]
+            if not devs:
+                raise RuntimeError("accelerator=tpu requested but no TPU devices are visible")
+        else:  # auto
+            devs = jax.devices()
+        if self.requested_devices in ("auto", -1, None):
+            return devs
+        n = int(self.requested_devices) * self.model_axis
+        if n > len(devs):
+            raise RuntimeError(
+                f"Requested {n} devices (devices={self.requested_devices} x model_axis={self.model_axis}) "
+                f"but only {len(devs)} are visible"
+            )
+        return devs[:n]
+
+    # ------------------------------------------------------------ properties
+    @property
+    def mesh(self) -> mesh_lib.Mesh:
+        if self._mesh is None:
+            self.launch()
+        return self._mesh
+
+    @property
+    def device(self) -> jax.Device:
+        return self.mesh.devices.flat[0]
+
+    @property
+    def world_size(self) -> int:
+        """Number of data-parallel workers (devices on the data axis).
+
+        Plays the role of the reference's world_size: per_rank_* config values
+        are per data-parallel shard.
+        """
+        return int(self.mesh.shape[mesh_lib.DATA_AXIS])
+
+    @property
+    def global_rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def node_rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def is_global_zero(self) -> bool:
+        return jax.process_index() == 0
+
+    # ------------------------------------------------------------ utilities
+    def seed_everything(self, seed: int) -> jax.Array:
+        # Different hosts must draw different env seeds but identical model
+        # init: algorithms use root_key (identical) for params and
+        # fold_in(rank) streams for env/sampling.
+        self.seed = seed
+        self.root_key = seed_everything(seed)
+        return self.root_key
+
+    def print(self, *args: Any, **kwargs: Any) -> None:
+        if self.is_global_zero:
+            print(*args, **kwargs)
+
+    def shard_batch(self, tree: Any, axis: int = 0) -> Any:
+        return mesh_lib.shard_batch(tree, self.mesh, axis=axis)
+
+    def replicate(self, tree: Any) -> Any:
+        return mesh_lib.replicate(tree, self.mesh)
+
+    def to_host(self, tree: Any) -> Any:
+        return jax.tree_util.tree_map(np.asarray, tree)
+
+    def local_batch_size(self, global_batch: int) -> int:
+        return mesh_lib.local_batch_size(global_batch, self.mesh)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        shape = dict(self.mesh.shape) if self._mesh is not None else "unlaunched"
+        return (
+            f"Runtime(accelerator={self.accelerator}, precision={self.precision.name}, "
+            f"mesh={shape}, processes={jax.process_count()})"
+        )
+
+
+def get_single_device_runtime(runtime: Runtime) -> Runtime:
+    """A single-device view of an existing runtime, for the *player*.
+
+    Parity with `get_single_device_fabric` (sheeprl/utils/fabric.py:8-35): env
+    interaction must never synchronize across the mesh. In JAX terms the
+    player just runs jitted forwards on device 0 with replicated params — no
+    collective ops are traced, so a separate strategy object is unnecessary;
+    this helper exists to make that intent explicit at call sites.
+    """
+    view = Runtime(
+        devices=1,
+        num_nodes=1,
+        strategy="single_device",
+        accelerator=runtime.accelerator,
+        precision=runtime.precision.name,
+        model_axis=1,
+    )
+    # The player must live on a device *this process* can address: the global
+    # mesh's first device belongs to process 0, which is remote on other hosts.
+    local = [d for d in runtime.mesh.devices.flat if d.process_index == jax.process_index()]
+    player_device = local[0] if local else jax.local_devices()[0]
+    view._mesh = mesh_lib.build_mesh(devices=[player_device], model_axis_size=1)
+    view._launched = True
+    view.seed = runtime.seed
+    view.root_key = runtime.root_key
+    return view

@@ -9,10 +9,21 @@ first `import jax` anywhere in the test process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The host environment pins JAX_PLATFORMS=axon (the tunneled TPU) and its
+# sitecustomize initializes that backend before any user code runs, so setting
+# env vars alone is not enough: re-point JAX at CPU and drop the already-built
+# backends. XLA_FLAGS is read lazily when the CPU client is created.
+os.environ["JAX_PLATFORMS"] = "cpu"
 prev = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in prev:
     os.environ["XLA_FLAGS"] = (prev + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+from jax.extend import backend as _jeb  # noqa: E402
+
+_jeb.clear_backends()
 
 import pytest  # noqa: E402
 

@@ -89,7 +89,7 @@ def test_cli_group_and_dotted_overrides(toy_root):
 
 
 def test_value_types_parsed(toy_root):
-    cfg = compose(overrides=["exp=run", "algo.keys=[a,b]", "algo.flag=True", "algo.none=null"], roots=[toy_root])
+    cfg = compose(overrides=["exp=run", "+algo.keys=[a,b]", "+algo.flag=True", "+algo.none=null"], roots=[toy_root])
     assert cfg.algo["keys"] == ["a", "b"]
     assert cfg.algo.flag is True
     assert cfg.algo.none is None
@@ -164,3 +164,21 @@ def test_instantiate_recurses_into_plain_containers():
     obj = instantiate(node)
     assert obj["metrics"]["m1"](1, 2) == 3
     assert obj["lst"][0](3, 4) == 12
+
+
+def test_unknown_dotted_override_rejected(toy_root):
+    with pytest.raises(ConfigError, match="Could not override"):
+        compose(overrides=["exp=run", "algo.gama=0.9"], roots=[toy_root])
+    with pytest.raises(ConfigError, match="Could not override"):
+        compose(overrides=["exp=run", "algos=weird"], roots=[toy_root])
+
+
+def test_locate_reraises_transitive_import_error(tmp_path, monkeypatch):
+    import sys
+    pkg = tmp_path / "brokenpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("import nonexistent_dependency_xyz\n")
+    monkeypatch.syspath_prepend(str(tmp_path))
+    from sheeprl_tpu.config.instantiate import locate
+    with pytest.raises(ImportError, match="nonexistent_dependency_xyz"):
+        locate("brokenpkg.something")

@@ -1,0 +1,83 @@
+"""Core substrate tests on the virtual 8-device CPU platform."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.core import (
+    DATA_AXIS,
+    Runtime,
+    build_mesh,
+    get_single_device_runtime,
+    local_batch_size,
+    resolve_precision,
+    shard_batch,
+)
+
+
+def test_virtual_devices_present():
+    assert len(jax.devices()) == 8
+
+
+def test_build_mesh_shapes():
+    mesh = build_mesh()
+    assert mesh.shape[DATA_AXIS] == 8
+    mesh2 = build_mesh(model_axis_size=2)
+    assert mesh2.shape[DATA_AXIS] == 4
+    assert mesh2.shape["model"] == 2
+    with pytest.raises(ValueError):
+        build_mesh(model_axis_size=3)
+
+
+def test_shard_batch_places_shards():
+    mesh = build_mesh()
+    batch = {"obs": np.arange(16 * 3, dtype=np.float32).reshape(16, 3)}
+    sharded = shard_batch(batch, mesh)
+    assert sharded["obs"].shape == (16, 3)
+    assert len(sharded["obs"].addressable_shards) == 8
+    assert sharded["obs"].addressable_shards[0].data.shape == (2, 3)
+    np.testing.assert_array_equal(np.asarray(sharded["obs"]), batch["obs"])
+
+
+def test_psum_over_mesh():
+    mesh = build_mesh()
+    x = shard_batch(np.ones((8, 4), np.float32), mesh)
+
+    @jax.jit
+    def total(v):
+        return jnp.sum(v)
+
+    assert float(total(x)) == 32.0
+
+
+def test_runtime_launch_and_world():
+    rt = Runtime(devices="auto", accelerator="cpu", precision="bf16-mixed").launch()
+    assert rt.world_size == 8
+    assert rt.is_global_zero
+    assert rt.precision.compute_dtype == jnp.bfloat16
+    assert rt.precision.param_dtype == jnp.float32
+    key = rt.seed_everything(3)
+    assert key is not None
+    assert rt.local_batch_size(64) == 8
+    single = get_single_device_runtime(rt)
+    assert single.world_size == 1
+    assert single.seed == 3
+
+
+def test_runtime_device_count_limit():
+    rt = Runtime(devices=2, accelerator="cpu").launch()
+    assert rt.world_size == 2
+    with pytest.raises(RuntimeError):
+        Runtime(devices=99, accelerator="cpu").launch()
+
+
+def test_precision_unknown():
+    with pytest.raises(ValueError):
+        resolve_precision("8-bit")
+
+
+def test_local_batch_not_divisible():
+    mesh = build_mesh()
+    with pytest.raises(ValueError):
+        local_batch_size(12, mesh)
