@@ -21,10 +21,11 @@ def locate(path: str) -> Any:
         module_name = ".".join(parts[:split])
         try:
             module = importlib.import_module(module_name)
-        except ImportError as e:
-            # Only swallow "this prefix isn't a module"; a module that exists
-            # but fails on a transitive import is a real error the user must
-            # see (e.g. missing optional dependency inside an env module).
+        except ModuleNotFoundError as e:
+            # Only swallow "this prefix isn't a module"; anything else — a
+            # transitive missing dependency, or a module that exists but
+            # raises a bare ImportError("install the X extra") — is a real
+            # error the user must see, so plain ImportError propagates.
             if e.name is not None and not (module_name == e.name or module_name.startswith(e.name + ".")):
                 raise
             continue

@@ -257,7 +257,7 @@ class Composer:
 
     # ---------------------------------------------------------------- compose
     def compose(self, config_name: str = "config", overrides: Sequence[str] = ()) -> dotdict:
-        cli_choices, dotted, adds = self._parse_overrides(overrides)
+        cli_choices, dotted = self._parse_overrides(overrides)
 
         # Fixed-point choice collection: overrides discovered in newly selected
         # files may change selections which expose further overrides.
@@ -282,16 +282,16 @@ class Composer:
             _deep_merge(result, node)
 
         _sentinel = object()
-        for path, value in dotted:
-            # Hydra semantics: a plain override must target an existing key;
-            # typos should fail loudly. New keys require the '+key=value' form.
-            if get_by_path(result, path, _sentinel) is _sentinel:
+        # Applied in CLI order so '+a.b={}' can introduce a key that a later
+        # plain 'a.b.c=1' override targets (Hydra applies in list order).
+        for path, value, is_add in dotted:
+            if not is_add and get_by_path(result, path, _sentinel) is _sentinel:
+                # Hydra semantics: a plain override must target an existing
+                # key; typos should fail loudly. New keys use '+key=value'.
                 raise ConfigError(
                     f"Could not override '{path}': no such key in the composed config. "
                     f"Use '+{path}={value}' to add a new key."
                 )
-            set_by_path(result, path, value)
-        for path, value in adds:
             set_by_path(result, path, value)
 
         result = _resolve_interpolations(result)
@@ -299,15 +299,14 @@ class Composer:
 
     def _parse_overrides(self, overrides: Sequence[str]):
         cli_choices: Dict[str, str] = {}
-        dotted: List[Tuple[str, Any]] = []
-        adds: List[Tuple[str, Any]] = []
+        dotted: List[Tuple[str, Any, bool]] = []  # (path, value, is_add), CLI order
         for ov in overrides:
             if "=" not in ov:
                 raise ConfigError(f"Override '{ov}' must be of the form key=value")
             k, v = ov.split("=", 1)
             k = k.strip()
             if k.startswith("+"):
-                adds.append((k[1:], _parse_value(v)))
+                dotted.append((k[1:], _parse_value(v), True))
                 continue
             group_key = k.split("@", 1)[0]
             full_key = k.lstrip("/")  # keeps any @pkg suffix for scoped choices
@@ -316,8 +315,8 @@ class Composer:
             elif "/" in group_key and self.is_group(group_key.lstrip("/").rsplit("/", 1)[0]):
                 cli_choices[full_key] = _strip_ext(v)
             else:
-                dotted.append((k, _parse_value(v)))
-        return cli_choices, dotted, adds
+                dotted.append((k, _parse_value(v), False))
+        return cli_choices, dotted
 
 
 def _parse_value(text: str) -> Any:

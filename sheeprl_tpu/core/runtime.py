@@ -57,12 +57,18 @@ class Runtime:
         """Initialize multi-host (if configured) and build the mesh."""
         if self._launched:
             return self
-        if self.num_nodes > 1 and jax.process_count() == 1:
+        if self.num_nodes > 1:
             # On TPU pods jax.distributed.initialize() auto-detects the
             # coordinator from platform metadata; no env var is required.
-            # Failure must be loud — silently training per-host with a
-            # halved world is worse than crashing.
-            jax.distributed.initialize()
+            # Failure must be loud — silently training per-host with a halved
+            # world is worse than crashing. Note: nothing may touch the JAX
+            # backend before this call (no jax.devices()/process_count()), so
+            # the only tolerated error is "already initialized".
+            try:
+                jax.distributed.initialize()
+            except RuntimeError as e:
+                if "already" not in str(e).lower():
+                    raise
         self._mesh = mesh_lib.build_mesh(
             devices=self._select_devices(),
             data_axis_size=None,
@@ -148,10 +154,13 @@ class Runtime:
         return mesh_lib.local_batch_size(global_batch, self.mesh)
 
     def __repr__(self) -> str:  # pragma: no cover
-        shape = dict(self.mesh.shape) if self._mesh is not None else "unlaunched"
+        # repr must not initialize the JAX backend as a side effect (that
+        # would lock in the platform before launch()).
+        if self._mesh is None:
+            return f"Runtime(accelerator={self.accelerator}, precision={self.precision.name}, unlaunched)"
         return (
             f"Runtime(accelerator={self.accelerator}, precision={self.precision.name}, "
-            f"mesh={shape}, processes={jax.process_count()})"
+            f"mesh={dict(self.mesh.shape)}, processes={jax.process_count()})"
         )
 
 

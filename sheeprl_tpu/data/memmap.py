@@ -1,0 +1,146 @@
+"""Disk-backed numpy arrays for replay buffers.
+
+Behavioral parity with the reference's MemmapArray (sheeprl/utils/memmap.py:22-270):
+lazily-opened ``np.memmap`` storage with explicit file ownership (the owner
+deletes the backing file on collection), pickling that reopens the mapping in
+the child process without transferring ownership (worker-safe), and ndarray
+duck-typing so buffer code can treat it as a plain array.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Any, Optional, Tuple
+
+import numpy as np
+from numpy.typing import DTypeLike
+
+_VALID_MODES = ("r+", "w+", "c", "copyonwrite", "readwrite", "write")
+
+
+class MemmapArray:
+    def __init__(
+        self,
+        filename: str | os.PathLike,
+        dtype: DTypeLike,
+        shape: Tuple[int, ...],
+        mode: str = "r+",
+    ):
+        if mode not in _VALID_MODES:
+            raise ValueError(f"Accepted values for mode are {_VALID_MODES}, got '{mode}'")
+        self._filename = Path(filename).absolute()
+        self._dtype = np.dtype(dtype)
+        self._shape = tuple(shape)
+        self._mode = mode
+        self._array: Optional[np.memmap] = None
+        self._has_ownership = True
+        self._filename.parent.mkdir(parents=True, exist_ok=True)
+        if not self._filename.exists() or os.path.getsize(self._filename) != self._dtype.itemsize * int(
+            np.prod(self._shape)
+        ):
+            # First creation must allocate the file ("w+"); subsequent opens
+            # honor the requested mode.
+            np.memmap(self._filename, dtype=self._dtype, shape=self._shape, mode="w+").flush()
+
+    # ----------------------------------------------------------- properties
+    @property
+    def filename(self) -> Path:
+        return self._filename
+
+    @property
+    def dtype(self) -> np.dtype:
+        return self._dtype
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return self._shape
+
+    @property
+    def mode(self) -> str:
+        return self._mode
+
+    @property
+    def has_ownership(self) -> bool:
+        return self._has_ownership
+
+    @has_ownership.setter
+    def has_ownership(self, value: bool) -> None:
+        self._has_ownership = bool(value)
+
+    @property
+    def array(self) -> np.memmap:
+        if self._array is None:
+            self._array = np.memmap(self._filename, dtype=self._dtype, shape=self._shape, mode=self._mode)
+        return self._array
+
+    # ------------------------------------------------------------- creation
+    @classmethod
+    def from_array(
+        cls,
+        array: "np.ndarray | MemmapArray",
+        filename: str | os.PathLike,
+        mode: str = "r+",
+    ) -> "MemmapArray":
+        if isinstance(array, MemmapArray):
+            source = array.array
+        else:
+            source = np.asarray(array)
+        out = cls(filename=filename, dtype=source.dtype, shape=source.shape, mode=mode)
+        same_file = isinstance(array, MemmapArray) and Path(filename).absolute() == array.filename
+        if not same_file:
+            out.array[:] = source
+            out.array.flush()
+        else:
+            # Pointing at the same backing file: become a non-owning view so
+            # two collectors don't both try to delete it.
+            out._has_ownership = False
+        return out
+
+    # ------------------------------------------------------------ lifecycle
+    def __del__(self) -> None:
+        # Runs during interpreter shutdown too, when module globals (os,
+        # pathlib internals) may already be torn down — never raise here.
+        try:
+            if getattr(self, "_has_ownership", False) and getattr(self, "_filename", None) is not None:
+                array = self._array
+                if array is not None:
+                    array.flush()
+                    del array
+                self._array = None
+                self._filename.unlink(missing_ok=True)
+        except Exception:
+            pass
+
+    def __getstate__(self) -> dict:
+        state = self.__dict__.copy()
+        state["_array"] = None
+        # Unpickled copies (e.g. in worker processes) never own the file.
+        state["_has_ownership"] = False
+        return state
+
+    def __setstate__(self, state: dict) -> None:
+        self.__dict__.update(state)
+
+    # ---------------------------------------------------------- array-like
+    def __array__(self, dtype: DTypeLike = None) -> np.ndarray:
+        arr = self.array
+        return np.asarray(arr, dtype=dtype) if dtype is not None else arr
+
+    def __getitem__(self, idx: Any) -> np.ndarray:
+        return self.array[idx]
+
+    def __setitem__(self, idx: Any, value: Any) -> None:
+        self.array[idx] = value
+
+    def __getattr__(self, attr: str) -> Any:
+        # Delegate ndarray API (ndim, size, reshape, ...) to the mapping.
+        if attr.startswith("_"):
+            raise AttributeError(attr)
+        return getattr(self.array, attr)
+
+    def __len__(self) -> int:
+        return self._shape[0]
+
+    def __repr__(self) -> str:
+        return f"MemmapArray(shape={self._shape}, dtype={self._dtype}, file={self._filename}, owner={self._has_ownership})"

@@ -182,3 +182,8 @@ def test_locate_reraises_transitive_import_error(tmp_path, monkeypatch):
     from sheeprl_tpu.config.instantiate import locate
     with pytest.raises(ImportError, match="nonexistent_dependency_xyz"):
         locate("brokenpkg.something")
+
+
+def test_add_then_override_in_order(toy_root):
+    cfg = compose(overrides=["exp=run", "+algo.block.x=1", "algo.block.x=2"], roots=[toy_root])
+    assert cfg.algo.block.x == 2

@@ -1,0 +1,282 @@
+"""Replay buffer unit tests, mirroring the reference's coverage
+(tests/test_data/test_buffers.py, test_sequential_buffer.py,
+test_episode_buffer.py, test_env_independent_rb.py)."""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data import (
+    EnvIndependentReplayBuffer,
+    EpisodeBuffer,
+    MemmapArray,
+    ReplayBuffer,
+    SequentialReplayBuffer,
+)
+
+
+def make_steps(t, n_envs, base=0):
+    return {
+        "observations": np.arange(base, base + t * n_envs, dtype=np.float32).reshape(t, n_envs, 1),
+        "rewards": np.zeros((t, n_envs, 1), np.float32),
+        "terminated": np.zeros((t, n_envs, 1), np.float32),
+        "truncated": np.zeros((t, n_envs, 1), np.float32),
+    }
+
+
+class TestReplayBuffer:
+    def test_invalid_init(self):
+        with pytest.raises(ValueError):
+            ReplayBuffer(0)
+        with pytest.raises(ValueError):
+            ReplayBuffer(4, 0)
+        with pytest.raises(ValueError):
+            ReplayBuffer(4, memmap=True)  # no dir
+
+    def test_add_and_wraparound(self):
+        rb = ReplayBuffer(buffer_size=4, n_envs=2)
+        rb.add(make_steps(3, 2))
+        assert not rb.full
+        rb.add(make_steps(3, 2, base=6))
+        assert rb.full
+        # pos wrapped to 2; oldest data overwritten: second add wrote steps
+        # (6,7),(8,9),(10,11) at positions 3,0,1
+        assert rb._pos == 2
+        np.testing.assert_array_equal(rb["observations"][0, :, 0], [8, 9])
+        np.testing.assert_array_equal(rb["observations"][3, :, 0], [6, 7])
+
+    def test_add_longer_than_buffer(self):
+        rb = ReplayBuffer(buffer_size=3, n_envs=1)
+        data = make_steps(8, 1)
+        rb.add(data)
+        assert rb.full
+        # last 3 steps survive (5, 6, 7)
+        stored = np.sort(np.asarray(rb["observations"]).ravel())
+        np.testing.assert_array_equal(stored, [5, 6, 7])
+
+    def test_add_validate(self):
+        rb = ReplayBuffer(4, 2)
+        with pytest.raises(ValueError):
+            rb.add([1, 2], validate_args=True)
+        with pytest.raises(RuntimeError):
+            rb.add({"a": np.zeros(3)}, validate_args=True)
+        with pytest.raises(RuntimeError):
+            rb.add({"a": np.zeros((3, 2)), "b": np.zeros((3, 1))}, validate_args=True)
+
+    def test_sample_shape_and_validity(self):
+        rb = ReplayBuffer(8, 2)
+        rb.add(make_steps(5, 2))
+        s = rb.sample(10, n_samples=3)
+        assert s["observations"].shape == (3, 10, 1)
+        # all sampled values come from the filled region
+        assert set(np.unique(s["observations"])).issubset(set(range(10)))
+
+    def test_sample_errors(self):
+        rb = ReplayBuffer(8, 1)
+        with pytest.raises(ValueError):
+            rb.sample(1)
+        rb.add(make_steps(1, 1))
+        with pytest.raises(RuntimeError):
+            rb.sample(1, sample_next_obs=True)
+        with pytest.raises(ValueError):
+            rb.sample(0)
+
+    def test_sample_next_obs_consistency(self):
+        rb = ReplayBuffer(16, 1)
+        rb.add(make_steps(10, 1))
+        s = rb.sample(64, sample_next_obs=True)
+        np.testing.assert_array_equal(s["next_observations"], s["observations"] + 1)
+
+    def test_sample_next_obs_when_full_avoids_head(self):
+        rb = ReplayBuffer(4, 1)
+        rb.add(make_steps(6, 1))  # pos = 2, full
+        s = rb.sample(256, sample_next_obs=True)
+        # The transition at pos-1 (head) must never be sampled as current obs
+        head_value = np.asarray(rb["observations"]).reshape(-1)[(rb._pos - 1) % 4]
+        assert head_value not in s["observations"]
+
+    def test_getitem_setitem(self):
+        rb = ReplayBuffer(4, 2)
+        with pytest.raises(RuntimeError):
+            rb["observations"]
+        rb.add(make_steps(2, 2))
+        with pytest.raises(TypeError):
+            rb[0]
+        rb["new"] = np.ones((4, 2, 3), np.float32)
+        assert rb["new"].shape == (4, 2, 3)
+        with pytest.raises(RuntimeError):
+            rb["bad"] = np.ones((2, 2))
+
+    def test_memmap_roundtrip(self, tmp_path):
+        rb = ReplayBuffer(8, 2, memmap=True, memmap_dir=tmp_path / "buf")
+        rb.add(make_steps(5, 2))
+        assert rb.is_memmap
+        assert (tmp_path / "buf" / "observations.memmap").exists()
+        s = rb.sample(4)
+        assert s["observations"].shape == (1, 4, 1)
+
+    def test_sample_tensors_returns_jax(self):
+        import jax
+
+        rb = ReplayBuffer(8, 1)
+        rb.add(make_steps(4, 1))
+        s = rb.sample_tensors(3, device=jax.devices("cpu")[0], dtype=np.float32)
+        assert isinstance(s["observations"], jax.Array)
+
+
+class TestSequentialReplayBuffer:
+    def test_sequences_are_contiguous(self):
+        rb = SequentialReplayBuffer(32, 1)
+        rb.add(make_steps(20, 1))
+        s = rb.sample(6, sequence_length=5, n_samples=2)
+        obs = s["observations"]
+        assert obs.shape == (2, 5, 6, 1)
+        diffs = np.diff(obs[:, :, :, 0], axis=1)
+        assert (diffs == 1).all()
+
+    def test_full_buffer_sequences_avoid_head(self):
+        rb = SequentialReplayBuffer(8, 1)
+        rb.add(make_steps(12, 1))  # full, pos=4
+        s = rb.sample(128, sequence_length=3)
+        obs = s["observations"][0]  # [L, B, 1]
+        # valid data are values 4..11; check every sequence is increasing by 1
+        diffs = np.diff(obs[:, :, 0], axis=0)
+        assert (diffs == 1).all()
+        assert obs.min() >= 4
+
+    def test_too_long_sequence_errors(self):
+        rb = SequentialReplayBuffer(8, 1)
+        rb.add(make_steps(4, 1))
+        with pytest.raises(ValueError):
+            rb.sample(1, sequence_length=5)
+
+    def test_sequence_per_env(self):
+        rb = SequentialReplayBuffer(16, 4)
+        rb.add(make_steps(10, 4))
+        s = rb.sample(32, sequence_length=4)
+        obs = s["observations"][0]  # [L, B, 1]
+        # within a sequence the env stride (4) is constant
+        diffs = np.diff(obs[:, :, 0], axis=0)
+        assert (diffs == 4).all()
+
+
+class TestEnvIndependent:
+    def test_add_with_indices_and_sample(self):
+        rb = EnvIndependentReplayBuffer(16, n_envs=3, buffer_cls=SequentialReplayBuffer)
+        rb.add(make_steps(6, 2), indices=[0, 2])
+        rb.add(make_steps(6, 1), indices=[1])
+        s = rb.sample(8, sequence_length=3)
+        assert s["observations"].shape[2] == 8
+        with pytest.raises(ValueError):
+            rb.add(make_steps(4, 2), indices=[1])
+
+    def test_sample_before_add_raises(self):
+        rb = EnvIndependentReplayBuffer(8, n_envs=2)
+        with pytest.raises(Exception):
+            rb.sample(4)
+
+
+class TestEpisodeBuffer:
+    def _episode(self, length, value=0.0, end=True):
+        term = np.zeros((length, 1, 1), np.float32)
+        if end:
+            term[-1] = 1
+        return {
+            "observations": np.full((length, 1, 1), value, np.float32),
+            "terminated": term,
+            "truncated": np.zeros((length, 1, 1), np.float32),
+        }
+
+    def test_save_and_len(self):
+        eb = EpisodeBuffer(buffer_size=32, minimum_episode_length=2)
+        eb.add(self._episode(5, 1))
+        assert len(eb) == 5
+        eb.add(self._episode(4, 2))
+        assert len(eb) == 9
+        assert len(eb.buffer) == 2
+
+    def test_open_episode_accumulates(self):
+        eb = EpisodeBuffer(32, 2)
+        eb.add(self._episode(3, 1, end=False))
+        assert len(eb) == 0  # still open
+        eb.add(self._episode(2, 1, end=True))
+        assert len(eb) == 5
+
+    def test_eviction(self):
+        eb = EpisodeBuffer(buffer_size=10, minimum_episode_length=2)
+        eb.add(self._episode(5, 1))
+        eb.add(self._episode(5, 2))
+        eb.add(self._episode(4, 3))
+        # first episode evicted to fit the third
+        assert len(eb) <= 10
+        values = [float(np.asarray(ep["observations"]).ravel()[0]) for ep in eb.buffer]
+        assert 1.0 not in values
+
+    def test_short_episode_rejected(self):
+        eb = EpisodeBuffer(32, minimum_episode_length=4)
+        with pytest.raises(RuntimeError):
+            eb.add(self._episode(2, 1))
+
+    def test_sample_shapes_and_episode_bounds(self):
+        eb = EpisodeBuffer(64, 4)
+        eb.add(self._episode(10, 1))
+        eb.add(self._episode(8, 2))
+        s = eb.sample(6, sequence_length=4, n_samples=2)
+        assert s["observations"].shape == (2, 4, 6, 1)
+        # sequences never mix episodes: within a sequence all values equal
+        assert (np.diff(s["observations"][:, :, :, 0], axis=1) == 0).all()
+
+    def test_prioritize_ends_sampling(self):
+        eb = EpisodeBuffer(64, 4, prioritize_ends=True)
+        eb.add(self._episode(16, 1))
+        s = eb.sample(16, sequence_length=4)
+        assert s["observations"].shape == (1, 4, 16, 1)
+
+    def test_sample_no_valid_episode(self):
+        eb = EpisodeBuffer(32, 2)
+        eb.add(self._episode(3, 1))
+        with pytest.raises(RuntimeError):
+            eb.sample(2, sequence_length=8)
+
+    def test_memmap_episode(self, tmp_path):
+        eb = EpisodeBuffer(32, 2, memmap=True, memmap_dir=tmp_path / "ep")
+        eb.add(self._episode(6, 1))
+        assert eb.is_memmap
+        s = eb.sample(2, sequence_length=3)
+        assert s["observations"].shape == (1, 3, 2, 1)
+
+
+class TestMemmapArray:
+    def test_roundtrip_and_reopen(self, tmp_path):
+        arr = MemmapArray(tmp_path / "a.memmap", np.float32, (4, 3))
+        arr[:] = np.arange(12, dtype=np.float32).reshape(4, 3)
+        arr2 = MemmapArray(tmp_path / "a.memmap", np.float32, (4, 3))
+        np.testing.assert_array_equal(np.asarray(arr2), np.asarray(arr))
+
+    def test_pickle_loses_ownership(self, tmp_path):
+        arr = MemmapArray(tmp_path / "p.memmap", np.float32, (2, 2))
+        arr[:] = 7
+        clone = pickle.loads(pickle.dumps(arr))
+        assert not clone.has_ownership
+        np.testing.assert_array_equal(np.asarray(clone), 7)
+        del clone  # must not delete the file
+        assert (tmp_path / "p.memmap").exists()
+
+    def test_owner_deletes_file(self, tmp_path):
+        arr = MemmapArray(tmp_path / "d.memmap", np.float32, (2,))
+        filename = arr.filename
+        del arr
+        assert not filename.exists()
+
+    def test_from_array(self, tmp_path):
+        src = np.arange(6, dtype=np.int32).reshape(2, 3)
+        m = MemmapArray.from_array(src, tmp_path / "f.memmap")
+        np.testing.assert_array_equal(np.asarray(m), src)
+        assert m.dtype == np.int32
+
+    def test_ndarray_delegation(self, tmp_path):
+        m = MemmapArray(tmp_path / "g.memmap", np.float32, (4, 2))
+        assert m.ndim == 2
+        assert m.size == 8
+        assert len(m) == 4
