@@ -67,7 +67,10 @@ class Runtime:
             try:
                 jax.distributed.initialize()
             except RuntimeError as e:
-                if "already" not in str(e).lower():
+                # jax raises "distributed.initialize should only be called
+                # once"; older versions said "already initialized".
+                msg = str(e).lower()
+                if "already" not in msg and "once" not in msg:
                     raise
         self._mesh = mesh_lib.build_mesh(
             devices=self._select_devices(),

@@ -27,7 +27,7 @@ from typing import Any, Dict, List, Optional, Sequence, Type
 
 import numpy as np
 
-from sheeprl_tpu.data.memmap import MemmapArray
+from sheeprl_tpu.data.memmap import _VALID_MODES, MemmapArray
 
 def get_array(
     value: "np.ndarray | MemmapArray",
@@ -98,10 +98,8 @@ class ReplayBuffer:
         self._memmap_dir = Path(memmap_dir) if memmap_dir is not None else None
         self._memmap_mode = memmap_mode
         if self._memmap:
-            if memmap_mode not in ("r+", "w+", "c", "copyonwrite", "readwrite", "write"):
-                raise ValueError(
-                    "Accepted values for memmap_mode are 'r+', 'readwrite', 'w+', 'write', 'c' or 'copyonwrite'"
-                )
+            if memmap_mode not in _VALID_MODES:
+                raise ValueError(f"Accepted values for memmap_mode are {_VALID_MODES}, got '{memmap_mode}'")
             if self._memmap_dir is None:
                 raise ValueError(
                     "The buffer is set to be memory-mapped but 'memmap_dir' is None. Set it to a known directory."
@@ -169,8 +167,18 @@ class ReplayBuffer:
             data = {k: v[-self._buffer_size :] for k, v in data.items()}
             data_len = self._buffer_size
         idxes = np.arange(self._pos, self._pos + data_len) % self._buffer_size
+        # All keys must be declared by the first add: allocating a key later
+        # would leave np.empty garbage at every previously-written position,
+        # which sample() would then serve as real data. The reference fails
+        # loudly here too (KeyError at buffers.py:216).
+        has_keys = bool(self._buf)
         for k, v in data.items():
             if k not in self._buf:
+                if has_keys:
+                    raise KeyError(
+                        f"Key '{k}' was not present in the first add(); all keys must be added from the start "
+                        f"(existing keys: {sorted(self._buf)})"
+                    )
                 self._allocate(k, np.asarray(v))
             self._buf[k][idxes] = v
         if self._pos + data_len >= self._buffer_size:
@@ -262,6 +270,11 @@ class ReplayBuffer:
             )
         if self._memmap:
             filename = value.filename if isinstance(value, MemmapArray) else self._memmap_dir / f"{key}.memmap"
+            # The displaced entry may own the very file the replacement maps;
+            # revoke its ownership first or its __del__ unlinks the live file.
+            old = self._buf.get(key)
+            if isinstance(old, MemmapArray) and old.filename == Path(filename).absolute():
+                old.has_ownership = False
             self._buf[key] = MemmapArray.from_array(value, filename=filename, mode=self._memmap_mode)
         else:
             self._buf[key] = np.array(value, copy=True)
@@ -304,7 +317,17 @@ class SequentialReplayBuffer(ReplayBuffer):
             valid = np.concatenate([np.arange(0, max(first_end, 0)), np.arange(self._pos, second_end)]).astype(np.intp)
             starts = valid[self._rng.integers(0, len(valid), size=(batch_dim,), dtype=np.intp)]
         else:
-            starts = self._rng.integers(0, self._pos - sequence_length + 1, size=(batch_dim,), dtype=np.intp)
+            # With sample_next_obs the slot at _pos is read via time_idxes+1,
+            # but it has never been written on a non-full buffer: shrink the
+            # start range by one (improves on the reference, which emits
+            # uninitialized memory here).
+            max_start = self._pos - sequence_length + 1 - int(sample_next_obs)
+            if max_start <= 0:
+                raise RuntimeError(
+                    f"Cannot sample a sequence of length {sequence_length} "
+                    f"(sample_next_obs={sample_next_obs}) with only {self._pos} steps in the buffer"
+                )
+            starts = self._rng.integers(0, max_start, size=(batch_dim,), dtype=np.intp)
 
         offsets = np.arange(sequence_length, dtype=np.intp)[None, :]
         time_idxes = (starts[:, None] + offsets) % self._buffer_size  # [batch_dim, L]
@@ -558,7 +581,7 @@ class EpisodeBuffer:
             data = data.buffer
         if validate_args:
             _validate_add_data(data)
-            if "terminated" not in data and "truncated" not in data:
+            if "terminated" not in data or "truncated" not in data:
                 raise RuntimeError(
                     f"The episode must contain the 'terminated' and the 'truncated' keys, got: {list(data.keys())}"
                 )
@@ -611,9 +634,8 @@ class EpisodeBuffer:
             for ep in self._buf[:keep_from]:
                 if self._memmap:
                     dirname = os.path.dirname(next(iter(ep.values())).filename)
-                    for v in list(ep.values()):
+                    for v in ep.values():
                         v.has_ownership = False
-                        del v
                     ep.clear()
                     shutil.rmtree(dirname, ignore_errors=True)
             self._buf = self._buf[keep_from:]

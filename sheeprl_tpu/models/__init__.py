@@ -1,0 +1,25 @@
+from sheeprl_tpu.models.models import (
+    CNN,
+    DeCNN,
+    LayerNorm,
+    LayerNormChannelLast,
+    LayerNormGRUCell,
+    MLP,
+    MultiDecoder,
+    MultiEncoder,
+    NatureCNN,
+    get_activation,
+)
+
+__all__ = [
+    "CNN",
+    "DeCNN",
+    "LayerNorm",
+    "LayerNormChannelLast",
+    "LayerNormGRUCell",
+    "MLP",
+    "MultiDecoder",
+    "MultiEncoder",
+    "NatureCNN",
+    "get_activation",
+]

@@ -116,6 +116,28 @@ class TestReplayBuffer:
         s = rb.sample(4)
         assert s["observations"].shape == (1, 4, 1)
 
+    def test_setitem_over_memmap_key_keeps_backing_file(self, tmp_path):
+        """Regression: replacing a memmapped key with an ndarray must not let
+        the displaced owner unlink the backing file on GC."""
+        import gc
+
+        rb = ReplayBuffer(4, 1, memmap=True, memmap_dir=tmp_path / "buf")
+        rb.add({"a": np.ones((2, 1, 3), np.float32)})
+        rb["a"] = np.zeros((4, 1, 3), np.float32)
+        gc.collect()
+        assert (tmp_path / "buf" / "a.memmap").exists()
+        np.testing.assert_array_equal(np.asarray(rb["a"]), 0.0)
+
+    def test_late_key_introduction_raises(self):
+        """Keys added after the first add() would expose np.empty garbage at
+        earlier positions; must fail loudly instead."""
+        rb = ReplayBuffer(8, 1)
+        rb.add(make_steps(2, 1))
+        bad = make_steps(2, 1)
+        bad["extra"] = np.ones((2, 1, 1), np.float32)
+        with pytest.raises(KeyError, match="extra"):
+            rb.add(bad)
+
     def test_sample_tensors_returns_jax(self):
         import jax
 
@@ -150,6 +172,15 @@ class TestSequentialReplayBuffer:
         rb.add(make_steps(4, 1))
         with pytest.raises(ValueError):
             rb.sample(1, sequence_length=5)
+
+    def test_next_obs_nonfull_never_reads_unwritten_slot(self):
+        """Regression: with sample_next_obs on a non-full buffer, next_* must
+        stop one step before the write head (slot at _pos is unwritten)."""
+        rb = SequentialReplayBuffer(64, 1)
+        rb.add(make_steps(8, 1))  # pos=8; slot 8 is np.empty garbage
+        s = rb.sample(256, sequence_length=4, sample_next_obs=True)
+        nxt = s["next_observations"][0]  # [L, B, 1]
+        assert nxt.max() <= 7  # values are 0..7; garbage would exceed
 
     def test_sequence_per_env(self):
         rb = SequentialReplayBuffer(16, 4)

@@ -1,0 +1,248 @@
+"""Model library tests (parity targets: reference tests/test_models/*)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.models import (
+    CNN,
+    DeCNN,
+    LayerNorm,
+    LayerNormGRUCell,
+    MLP,
+    MultiDecoder,
+    MultiEncoder,
+    NatureCNN,
+)
+
+
+def init_apply(module, *args, **kwargs):
+    params = module.init(jax.random.PRNGKey(0), *args, **kwargs)
+    return params, module.apply(params, *args, **kwargs)
+
+
+class TestMLP:
+    def test_shapes(self):
+        x = jnp.ones((7, 10))
+        _, out = init_apply(MLP(hidden_sizes=(32, 16), output_dim=4), x)
+        assert out.shape == (7, 4)
+
+    def test_no_output_head(self):
+        x = jnp.ones((7, 10))
+        _, out = init_apply(MLP(hidden_sizes=(32, 16)), x)
+        assert out.shape == (7, 16)
+
+    def test_no_layers_raises(self):
+        with pytest.raises(ValueError, match="at least 1"):
+            init_apply(MLP(hidden_sizes=()), jnp.ones((1, 3)))
+
+    def test_flatten_dim(self):
+        x = jnp.ones((5, 4, 3))
+        _, out = init_apply(MLP(hidden_sizes=(8,), flatten_dim=1), x)
+        assert out.shape == (5, 8)
+
+    def test_flatten_dim_negative(self):
+        x = jnp.ones((5, 2, 4, 3))
+        _, out = init_apply(MLP(hidden_sizes=(8,), flatten_dim=-2), x)
+        assert out.shape == (5, 2, 8)
+
+    def test_per_layer_specs(self):
+        x = jnp.ones((3, 10))
+        mlp = MLP(
+            hidden_sizes=(16, 8),
+            activation=["relu", "tanh"],
+            norm_layer=[None, "layer_norm"],
+            norm_args=[None, {"epsilon": 1e-3}],
+        )
+        _, out = init_apply(mlp, x)
+        assert out.shape == (3, 8)
+
+    def test_per_layer_mismatch_raises(self):
+        with pytest.raises(ValueError, match="activation"):
+            init_apply(MLP(hidden_sizes=(16, 8), activation=["relu"]), jnp.ones((1, 4)))
+
+    def test_dropout_deterministic_default(self):
+        x = jnp.ones((3, 10))
+        mlp = MLP(hidden_sizes=(16,), dropout=0.5)
+        params = mlp.init(jax.random.PRNGKey(0), x)
+        a = mlp.apply(params, x)
+        b = mlp.apply(params, x)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_dropout_stochastic(self):
+        x = jnp.ones((3, 32))
+        mlp = MLP(hidden_sizes=(64,), dropout=0.5)
+        params = mlp.init(jax.random.PRNGKey(0), x)
+        a = mlp.apply(params, x, deterministic=False, rngs={"dropout": jax.random.PRNGKey(1)})
+        b = mlp.apply(params, x, deterministic=False, rngs={"dropout": jax.random.PRNGKey(2)})
+        assert not np.allclose(np.asarray(a), np.asarray(b))
+
+
+class TestCNN:
+    def test_shapes_and_padding(self):
+        # NHWC; torch-style symmetric int padding
+        x = jnp.ones((2, 8, 8, 3))
+        _, out = init_apply(
+            CNN(hidden_channels=(4, 8), layer_args={"kernel_size": 3, "padding": 1}), x
+        )
+        assert out.shape == (2, 8, 8, 8)
+
+    def test_stride(self):
+        x = jnp.ones((2, 8, 8, 3))
+        _, out = init_apply(
+            CNN(hidden_channels=(4,), layer_args={"kernel_size": 4, "stride": 2, "padding": 1}), x
+        )
+        assert out.shape == (2, 4, 4, 4)
+
+    def test_empty_raises(self):
+        with pytest.raises(ValueError, match="at least 1"):
+            init_apply(CNN(hidden_channels=()), jnp.ones((1, 4, 4, 3)))
+
+
+class TestDeCNN:
+    @pytest.mark.parametrize(
+        "size,kernel,stride,pad,out_pad",
+        [(1, 5, 2, 0, 0), (5, 4, 2, 1, 0), (4, 3, 2, 1, 1)],
+    )
+    def test_torch_output_size_formula(self, size, kernel, stride, pad, out_pad):
+        # torch: out = (in-1)*stride - 2*pad + kernel + output_padding
+        expected = (size - 1) * stride - 2 * pad + kernel + out_pad
+        x = jnp.ones((2, size, size, 8))
+        _, out = init_apply(
+            DeCNN(
+                hidden_channels=(4,),
+                layer_args={
+                    "kernel_size": kernel,
+                    "stride": stride,
+                    "padding": pad,
+                    "output_padding": out_pad,
+                },
+            ),
+            x,
+        )
+        assert out.shape == (2, expected, expected, 4)
+
+
+class TestNatureCNN:
+    def test_64x64(self):
+        x = jnp.ones((3, 64, 64, 4))
+        _, out = init_apply(NatureCNN(features_dim=512), x)
+        assert out.shape == (3, 512)
+        assert np.all(np.asarray(out) >= 0)  # final ReLU
+
+
+class TestLayerNormGRUCell:
+    def test_formula_golden(self):
+        """Pin the Hafner GRU semantics against a hand-computed numpy oracle
+        (formula spec: sheeprl/models/models.py:396-403)."""
+        hidden, inp, batch = 6, 4, 3
+        cell = LayerNormGRUCell(hidden_size=hidden, layer_norm=False)
+        rng = np.random.RandomState(0)
+        h = jnp.asarray(rng.randn(batch, hidden), jnp.float32)
+        x = jnp.asarray(rng.randn(batch, inp), jnp.float32)
+        params = cell.init(jax.random.PRNGKey(0), h, x)
+        out = np.asarray(cell.apply(params, h, x))
+
+        W = np.asarray(params["params"]["linear"]["kernel"])  # [hidden+inp, 3*hidden]
+        b = np.asarray(params["params"]["linear"]["bias"])
+        z = np.concatenate([np.asarray(h), np.asarray(x)], -1) @ W + b
+        reset, cand, update = np.split(z, 3, -1)
+        sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+        reset = sig(reset)
+        cand = np.tanh(reset * cand)
+        update = sig(update - 1.0)
+        expected = update * cand + (1 - update) * np.asarray(h)
+        np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-5)
+
+    def test_with_layer_norm_shape(self):
+        cell = LayerNormGRUCell(hidden_size=8)
+        h = jnp.zeros((2, 8))
+        x = jnp.ones((2, 5))
+        params = cell.init(jax.random.PRNGKey(0), h, x)
+        out = cell.apply(params, h, x)
+        assert out.shape == (2, 8)
+
+    def test_scan_over_time(self):
+        """The cell must compose with lax.scan (the RSSM usage pattern)."""
+        cell = LayerNormGRUCell(hidden_size=8)
+        h0 = jnp.zeros((2, 8))
+        xs = jnp.ones((10, 2, 5))
+        params = cell.init(jax.random.PRNGKey(0), h0, xs[0])
+
+        def step(h, x):
+            h = cell.apply(params, h, x)
+            return h, h
+
+        hT, hs = jax.lax.scan(step, h0, xs)
+        assert hT.shape == (2, 8)
+        assert hs.shape == (10, 2, 8)
+
+
+class TestLayerNorm:
+    def test_dtype_preserved_bf16(self):
+        x = jnp.ones((4, 16), jnp.bfloat16)
+        ln = LayerNorm()
+        params = ln.init(jax.random.PRNGKey(0), x)
+        out = ln.apply(params, x)
+        assert out.dtype == jnp.bfloat16
+
+    def test_normalizes(self):
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(4, 16) * 5 + 3, jnp.float32)
+        ln = LayerNorm()
+        params = ln.init(jax.random.PRNGKey(0), x)
+        out = np.asarray(ln.apply(params, x))
+        np.testing.assert_allclose(out.mean(-1), 0.0, atol=1e-5)
+        np.testing.assert_allclose(out.std(-1), 1.0, atol=1e-2)
+
+
+class TestMultiEncoderDecoder:
+    def test_multi_encoder_concat(self):
+        from flax import linen as nn
+
+        class PixEnc(nn.Module):
+            @nn.compact
+            def __call__(self, obs):
+                x = obs["rgb"].reshape(*obs["rgb"].shape[:-3], -1)
+                return nn.Dense(6)(x)
+
+        class VecEnc(nn.Module):
+            @nn.compact
+            def __call__(self, obs):
+                return nn.Dense(4)(obs["state"])
+
+        enc = MultiEncoder(cnn_encoder=PixEnc(), mlp_encoder=VecEnc())
+        obs = {"rgb": jnp.ones((2, 4, 4, 3)), "state": jnp.ones((2, 5))}
+        params = enc.init(jax.random.PRNGKey(0), obs)
+        out = enc.apply(params, obs)
+        assert out.shape == (2, 10)
+
+    def test_multi_encoder_requires_one(self):
+        with pytest.raises(ValueError, match="at least one encoder"):
+            MultiEncoder()
+
+    def test_multi_decoder_merges(self):
+        from flax import linen as nn
+
+        class PixDec(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return {"rgb": nn.Dense(12)(x)}
+
+        class VecDec(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return {"state": nn.Dense(5)(x)}
+
+        dec = MultiDecoder(cnn_decoder=PixDec(), mlp_decoder=VecDec())
+        x = jnp.ones((2, 8))
+        params = dec.init(jax.random.PRNGKey(0), x)
+        out = dec.apply(params, x)
+        assert set(out) == {"rgb", "state"}
+        assert out["rgb"].shape == (2, 12)
+        assert out["state"].shape == (2, 5)
+
+    def test_multi_decoder_requires_one(self):
+        with pytest.raises(ValueError, match="both cnn and mlp decoders"):
+            MultiDecoder()
