@@ -1,0 +1,450 @@
+"""Distributions as lightweight, jit-safe value classes (pure JAX).
+
+Formula parity with the reference distribution library
+(sheeprl/utils/distribution.py:25-416), without torch.distributions or any
+external dependency: each class is a thin container of arrays built *inside*
+traced functions, so construction is free under jit and all math fuses into
+the surrounding graph. Sampling takes an explicit PRNG key (JAX style); in
+JAX every sample through reparameterized math is an "rsample", and the
+straight-through estimator is expressed with `stop_gradient`.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import erf, erfinv
+
+from sheeprl_tpu.utils.ops import symexp, symlog
+
+CONST_SQRT_2 = math.sqrt(2)
+CONST_INV_SQRT_2PI = 1 / math.sqrt(2 * math.pi)
+CONST_INV_SQRT_2 = 1 / math.sqrt(2)
+CONST_LOG_INV_SQRT_2PI = math.log(CONST_INV_SQRT_2PI)
+CONST_LOG_SQRT_2PI_E = 0.5 * math.log(2 * math.pi * math.e)
+
+
+# ------------------------------------------------------------------ normal
+class Normal:
+    """Diagonal normal. log_prob/entropy per element; wrap in Independent to
+    sum event dims."""
+
+    def __init__(self, loc: jax.Array, scale: jax.Array):
+        self.loc = loc
+        self.scale = scale
+
+    @property
+    def mean(self) -> jax.Array:
+        return self.loc
+
+    @property
+    def mode(self) -> jax.Array:
+        return self.loc
+
+    @property
+    def stddev(self) -> jax.Array:
+        return self.scale
+
+    def sample(self, key: jax.Array, sample_shape: Tuple[int, ...] = ()) -> jax.Array:
+        shape = tuple(sample_shape) + jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        eps = jax.random.normal(key, shape, dtype=self.loc.dtype)
+        return self.loc + self.scale * eps
+
+    rsample = sample
+
+    def log_prob(self, value: jax.Array) -> jax.Array:
+        var = self.scale**2
+        return -((value - self.loc) ** 2) / (2 * var) - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi)
+
+    def entropy(self) -> jax.Array:
+        return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+
+
+class Independent:
+    """Reinterpret the last `reinterpreted_batch_ndims` batch dims as event
+    dims: log_prob/entropy sum over them (torch.distributions.Independent)."""
+
+    def __init__(self, base, reinterpreted_batch_ndims: int = 1):
+        self.base = base
+        self.ndims = reinterpreted_batch_ndims
+
+    def _reduce(self, x: jax.Array) -> jax.Array:
+        return x.sum(axis=tuple(range(-self.ndims, 0))) if self.ndims else x
+
+    @property
+    def mean(self) -> jax.Array:
+        return self.base.mean
+
+    @property
+    def mode(self) -> jax.Array:
+        return self.base.mode
+
+    def sample(self, key: jax.Array, sample_shape: Tuple[int, ...] = ()) -> jax.Array:
+        return self.base.sample(key, sample_shape)
+
+    def rsample(self, key: jax.Array, sample_shape: Tuple[int, ...] = ()) -> jax.Array:
+        return self.base.rsample(key, sample_shape)
+
+    def log_prob(self, value: jax.Array) -> jax.Array:
+        return self._reduce(self.base.log_prob(value))
+
+    def entropy(self) -> jax.Array:
+        return self._reduce(self.base.entropy())
+
+
+# ------------------------------------------------------- truncated normal
+class TruncatedStandardNormal:
+    """Truncated standard normal on [a, b]
+    (reference: sheeprl/utils/distribution.py:25-113, from torch_truncnorm)."""
+
+    def __init__(self, a: jax.Array, b: jax.Array):
+        self.a, self.b = jnp.broadcast_arrays(jnp.asarray(a), jnp.asarray(b))
+        eps = jnp.finfo(self.a.dtype).eps
+        self._dtype_min_gt_0 = eps
+        self._dtype_max_lt_1 = 1 - eps
+        self._little_phi_a = self._little_phi(self.a)
+        self._little_phi_b = self._little_phi(self.b)
+        self._big_phi_a = self._big_phi(self.a)
+        self._big_phi_b = self._big_phi(self.b)
+        self._Z = jnp.clip(self._big_phi_b - self._big_phi_a, eps, None)
+        self._log_Z = jnp.log(self._Z)
+        lpc_a = jnp.nan_to_num(self.a, nan=math.nan)
+        lpc_b = jnp.nan_to_num(self.b, nan=math.nan)
+        self._lpbb_m_lpaa_d_Z = (self._little_phi_b * lpc_b - self._little_phi_a * lpc_a) / self._Z
+        self._mean = -(self._little_phi_b - self._little_phi_a) / self._Z
+        self._variance = (
+            1 - self._lpbb_m_lpaa_d_Z - ((self._little_phi_b - self._little_phi_a) / self._Z) ** 2
+        )
+        self._entropy = CONST_LOG_SQRT_2PI_E + self._log_Z - 0.5 * self._lpbb_m_lpaa_d_Z
+
+    @property
+    def mean(self) -> jax.Array:
+        return self._mean
+
+    @property
+    def variance(self) -> jax.Array:
+        return self._variance
+
+    @staticmethod
+    def _little_phi(x: jax.Array) -> jax.Array:
+        return jnp.exp(-(x**2) * 0.5) * CONST_INV_SQRT_2PI
+
+    @staticmethod
+    def _big_phi(x: jax.Array) -> jax.Array:
+        return 0.5 * (1 + erf(x * CONST_INV_SQRT_2))
+
+    @staticmethod
+    def _inv_big_phi(x: jax.Array) -> jax.Array:
+        return CONST_SQRT_2 * erfinv(2 * x - 1)
+
+    def cdf(self, value: jax.Array) -> jax.Array:
+        return jnp.clip((self._big_phi(value) - self._big_phi_a) / self._Z, 0, 1)
+
+    def icdf(self, value: jax.Array) -> jax.Array:
+        return self._inv_big_phi(self._big_phi_a + value * self._Z)
+
+    def log_prob(self, value: jax.Array) -> jax.Array:
+        return CONST_LOG_INV_SQRT_2PI - self._log_Z - (value**2) * 0.5
+
+    def sample(self, key: jax.Array, sample_shape: Tuple[int, ...] = ()) -> jax.Array:
+        shape = tuple(sample_shape) + self.a.shape
+        p = jax.random.uniform(
+            key, shape, dtype=self.a.dtype, minval=self._dtype_min_gt_0, maxval=self._dtype_max_lt_1
+        )
+        return self.icdf(p)
+
+    rsample = sample
+
+    def entropy(self) -> jax.Array:
+        return self._entropy
+
+
+class TruncatedNormal(TruncatedStandardNormal):
+    """Truncated normal on [a, b] with location/scale
+    (reference: sheeprl/utils/distribution.py:116-147)."""
+
+    def __init__(self, loc: jax.Array, scale: jax.Array, a: jax.Array, b: jax.Array):
+        self.loc, self.scale, a, b = jnp.broadcast_arrays(
+            jnp.asarray(loc), jnp.asarray(scale), jnp.asarray(a), jnp.asarray(b)
+        )
+        super().__init__((a - self.loc) / self.scale, (b - self.loc) / self.scale)
+        self._log_scale = jnp.log(self.scale)
+        self._mean = self._mean * self.scale + self.loc
+        self._variance = self._variance * self.scale**2
+        self._entropy = self._entropy + self._log_scale
+
+    def _to_std_rv(self, value: jax.Array) -> jax.Array:
+        return (value - self.loc) / self.scale
+
+    def _from_std_rv(self, value: jax.Array) -> jax.Array:
+        return value * self.scale + self.loc
+
+    def cdf(self, value: jax.Array) -> jax.Array:
+        return super().cdf(self._to_std_rv(value))
+
+    def icdf(self, value: jax.Array) -> jax.Array:
+        return self._from_std_rv(super().icdf(value))
+
+    def log_prob(self, value: jax.Array) -> jax.Array:
+        return super().log_prob(self._to_std_rv(value)) - self._log_scale
+
+
+# --------------------------------------------------- symlog/mse "losses"
+class SymlogDistribution:
+    """MSE/abs distance in symlog space posing as a distribution
+    (reference: sheeprl/utils/distribution.py:152-193; danijar jaxutils)."""
+
+    def __init__(
+        self,
+        mode: jax.Array,
+        dims: int,
+        dist: str = "mse",
+        agg: str = "sum",
+        tol: float = 1e-8,
+    ):
+        self._mode = mode
+        # dims=0 reduces ALL axes: torch's sum(dim=()) collapses everything,
+        # and the reference relies on that default (distribution.py:162).
+        self._dims = tuple(-x for x in range(1, dims + 1)) if dims else None
+        self._dist = dist
+        self._agg = agg
+        self._tol = tol
+
+    @property
+    def mode(self) -> jax.Array:
+        return symexp(self._mode)
+
+    @property
+    def mean(self) -> jax.Array:
+        return symexp(self._mode)
+
+    def log_prob(self, value: jax.Array) -> jax.Array:
+        assert self._mode.shape == value.shape, (self._mode.shape, value.shape)
+        if self._dist == "mse":
+            distance = (self._mode - symlog(value)) ** 2
+        elif self._dist == "abs":
+            distance = jnp.abs(self._mode - symlog(value))
+        else:
+            raise NotImplementedError(self._dist)
+        distance = jnp.where(distance < self._tol, 0, distance)
+        if self._agg == "mean":
+            loss = distance.mean(self._dims)
+        elif self._agg == "sum":
+            loss = distance.sum(self._dims)
+        else:
+            raise NotImplementedError(self._agg)
+        return -loss
+
+
+class MSEDistribution:
+    """Plain MSE posing as a distribution
+    (reference: sheeprl/utils/distribution.py:196-221)."""
+
+    def __init__(self, mode: jax.Array, dims: int, agg: str = "sum"):
+        self._mode = mode
+        self._dims = tuple(-x for x in range(1, dims + 1)) if dims else None
+        self._agg = agg
+
+    @property
+    def mode(self) -> jax.Array:
+        return self._mode
+
+    @property
+    def mean(self) -> jax.Array:
+        return self._mode
+
+    def log_prob(self, value: jax.Array) -> jax.Array:
+        assert self._mode.shape == value.shape, (self._mode.shape, value.shape)
+        distance = (self._mode - value) ** 2
+        if self._agg == "mean":
+            loss = distance.mean(self._dims)
+        elif self._agg == "sum":
+            loss = distance.sum(self._dims)
+        else:
+            raise NotImplementedError(self._agg)
+        return -loss
+
+
+# ------------------------------------------------------- two-hot critic
+class TwoHotEncodingDistribution:
+    """Two-hot categorical over symlog-spaced bins; DV3 reward/critic heads
+    (reference: sheeprl/utils/distribution.py:224-276)."""
+
+    def __init__(
+        self,
+        logits: jax.Array,
+        dims: int = 0,
+        low: int = -20,
+        high: int = 20,
+        transfwd: Callable[[jax.Array], jax.Array] = symlog,
+        transbwd: Callable[[jax.Array], jax.Array] = symexp,
+    ):
+        self.logits = logits
+        self.probs = jax.nn.softmax(logits, axis=-1)
+        self.dims = tuple(-x for x in range(1, dims + 1)) if dims else None
+        self.bins = jnp.linspace(low, high, logits.shape[-1], dtype=logits.dtype)
+        self.low = low
+        self.high = high
+        self.transfwd = transfwd
+        self.transbwd = transbwd
+
+    @property
+    def mean(self) -> jax.Array:
+        return self.transbwd((self.probs * self.bins).sum(axis=self.dims, keepdims=True))
+
+    @property
+    def mode(self) -> jax.Array:
+        return self.transbwd((self.probs * self.bins).sum(axis=self.dims, keepdims=True))
+
+    def log_prob(self, x: jax.Array) -> jax.Array:
+        x = self.transfwd(x)
+        nbins = self.bins.shape[0]
+        below = (self.bins <= x).astype(jnp.int32).sum(axis=-1, keepdims=True) - 1
+        above = jnp.minimum(below + 1, nbins - 1)
+        below = jnp.maximum(below, 0)
+
+        equal = below == above
+        dist_to_below = jnp.where(equal, 1, jnp.abs(self.bins[below] - x))
+        dist_to_above = jnp.where(equal, 1, jnp.abs(self.bins[above] - x))
+        total = dist_to_below + dist_to_above
+        weight_below = dist_to_above / total
+        weight_above = dist_to_below / total
+        target = (
+            jax.nn.one_hot(below, nbins, dtype=x.dtype) * weight_below[..., None]
+            + jax.nn.one_hot(above, nbins, dtype=x.dtype) * weight_above[..., None]
+        ).squeeze(-2)
+        log_pred = self.logits - jax.scipy.special.logsumexp(self.logits, axis=-1, keepdims=True)
+        return (target * log_pred).sum(axis=self.dims)
+
+
+# ----------------------------------------------------- one-hot categorical
+class OneHotCategorical:
+    """One-hot categorical over the last axis
+    (reference: OneHotCategoricalValidateArgs, distribution.py:281-384)."""
+
+    def __init__(self, logits: Optional[jax.Array] = None, probs: Optional[jax.Array] = None):
+        if (logits is None) == (probs is None):
+            raise ValueError("Exactly one of `logits` or `probs` must be specified")
+        if logits is None:
+            probs = probs / probs.sum(axis=-1, keepdims=True)
+            eps = jnp.finfo(probs.dtype).tiny
+            logits = jnp.log(jnp.clip(probs, eps, None))
+        self.logits = logits - jax.scipy.special.logsumexp(logits, axis=-1, keepdims=True)
+
+    @property
+    def probs(self) -> jax.Array:
+        return jax.nn.softmax(self.logits, axis=-1)
+
+    @property
+    def mean(self) -> jax.Array:
+        return self.probs
+
+    @property
+    def mode(self) -> jax.Array:
+        p = self.probs
+        return jax.nn.one_hot(jnp.argmax(p, axis=-1), p.shape[-1], dtype=p.dtype)
+
+    @property
+    def variance(self) -> jax.Array:
+        p = self.probs
+        return p * (1 - p)
+
+    def sample(self, key: jax.Array, sample_shape: Tuple[int, ...] = ()) -> jax.Array:
+        idx = jax.random.categorical(key, self.logits, shape=tuple(sample_shape) + self.logits.shape[:-1])
+        return jax.nn.one_hot(idx, self.logits.shape[-1], dtype=self.logits.dtype)
+
+    def log_prob(self, value: jax.Array) -> jax.Array:
+        return (value * self.logits).sum(axis=-1)
+
+    def entropy(self) -> jax.Array:
+        p = self.probs
+        plogp = jnp.where(p > 0, p * self.logits, 0.0)
+        return -plogp.sum(axis=-1)
+
+
+class OneHotCategoricalStraightThrough(OneHotCategorical):
+    """Straight-through gradient sampling: forward a hard one-hot, backward
+    the probs gradient (reference: distribution.py:387-401)."""
+
+    def rsample(self, key: jax.Array, sample_shape: Tuple[int, ...] = ()) -> jax.Array:
+        samples = self.sample(key, sample_shape)
+        probs = self.probs
+        return samples + (probs - jax.lax.stop_gradient(probs))
+
+
+# --------------------------------------------------------------- bernoulli
+class BernoulliSafeMode:
+    """Bernoulli whose mode is p > 0.5 (reference: distribution.py:409-416;
+    used by the Dreamer continue head)."""
+
+    def __init__(self, logits: Optional[jax.Array] = None, probs: Optional[jax.Array] = None):
+        if (logits is None) == (probs is None):
+            raise ValueError("Exactly one of `logits` or `probs` must be specified")
+        if logits is None:
+            eps = jnp.finfo(probs.dtype).tiny
+            logits = jnp.log(jnp.clip(probs, eps, None)) - jnp.log(jnp.clip(1 - probs, eps, None))
+        self.logits = logits
+
+    @property
+    def probs(self) -> jax.Array:
+        return jax.nn.sigmoid(self.logits)
+
+    @property
+    def mean(self) -> jax.Array:
+        return self.probs
+
+    @property
+    def mode(self) -> jax.Array:
+        p = self.probs
+        return (p > 0.5).astype(p.dtype)
+
+    def sample(self, key: jax.Array, sample_shape: Tuple[int, ...] = ()) -> jax.Array:
+        p = self.probs
+        u = jax.random.uniform(key, tuple(sample_shape) + p.shape, dtype=p.dtype)
+        return (u < p).astype(p.dtype)
+
+    def log_prob(self, value: jax.Array) -> jax.Array:
+        # -BCEWithLogits: value*logsigmoid(l) + (1-value)*logsigmoid(-l)
+        return value * jax.nn.log_sigmoid(self.logits) + (1 - value) * jax.nn.log_sigmoid(-self.logits)
+
+    def entropy(self) -> jax.Array:
+        p = self.probs
+        return -(
+            jnp.where(p > 0, p * jax.nn.log_sigmoid(self.logits), 0.0)
+            + jnp.where(p < 1, (1 - p) * jax.nn.log_sigmoid(-self.logits), 0.0)
+        )
+
+
+# --------------------------------------------------------------------- kl
+def kl_divergence(p, q) -> jax.Array:
+    """KL(p||q) for the pairs the algorithms need (reference registers
+    cat-cat at distribution.py:404-406; normal-normal via torch)."""
+    if isinstance(p, Independent) and isinstance(q, Independent):
+        if p.ndims != q.ndims:
+            raise ValueError("Independent KL requires matching event ndims")
+        return p._reduce(kl_divergence(p.base, q.base))
+    if isinstance(p, OneHotCategorical) and isinstance(q, OneHotCategorical):
+        probs = p.probs
+        plogp_m_logq = jnp.where(probs > 0, probs * (p.logits - q.logits), 0.0)
+        return plogp_m_logq.sum(axis=-1)
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        var_ratio = (p.scale / q.scale) ** 2
+        t1 = ((p.loc - q.loc) / q.scale) ** 2
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+    raise NotImplementedError(f"KL not implemented for {type(p).__name__} || {type(q).__name__}")
+
+
+# ----------------------------------------------------------------- unimix
+def uniform_mix(logits: jax.Array, unimix: float) -> jax.Array:
+    """Mix `unimix` of a uniform into the categorical over the last axis and
+    return the new logits (reference: DreamerV3 RSSM._uniform_mix,
+    sheeprl/algos/dreamer_v3/agent.py:437-449; 1% by default)."""
+    if unimix > 0.0:
+        probs = jax.nn.softmax(logits, axis=-1)
+        uniform = jnp.ones_like(probs) / probs.shape[-1]
+        probs = (1 - unimix) * probs + unimix * uniform
+        logits = jnp.log(probs)
+    return logits

@@ -1,0 +1,178 @@
+"""Traced math ops shared across algorithms (pure JAX, jit-safe).
+
+Formula parity with the reference's tensor utilities, restructured for XLA:
+the reference's reverse Python loops (GAE at sheeprl/utils/utils.py:63-100,
+λ-values at sheeprl/algos/dreamer_v3/utils.py:66-77) become `lax.scan` over
+the time axis — traced once, fused by XLA, no per-step dispatch. Everything
+here is shape-polymorphic over leading batch dims and safe under `jit`/`pjit`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+# ---------------------------------------------------------------- symlog
+def symlog(x: jax.Array) -> jax.Array:
+    """sign(x) * log(1 + |x|) (reference: sheeprl/utils/utils.py:148-149)."""
+    return jnp.sign(x) * jnp.log1p(jnp.abs(x))
+
+
+def symexp(x: jax.Array) -> jax.Array:
+    """sign(x) * (exp(|x|) - 1) (reference: sheeprl/utils/utils.py:152-153)."""
+    return jnp.sign(x) * jnp.expm1(jnp.abs(x))
+
+
+# --------------------------------------------------------------- two-hot
+def two_hot_encoder(x: jax.Array, support_range: int = 300, num_buckets: Optional[int] = None) -> jax.Array:
+    """Encode scalars (..., 1) as two-hot vectors (..., num_buckets) over a
+    symmetric integer support (reference: sheeprl/utils/utils.py:156-190;
+    DreamerV3 paper eq. 9).
+    """
+    if x.ndim == 0:
+        x = x[None]
+    if num_buckets is None:
+        num_buckets = support_range * 2 + 1
+    if num_buckets % 2 == 0:
+        raise ValueError("support_size must be odd")
+    x = jnp.clip(x, -support_range, support_range)
+    buckets = jnp.linspace(-support_range, support_range, num_buckets, dtype=x.dtype)
+    bucket_size = buckets[1] - buckets[0] if num_buckets > 1 else jnp.asarray(1.0, x.dtype)
+
+    # torch.bucketize(right=False) == searchsorted(side='left')
+    right = jnp.searchsorted(buckets, x, side="left")
+    left = jnp.clip(right - 1, 0, None)
+
+    left_value = jnp.abs(buckets[right] - x) / bucket_size
+    right_value = 1.0 - left_value
+    lhot = jax.nn.one_hot(left[..., 0], num_buckets, dtype=x.dtype) * left_value
+    rhot = jax.nn.one_hot(right[..., 0], num_buckets, dtype=x.dtype) * right_value
+    return lhot + rhot
+
+
+def two_hot_decoder(x: jax.Array, support_range: int) -> jax.Array:
+    """Decode two-hot vectors (..., num_buckets) back to scalars (..., 1)
+    (reference: sheeprl/utils/utils.py:193-205)."""
+    num_buckets = x.shape[-1]
+    if num_buckets % 2 == 0:
+        raise ValueError("support_size must be odd")
+    support = jnp.linspace(-support_range, support_range, num_buckets, dtype=x.dtype)
+    return jnp.sum(x * support, axis=-1, keepdims=True)
+
+
+# ------------------------------------------------------------------- gae
+def gae(
+    rewards: jax.Array,
+    values: jax.Array,
+    dones: jax.Array,
+    next_value: jax.Array,
+    gamma: float,
+    gae_lambda: float,
+) -> Tuple[jax.Array, jax.Array]:
+    """Generalized advantage estimation over [T, ...] arrays.
+
+    Semantics match the reference loop (sheeprl/utils/utils.py:63-100):
+    delta[t] = r[t] + gamma * not_done[t] * V[t+1] - V[t] with V[T] =
+    next_value, and adv[t] = delta[t] + gamma * lambda * not_done[t] *
+    adv[t+1] — here as one reverse `lax.scan`. Returns (returns, advantages).
+    """
+    not_dones = (1.0 - dones).astype(values.dtype)
+    next_values = jnp.concatenate([values[1:], next_value[None]], axis=0)
+    deltas = rewards + gamma * not_dones * next_values - values
+
+    def step(carry, x):
+        delta, nd = x
+        carry = delta + gamma * gae_lambda * nd * carry
+        return carry, carry
+
+    _, adv = jax.lax.scan(step, jnp.zeros_like(deltas[0]), (deltas, not_dones), reverse=True)
+    return adv + values, adv
+
+
+# ---------------------------------------------------------- lambda values
+def compute_lambda_values(
+    rewards: jax.Array,
+    values: jax.Array,
+    continues: jax.Array,
+    lmbda: float = 0.95,
+) -> jax.Array:
+    """TD(λ) targets for imagined trajectories, [T, ...] → [T, ...].
+
+    Reference reverse loop: sheeprl/algos/dreamer_v3/utils.py:66-77 —
+    L[t] = r[t] + c[t] * ((1 - λ) * V[t] + λ * L[t+1]), seeded L[T] = V[T-1].
+    """
+    interm = rewards + continues * values * (1 - lmbda)
+
+    def step(nxt, x):
+        i, c = x
+        v = i + c * lmbda * nxt
+        return v, v
+
+    _, out = jax.lax.scan(step, values[-1], (interm, continues), reverse=True)
+    return out
+
+
+# -------------------------------------------------------------- normalize
+def normalize_tensor(x: jax.Array, eps: float = 1e-8, mask: Optional[jax.Array] = None) -> jax.Array:
+    """(x - mean) / (std + eps), optionally over a boolean mask
+    (reference: sheeprl/utils/utils.py:121-130). With a mask, statistics are
+    computed over selected elements only; masked-out entries are returned
+    normalized with those statistics (shape is preserved — under jit we cannot
+    return a ragged selection like the reference does).
+    """
+    if mask is None:
+        std = jnp.std(x, ddof=1) if x.size > 1 else jnp.asarray(0.0, x.dtype)
+        return (x - jnp.mean(x)) / (std + eps)
+    n = jnp.maximum(mask.sum(), 1)
+    mean = jnp.where(mask, x, 0).sum() / n
+    var = (jnp.where(mask, x - mean, 0) ** 2).sum() / jnp.maximum(n - 1, 1)
+    return (x - mean) / (jnp.sqrt(var) + eps)
+
+
+# ------------------------------------------------------------ safe atanh
+def safetanh(x: jax.Array, eps: float) -> jax.Array:
+    """tanh clamped away from ±1 (reference: sheeprl/utils/utils.py:304-308)."""
+    lim = 1.0 - eps
+    return jnp.clip(jnp.tanh(x), -lim, lim)
+
+
+def safeatanh(y: jax.Array, eps: float) -> jax.Array:
+    """atanh of input clamped away from ±1 (reference: utils.py:311-313)."""
+    lim = 1.0 - eps
+    return jnp.arctanh(jnp.clip(y, -lim, lim))
+
+
+# ---------------------------------------------------------------- moments
+def init_moments() -> dict:
+    """Initial state for the EMA return-range tracker (reference `Moments`
+    buffers, sheeprl/algos/dreamer_v3/utils.py:40-56)."""
+    return {"low": jnp.zeros((), jnp.float32), "high": jnp.zeros((), jnp.float32)}
+
+
+def update_moments(
+    state: dict,
+    x: jax.Array,
+    decay: float = 0.99,
+    max_: float = 1e8,
+    percentile_low: float = 0.05,
+    percentile_high: float = 0.95,
+) -> Tuple[dict, Tuple[jax.Array, jax.Array]]:
+    """EMA 5/95-percentile return normalizer (reference: Moments.forward,
+    sheeprl/algos/dreamer_v3/utils.py:57-63). Returns (new_state, (low,
+    invscale)).
+
+    The reference all_gathers `x` across ranks before the quantile; here the
+    caller runs this inside a pjit-sharded step, where `jnp.quantile` over a
+    batch-sharded array *is* the global quantile — XLA inserts the gather on
+    ICI automatically.
+    """
+    x = jax.lax.stop_gradient(x.astype(jnp.float32))
+    low = jnp.quantile(x, percentile_low)
+    high = jnp.quantile(x, percentile_high)
+    new_low = decay * state["low"] + (1 - decay) * low
+    new_high = decay * state["high"] + (1 - decay) * high
+    invscale = jnp.maximum(1.0 / max_, new_high - new_low)
+    return {"low": new_low, "high": new_high}, (new_low, invscale)

@@ -1,0 +1,237 @@
+"""Distribution tests with scipy golden values
+(reference spec: sheeprl/utils/distribution.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.stats
+
+from sheeprl_tpu.utils.distribution import (
+    BernoulliSafeMode,
+    Independent,
+    MSEDistribution,
+    Normal,
+    OneHotCategorical,
+    OneHotCategoricalStraightThrough,
+    SymlogDistribution,
+    TruncatedNormal,
+    TwoHotEncodingDistribution,
+    kl_divergence,
+    uniform_mix,
+)
+from sheeprl_tpu.utils.ops import symexp, symlog
+
+
+class TestNormal:
+    def test_log_prob_matches_scipy(self):
+        d = Normal(jnp.asarray(1.5), jnp.asarray(2.0))
+        x = np.linspace(-3, 5, 7)
+        np.testing.assert_allclose(
+            np.asarray(d.log_prob(jnp.asarray(x))),
+            scipy.stats.norm(1.5, 2.0).logpdf(x),
+            rtol=1e-5,
+        )
+
+    def test_entropy_matches_scipy(self):
+        d = Normal(jnp.asarray(0.0), jnp.asarray(3.0))
+        np.testing.assert_allclose(float(d.entropy()), scipy.stats.norm(0, 3).entropy(), rtol=1e-6)
+
+    def test_sample_moments(self):
+        d = Normal(jnp.asarray(2.0), jnp.asarray(0.5))
+        s = np.asarray(d.sample(jax.random.PRNGKey(0), (20000,)))
+        assert abs(s.mean() - 2.0) < 0.02
+        assert abs(s.std() - 0.5) < 0.02
+
+    def test_kl_matches_closed_form(self):
+        p = Normal(jnp.asarray(0.0), jnp.asarray(1.0))
+        q = Normal(jnp.asarray(1.0), jnp.asarray(2.0))
+        # KL(N(0,1)||N(1,2)) = log(2) + (1+1)/8 - 1/2
+        expected = np.log(2.0) + 2 / 8 - 0.5
+        np.testing.assert_allclose(float(kl_divergence(p, q)), expected, rtol=1e-6)
+
+
+class TestIndependent:
+    def test_sums_event_dims(self):
+        d = Independent(Normal(jnp.zeros((3, 4)), jnp.ones((3, 4))), 1)
+        lp = d.log_prob(jnp.zeros((3, 4)))
+        assert lp.shape == (3,)
+        np.testing.assert_allclose(np.asarray(lp), 4 * scipy.stats.norm.logpdf(0.0), rtol=1e-6)
+
+    def test_kl_independent(self):
+        p = Independent(Normal(jnp.zeros(4), jnp.ones(4)), 1)
+        q = Independent(Normal(jnp.ones(4), jnp.ones(4)), 1)
+        np.testing.assert_allclose(float(kl_divergence(p, q)), 4 * 0.5, rtol=1e-6)
+
+
+class TestTruncatedNormal:
+    def test_log_prob_matches_scipy(self):
+        loc, scale, a, b = 0.5, 1.5, -1.0, 2.0
+        d = TruncatedNormal(jnp.asarray(loc), jnp.asarray(scale), jnp.asarray(a), jnp.asarray(b))
+        sp = scipy.stats.truncnorm((a - loc) / scale, (b - loc) / scale, loc=loc, scale=scale)
+        x = np.linspace(-0.9, 1.9, 9)
+        np.testing.assert_allclose(np.asarray(d.log_prob(jnp.asarray(x))), sp.logpdf(x), rtol=1e-4)
+
+    def test_mean_variance_match_scipy(self):
+        loc, scale, a, b = -0.3, 0.8, -1.0, 1.0
+        d = TruncatedNormal(jnp.asarray(loc), jnp.asarray(scale), jnp.asarray(a), jnp.asarray(b))
+        sp = scipy.stats.truncnorm((a - loc) / scale, (b - loc) / scale, loc=loc, scale=scale)
+        np.testing.assert_allclose(float(d.mean), sp.mean(), rtol=1e-4)
+        np.testing.assert_allclose(float(d.variance), sp.var(), rtol=1e-4)
+
+    def test_samples_within_bounds(self):
+        d = TruncatedNormal(jnp.asarray(0.0), jnp.asarray(1.0), jnp.asarray(-0.5), jnp.asarray(0.5))
+        s = np.asarray(d.sample(jax.random.PRNGKey(0), (5000,)))
+        assert s.min() >= -0.5 and s.max() <= 0.5
+
+    def test_entropy_matches_scipy(self):
+        d = TruncatedNormal(jnp.asarray(0.0), jnp.asarray(2.0), jnp.asarray(-1.0), jnp.asarray(3.0))
+        sp = scipy.stats.truncnorm(-0.5, 1.5, loc=0.0, scale=2.0)
+        np.testing.assert_allclose(float(d.entropy()), sp.entropy(), rtol=1e-4)
+
+
+class TestSymlogMSEDistributions:
+    def test_symlog_mode_roundtrip(self):
+        raw = jnp.asarray([[0.5, -1.0, 2.0]])
+        d = SymlogDistribution(symlog(raw), dims=1)
+        np.testing.assert_allclose(np.asarray(d.mode), np.asarray(raw), rtol=1e-5)
+
+    def test_symlog_log_prob_is_neg_mse_in_symlog_space(self):
+        mode = jnp.asarray([[0.0, 1.0]])
+        value = jnp.asarray([[1.0, 1.0]])
+        d = SymlogDistribution(mode, dims=1)
+        s1 = float(symlog(jnp.asarray(1.0)))
+        expected = -((0.0 - s1) ** 2 + (1.0 - s1) ** 2)  # sum over event dim
+        np.testing.assert_allclose(float(d.log_prob(value)[0]), expected, rtol=1e-5)
+
+    def test_dims_zero_reduces_all_axes(self):
+        # torch parity: sum(dim=()) collapses all dims (reference default dims=0)
+        d = MSEDistribution(jnp.ones((3, 4)), dims=0)
+        assert d.log_prob(jnp.zeros((3, 4))).shape == ()
+        th = TwoHotEncodingDistribution(jnp.zeros((4, 255)), dims=0)
+        assert th.log_prob(jnp.zeros((4, 1))).shape == ()
+
+    def test_mse_log_prob(self):
+        d = MSEDistribution(jnp.asarray([[1.0, 2.0]]), dims=1)
+        lp = float(d.log_prob(jnp.asarray([[0.0, 0.0]]))[0])
+        assert lp == pytest.approx(-(1.0 + 4.0))
+
+
+class TestTwoHotDistribution:
+    def test_mean_inverts_symlog(self):
+        # All mass on one bin → mean = symexp(bin)
+        nbins = 255
+        logits = jnp.full((1, nbins), -1e9).at[0, 200].set(0.0)
+        d = TwoHotEncodingDistribution(logits, dims=1)
+        bin_val = float(jnp.linspace(-20, 20, nbins)[200])
+        np.testing.assert_allclose(np.asarray(d.mean).squeeze(), float(symexp(jnp.asarray(bin_val))), rtol=1e-4)
+
+    def test_log_prob_peaks_at_target(self):
+        nbins = 255
+        key = jax.random.PRNGKey(0)
+        logits = jax.random.normal(key, (1, nbins))
+        d = TwoHotEncodingDistribution(logits, dims=1)
+        lp = d.log_prob(jnp.asarray([[3.0]]))
+        assert lp.shape == (1,)
+        # log_prob equals target·log_softmax; verify against explicit two-hot
+        x = symlog(jnp.asarray([[3.0]]))
+        bins = jnp.linspace(-20, 20, nbins)
+        below = int((bins <= x[0, 0]).sum()) - 1
+        w_above = float((x[0, 0] - bins[below]) / (bins[below + 1] - bins[below]))
+        logp = np.asarray(jax.nn.log_softmax(logits, axis=-1))[0]
+        expected = (1 - w_above) * logp[below] + w_above * logp[below + 1]
+        np.testing.assert_allclose(float(lp[0]), expected, rtol=1e-5)
+
+    def test_extreme_values_clipped_to_support(self):
+        nbins = 255
+        logits = jnp.zeros((1, nbins))
+        d = TwoHotEncodingDistribution(logits, dims=1)
+        assert np.isfinite(float(d.log_prob(jnp.asarray([[1e9]]))[0]))
+
+
+class TestOneHotCategorical:
+    def test_probs_logits_consistency(self):
+        probs = jnp.asarray([0.1, 0.2, 0.7])
+        d = OneHotCategorical(probs=probs)
+        np.testing.assert_allclose(np.asarray(d.probs), np.asarray(probs), rtol=1e-6)
+
+    def test_log_prob(self):
+        d = OneHotCategorical(logits=jnp.log(jnp.asarray([0.1, 0.2, 0.7])))
+        lp = float(d.log_prob(jnp.asarray([0.0, 0.0, 1.0])))
+        np.testing.assert_allclose(lp, np.log(0.7), rtol=1e-5)
+
+    def test_entropy_matches_scipy(self):
+        p = np.asarray([0.2, 0.3, 0.5])
+        d = OneHotCategorical(probs=jnp.asarray(p))
+        np.testing.assert_allclose(float(d.entropy()), scipy.stats.entropy(p), rtol=1e-5)
+
+    def test_mode_is_onehot_argmax(self):
+        d = OneHotCategorical(probs=jnp.asarray([[0.2, 0.7, 0.1]]))
+        np.testing.assert_array_equal(np.asarray(d.mode), [[0, 1, 0]])
+
+    def test_sample_frequencies(self):
+        p = jnp.asarray([0.15, 0.35, 0.5])
+        d = OneHotCategorical(probs=p)
+        s = np.asarray(d.sample(jax.random.PRNGKey(0), (20000,)))
+        np.testing.assert_allclose(s.mean(0), np.asarray(p), atol=0.02)
+
+    def test_kl_matches_scipy(self):
+        p_np, q_np = np.asarray([0.2, 0.3, 0.5]), np.asarray([0.4, 0.4, 0.2])
+        p = OneHotCategorical(probs=jnp.asarray(p_np))
+        q = OneHotCategorical(probs=jnp.asarray(q_np))
+        np.testing.assert_allclose(
+            float(kl_divergence(p, q)), scipy.stats.entropy(p_np, q_np), rtol=1e-5
+        )
+
+    def test_kl_with_zero_probs_finite(self):
+        p = OneHotCategorical(probs=jnp.asarray([1.0, 0.0]))
+        q = OneHotCategorical(probs=jnp.asarray([0.5, 0.5]))
+        assert np.isfinite(float(kl_divergence(p, q)))
+
+    def test_straight_through_gradient(self):
+        def f(logits, key):
+            d = OneHotCategoricalStraightThrough(logits=logits)
+            return (d.rsample(key) * jnp.asarray([1.0, 2.0, 3.0])).sum()
+
+        g = jax.grad(f)(jnp.asarray([0.1, 0.1, 0.1]), jax.random.PRNGKey(0))
+        assert np.abs(np.asarray(g)).sum() > 0  # gradient flows via probs
+
+    def test_straight_through_forward_is_hard(self):
+        d = OneHotCategoricalStraightThrough(logits=jnp.zeros((4, 5)))
+        s = np.asarray(d.rsample(jax.random.PRNGKey(0)))
+        np.testing.assert_allclose(s.sum(-1), 1.0, rtol=1e-6)
+        assert ((s > 0.99) | (s < 0.21)).all()  # hard one-hot + probs residual≈0
+
+
+class TestBernoulliSafeMode:
+    def test_mode(self):
+        d = BernoulliSafeMode(probs=jnp.asarray([0.3, 0.7]))
+        np.testing.assert_array_equal(np.asarray(d.mode), [0, 1])
+
+    def test_log_prob_matches_scipy(self):
+        p = 0.3
+        d = BernoulliSafeMode(probs=jnp.asarray(p))
+        np.testing.assert_allclose(
+            float(d.log_prob(jnp.asarray(1.0))), scipy.stats.bernoulli(p).logpmf(1), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            float(d.log_prob(jnp.asarray(0.0))), scipy.stats.bernoulli(p).logpmf(0), rtol=1e-5
+        )
+
+    def test_entropy(self):
+        d = BernoulliSafeMode(probs=jnp.asarray(0.25))
+        np.testing.assert_allclose(float(d.entropy()), scipy.stats.bernoulli(0.25).entropy(), rtol=1e-5)
+
+
+class TestUniformMix:
+    def test_one_percent_mix(self):
+        logits = jnp.asarray([[10.0, 0.0, 0.0, 0.0]])
+        mixed = uniform_mix(logits, 0.01)
+        p = np.asarray(jax.nn.softmax(mixed, -1))[0]
+        assert p.min() >= 0.01 / 4 * 0.99  # every class gets ≥ unimix/K mass
+        raw = np.asarray(jax.nn.softmax(logits, -1))[0]
+        np.testing.assert_allclose(p, 0.99 * raw + 0.01 / 4, rtol=1e-5)
+
+    def test_zero_mix_is_identity(self):
+        logits = jnp.asarray([[1.0, 2.0]])
+        np.testing.assert_array_equal(np.asarray(uniform_mix(logits, 0.0)), np.asarray(logits))

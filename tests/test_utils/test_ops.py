@@ -1,0 +1,171 @@
+"""Golden-value tests for traced math ops against reference formulas
+(reference: sheeprl/utils/utils.py, sheeprl/algos/dreamer_v3/utils.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.utils.ops import (
+    compute_lambda_values,
+    gae,
+    init_moments,
+    normalize_tensor,
+    safeatanh,
+    safetanh,
+    symexp,
+    symlog,
+    two_hot_decoder,
+    two_hot_encoder,
+    update_moments,
+)
+
+
+class TestSymlog:
+    def test_roundtrip(self):
+        x = jnp.asarray([-1e4, -3.3, -1.0, 0.0, 0.5, 2.0, 1e4])
+        np.testing.assert_allclose(np.asarray(symexp(symlog(x))), np.asarray(x), rtol=1e-4)
+
+    def test_values(self):
+        np.testing.assert_allclose(float(symlog(jnp.asarray(np.e - 1))), 1.0, rtol=1e-6)
+        np.testing.assert_allclose(float(symlog(jnp.asarray(-(np.e - 1)))), -1.0, rtol=1e-6)
+
+
+class TestTwoHot:
+    def test_exact_bucket(self):
+        # support [-2, 2], 5 buckets → bins at -2,-1,0,1,2; x=1 is exactly bin 3
+        out = np.asarray(two_hot_encoder(jnp.asarray([[1.0]]), support_range=2, num_buckets=5))
+        np.testing.assert_allclose(out, [[0, 0, 0, 1, 0]], atol=1e-6)
+
+    def test_between_buckets(self):
+        out = np.asarray(two_hot_encoder(jnp.asarray([[0.3]]), support_range=2, num_buckets=5))
+        np.testing.assert_allclose(out, [[0, 0, 0.7, 0.3, 0]], atol=1e-6)
+
+    def test_clipping_and_edges(self):
+        for v, idx in ((-5.0, 0), (5.0, 4)):
+            out = np.asarray(two_hot_encoder(jnp.asarray([[v]]), support_range=2, num_buckets=5))
+            expected = np.zeros(5)
+            expected[idx] = 1
+            np.testing.assert_allclose(out[0], expected, atol=1e-6)
+
+    def test_roundtrip(self):
+        xs = jnp.asarray([[-7.3], [0.0], [0.25], [3.9]])
+        enc = two_hot_encoder(xs, support_range=10, num_buckets=41)
+        dec = two_hot_decoder(enc, support_range=10)
+        np.testing.assert_allclose(np.asarray(dec), np.asarray(xs), atol=1e-5)
+
+    def test_even_buckets_raises(self):
+        with pytest.raises(ValueError):
+            two_hot_encoder(jnp.asarray([[1.0]]), support_range=2, num_buckets=4)
+
+    def test_batch_shape(self):
+        out = two_hot_encoder(jnp.ones((3, 4, 1)), support_range=5)
+        assert out.shape == (3, 4, 11)
+
+
+def _gae_oracle(rewards, values, dones, next_value, gamma, lam):
+    """Transliteration of the reference loop (sheeprl/utils/utils.py:63-100)."""
+    T = rewards.shape[0]
+    advantages = np.zeros_like(rewards)
+    lastgaelam = 0
+    not_dones = 1.0 - dones
+    nextnonterminal = not_dones[-1]
+    nextvalues = next_value
+    for t in reversed(range(T)):
+        if t < T - 1:
+            nextnonterminal = not_dones[t]
+            nextvalues = values[t + 1]
+        delta = rewards[t] + nextvalues * nextnonterminal * gamma - values[t]
+        advantages[t] = lastgaelam = delta + nextnonterminal * lastgaelam * gamma * lam
+    return advantages + values, advantages
+
+
+class TestGAE:
+    def test_matches_reference_loop(self):
+        rng = np.random.RandomState(0)
+        T, N = 16, 4
+        rewards = rng.randn(T, N, 1).astype(np.float32)
+        values = rng.randn(T, N, 1).astype(np.float32)
+        dones = (rng.rand(T, N, 1) < 0.15).astype(np.float32)
+        next_value = rng.randn(N, 1).astype(np.float32)
+        ret, adv = gae(
+            jnp.asarray(rewards), jnp.asarray(values), jnp.asarray(dones), jnp.asarray(next_value), 0.99, 0.95
+        )
+        oret, oadv = _gae_oracle(rewards, values, dones, next_value, 0.99, 0.95)
+        np.testing.assert_allclose(np.asarray(adv), oadv, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(ret), oret, rtol=1e-4, atol=1e-5)
+
+    def test_jittable(self):
+        f = jax.jit(lambda r, v, d, nv: gae(r, v, d, nv, 0.99, 0.95))
+        ret, adv = f(jnp.ones((4, 2)), jnp.zeros((4, 2)), jnp.zeros((4, 2)), jnp.zeros((2,)))
+        assert ret.shape == (4, 2)
+
+
+def _lambda_oracle(rewards, values, continues, lmbda):
+    """Transliteration of the reference loop (dreamer_v3/utils.py:66-77)."""
+    interm = rewards + continues * values * (1 - lmbda)
+    vals = [values[-1]]
+    for t in reversed(range(len(continues))):
+        vals.append(interm[t] + continues[t] * lmbda * vals[-1])
+    return np.stack(list(reversed(vals))[:-1])
+
+
+class TestLambdaValues:
+    def test_matches_reference_loop(self):
+        rng = np.random.RandomState(1)
+        T, B = 15, 6
+        rewards = rng.randn(T, B, 1).astype(np.float32)
+        values = rng.randn(T, B, 1).astype(np.float32)
+        continues = (rng.rand(T, B, 1) < 0.9).astype(np.float32) * 0.997
+        out = compute_lambda_values(jnp.asarray(rewards), jnp.asarray(values), jnp.asarray(continues), 0.95)
+        oracle = _lambda_oracle(rewards, values, continues, 0.95)
+        np.testing.assert_allclose(np.asarray(out), oracle, rtol=1e-4, atol=1e-5)
+
+
+class TestNormalize:
+    def test_zero_mean_unit_std(self):
+        rng = np.random.RandomState(2)
+        x = jnp.asarray(rng.randn(100) * 4 + 7, jnp.float32)
+        out = np.asarray(normalize_tensor(x))
+        assert abs(out.mean()) < 1e-5
+        np.testing.assert_allclose(out.std(ddof=1), 1.0, atol=1e-3)
+
+    def test_masked(self):
+        x = jnp.asarray([1.0, 2.0, 3.0, 100.0])
+        mask = jnp.asarray([True, True, True, False])
+        out = np.asarray(normalize_tensor(x, mask=mask))
+        np.testing.assert_allclose(out[:3].mean(), 0.0, atol=1e-6)
+
+
+class TestSafeTanh:
+    def test_clamped(self):
+        eps = 1e-3
+        assert float(safetanh(jnp.asarray(100.0), eps)) == pytest.approx(1 - eps)
+        assert np.isfinite(float(safeatanh(jnp.asarray(1.0), eps)))
+
+    def test_roundtrip(self):
+        x = jnp.asarray(0.7)
+        np.testing.assert_allclose(float(safeatanh(safetanh(x, 1e-6), 1e-6)), 0.7, rtol=1e-4)
+
+
+class TestMoments:
+    def test_ema_tracks_quantiles(self):
+        state = init_moments()
+        rng = np.random.RandomState(3)
+        x = jnp.asarray(rng.randn(1024), jnp.float32)
+        low5, high95 = np.quantile(np.asarray(x), [0.05, 0.95])
+        for _ in range(300):
+            state, (low, invscale) = update_moments(state, x, decay=0.9)
+        np.testing.assert_allclose(float(state["low"]), low5, atol=1e-2)
+        np.testing.assert_allclose(float(state["high"]), high95, atol=1e-2)
+        np.testing.assert_allclose(float(invscale), high95 - low5, atol=2e-2)
+
+    def test_invscale_floor(self):
+        state = init_moments()
+        _, (_, invscale) = update_moments(state, jnp.zeros(16), max_=1e8)
+        assert float(invscale) == pytest.approx(1e-8)
+
+    def test_jittable(self):
+        f = jax.jit(update_moments)
+        state, (low, inv) = f(init_moments(), jnp.ones(8))
+        assert low.shape == ()
