@@ -1,0 +1,19 @@
+from sheeprl_tpu.envs.wrappers import (
+    ActionRepeat,
+    ActionsAsObservationWrapper,
+    FrameStack,
+    GrayscaleRenderWrapper,
+    MaskVelocityWrapper,
+    RestartOnException,
+    RewardAsObservationWrapper,
+)
+
+__all__ = [
+    "ActionRepeat",
+    "ActionsAsObservationWrapper",
+    "FrameStack",
+    "GrayscaleRenderWrapper",
+    "MaskVelocityWrapper",
+    "RestartOnException",
+    "RewardAsObservationWrapper",
+]

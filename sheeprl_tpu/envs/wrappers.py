@@ -1,0 +1,333 @@
+"""Generic environment wrappers.
+
+Capability parity with the reference wrapper set
+(sheeprl/envs/wrappers.py:13-342), with one deliberate layout change: pixel
+observations are **channel-last (H, W, C)** end-to-end — the TPU/XLA-native
+conv layout — and FrameStack concatenates frames along the channel axis
+instead of prepending a stack axis, so stacked pixels feed NHWC convolutions
+with no reshape or transpose anywhere in the pipeline.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, SupportsFloat, Tuple, Union
+
+import gymnasium as gym
+import numpy as np
+
+
+class MaskVelocityWrapper(gym.ObservationWrapper):
+    """Mask velocity entries of classic-control observations to make the MDP
+    partially observable (reference: sheeprl/envs/wrappers.py:13-45)."""
+
+    velocity_indices: Dict[str, np.ndarray] = {
+        "CartPole-v0": np.array([1, 3]),
+        "CartPole-v1": np.array([1, 3]),
+        "MountainCar-v0": np.array([1]),
+        "MountainCarContinuous-v0": np.array([1]),
+        "Pendulum-v1": np.array([2]),
+        "LunarLander-v2": np.array([2, 3, 5]),
+        "LunarLanderContinuous-v2": np.array([2, 3, 5]),
+        # gymnasium >= 1.0 registers the v3 revisions
+        "LunarLander-v3": np.array([2, 3, 5]),
+        "LunarLanderContinuous-v3": np.array([2, 3, 5]),
+    }
+
+    def __init__(self, env: gym.Env):
+        super().__init__(env)
+        assert env.unwrapped.spec is not None
+        env_id: str = env.unwrapped.spec.id
+        self.mask = np.ones_like(env.observation_space.sample())
+        try:
+            self.mask[self.velocity_indices[env_id]] = 0.0
+        except KeyError as e:
+            raise NotImplementedError(f"Velocity masking not implemented for {env_id}") from e
+
+    def observation(self, observation: np.ndarray) -> np.ndarray:
+        return observation * self.mask
+
+
+class ActionRepeat(gym.Wrapper):
+    """Repeat an action `amount` times, summing rewards, stopping early on
+    termination (reference: sheeprl/envs/wrappers.py:48-71)."""
+
+    def __init__(self, env: gym.Env, amount: int = 1):
+        super().__init__(env)
+        if amount <= 0:
+            raise ValueError("`amount` should be a positive integer")
+        self._amount = amount
+
+    @property
+    def action_repeat(self) -> int:
+        return self._amount
+
+    def step(self, action):
+        done = False
+        truncated = False
+        current_step = 0
+        total_reward = 0.0
+        while current_step < self._amount and not (done or truncated):
+            obs, reward, done, truncated, info = self.env.step(action)
+            total_reward += reward
+            current_step += 1
+        return obs, total_reward, done, truncated, info
+
+
+class RestartOnException(gym.Wrapper):
+    """Env-level fault tolerance: on exception in step/reset, rebuild the env
+    (at most `maxfails` failures per `window` seconds, sleeping `wait` between
+    attempts) and flag `info["restart_on_exception"]=True` so the algorithm
+    can patch its buffer (reference: sheeprl/envs/wrappers.py:74-124; consumed
+    by DreamerV3 at dreamer_v3.py:595-608)."""
+
+    def __init__(
+        self,
+        env_fn: Callable[..., gym.Env],
+        exceptions: Union[type, Tuple[type, ...], List[type]] = (Exception,),
+        window: float = 300,
+        maxfails: int = 2,
+        wait: float = 20,
+    ):
+        if not isinstance(exceptions, (tuple, list)):
+            exceptions = [exceptions]
+        self._env_fn = env_fn
+        self._exceptions = tuple(exceptions)
+        self._window = window
+        self._maxfails = maxfails
+        self._wait = wait
+        self._last = time.time()
+        self._fails = 0
+        super().__init__(self._env_fn())
+
+    def _register_failure(self, where: str, e: Exception) -> None:
+        if time.time() > self._last + self._window:
+            self._last = time.time()
+            self._fails = 1
+        else:
+            self._fails += 1
+        if self._fails > self._maxfails:
+            raise RuntimeError(f"The env crashed too many times: {self._fails}")
+        gym.logger.warn(f"{where} - Restarting env after crash with {type(e).__name__}: {e}")
+        time.sleep(self._wait)
+
+    def step(self, action) -> Tuple[Any, SupportsFloat, bool, bool, Dict[str, Any]]:
+        try:
+            return self.env.step(action)
+        except self._exceptions as e:
+            self._register_failure("STEP", e)
+            self.env = self._env_fn()
+            new_obs, info = self.env.reset()
+            info.update({"restart_on_exception": True})
+            return new_obs, 0.0, False, False, info
+
+    def reset(
+        self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None
+    ) -> Tuple[Any, Dict[str, Any]]:
+        try:
+            return self.env.reset(seed=seed, options=options)
+        except self._exceptions as e:
+            self._register_failure("RESET", e)
+            self.env = self._env_fn()
+            new_obs, info = self.env.reset(seed=seed, options=options)
+            info.update({"restart_on_exception": True})
+            return new_obs, info
+
+
+class FrameStack(gym.Wrapper):
+    """Stack the last `num_stack` pixel frames along the CHANNEL axis.
+
+    Reference parity: sheeprl/envs/wrappers.py:126-182, with the layout change
+    documented at module level — a (H, W, C) key becomes (H, W, C*num_stack)
+    rather than (num_stack, C, H, W), so NHWC convs consume it directly.
+    `dilation` keeps every dilation-th frame of the last num_stack*dilation.
+    """
+
+    def __init__(self, env: gym.Env, num_stack: int, cnn_keys: Sequence[str], dilation: int = 1) -> None:
+        super().__init__(env)
+        if num_stack <= 0:
+            raise ValueError(f"Invalid value for num_stack, expected a value greater than zero, got {num_stack}")
+        if not isinstance(env.observation_space, gym.spaces.Dict):
+            raise RuntimeError(
+                f"Expected an observation space of type gym.spaces.Dict, got: {type(env.observation_space)}"
+            )
+        self._num_stack = num_stack
+        self._cnn_keys = []
+        self._dilation = dilation
+        self.observation_space = copy.deepcopy(self.env.observation_space)
+        for k, v in self.env.observation_space.spaces.items():
+            if cnn_keys and k in cnn_keys and len(v.shape) == 3:
+                self._cnn_keys.append(k)
+                self.observation_space[k] = gym.spaces.Box(
+                    np.concatenate([v.low] * num_stack, axis=-1),
+                    np.concatenate([v.high] * num_stack, axis=-1),
+                    (*v.shape[:-1], v.shape[-1] * num_stack),
+                    v.dtype,
+                )
+        if len(self._cnn_keys) == 0:
+            raise RuntimeError("Specify at least one valid cnn key to be stacked")
+        self._frames = {k: deque(maxlen=num_stack * dilation) for k in self._cnn_keys}
+
+    def _get_obs(self, key: str) -> np.ndarray:
+        frames_subset = list(self._frames[key])[self._dilation - 1 :: self._dilation]
+        assert len(frames_subset) == self._num_stack
+        return np.concatenate(frames_subset, axis=-1)
+
+    def step(self, action: Any) -> Tuple[Any, SupportsFloat, bool, bool, Dict[str, Any]]:
+        obs, reward, done, truncated, infos = self.env.step(action)
+        for k in self._cnn_keys:
+            self._frames[k].append(obs[k])
+            obs[k] = self._get_obs(k)
+        return obs, reward, done, truncated, infos
+
+    def reset(
+        self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None, **kwargs
+    ) -> Tuple[Any, Dict[str, Any]]:
+        obs, infos = self.env.reset(seed=seed, options=options, **kwargs)
+        for k in self._cnn_keys:
+            self._frames[k].clear()
+            for _ in range(self._num_stack * self._dilation):
+                self._frames[k].append(obs[k])
+            obs[k] = self._get_obs(k)
+        return obs, infos
+
+
+class RewardAsObservationWrapper(gym.Wrapper):
+    """Expose the last reward as a (1,)-shaped `reward` observation key,
+    dict-ifying the obs space if needed (reference: wrappers.py:185-241)."""
+
+    def __init__(self, env: gym.Env) -> None:
+        super().__init__(env)
+        reward_range = getattr(self.env, "reward_range", None) or (-np.inf, np.inf)
+        if isinstance(self.env.observation_space, gym.spaces.Dict):
+            self.observation_space = gym.spaces.Dict(
+                {
+                    "reward": gym.spaces.Box(*reward_range, (1,), np.float32),
+                    **{k: v for k, v in self.env.observation_space.items()},
+                }
+            )
+        else:
+            self.observation_space = gym.spaces.Dict(
+                {"obs": self.env.observation_space, "reward": gym.spaces.Box(*reward_range, (1,), np.float32)}
+            )
+
+    def _convert_obs(self, obs: Any, reward: Union[float, np.ndarray]) -> Dict[str, Any]:
+        reward_obs = np.asarray(reward, dtype=np.float32).reshape(-1)
+        if isinstance(obs, dict):
+            obs["reward"] = reward_obs
+        else:
+            obs = {"obs": obs, "reward": reward_obs}
+        return obs
+
+    def step(self, action: Any) -> Tuple[Any, SupportsFloat, bool, bool, Dict[str, Any]]:
+        obs, reward, done, truncated, infos = self.env.step(action)
+        return self._convert_obs(obs, copy.deepcopy(reward)), reward, done, truncated, infos
+
+    def reset(
+        self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None
+    ) -> Tuple[Any, Dict[str, Any]]:
+        obs, infos = self.env.reset(seed=seed, options=options)
+        return self._convert_obs(obs, 0), infos
+
+
+class GrayscaleRenderWrapper(gym.Wrapper):
+    """Promote 2-D/1-channel render frames to 3-channel RGB so video encoders
+    accept them (reference: wrappers.py:244-255)."""
+
+    def render(self):
+        frame = super().render()
+        if isinstance(frame, np.ndarray):
+            if len(frame.shape) == 2:
+                frame = frame[..., np.newaxis]
+            if len(frame.shape) == 3 and frame.shape[-1] == 1:
+                frame = frame.repeat(3, axis=-1)
+        return frame
+
+
+class ActionsAsObservationWrapper(gym.Wrapper):
+    """Expose the last `num_stack` actions (one-hot for discrete spaces) as an
+    `action_stack` observation key (reference: wrappers.py:258-342)."""
+
+    def __init__(self, env: gym.Env, num_stack: int, noop: Union[float, int, List[int]], dilation: int = 1):
+        super().__init__(env)
+        if num_stack < 1:
+            raise ValueError(
+                "The number of actions to the `action_stack` observation "
+                f"must be greater or equal than 1, got: {num_stack}"
+            )
+        if dilation < 1:
+            raise ValueError(f"The actions stack dilation argument must be greater than zero, got: {dilation}")
+        if not isinstance(noop, (int, float, list)):
+            raise ValueError(f"The noop action must be an integer or float or list, got: {noop} ({type(noop)})")
+        self._num_stack = num_stack
+        self._dilation = dilation
+        self._actions = deque(maxlen=num_stack * dilation)
+        self._is_continuous = isinstance(self.env.action_space, gym.spaces.Box)
+        self._is_multidiscrete = isinstance(self.env.action_space, gym.spaces.MultiDiscrete)
+        self.observation_space = copy.deepcopy(self.env.observation_space)
+        if self._is_continuous:
+            self._action_shape = self.env.action_space.shape[0]
+            low = np.resize(self.env.action_space.low, self._action_shape * num_stack)
+            high = np.resize(self.env.action_space.high, self._action_shape * num_stack)
+        elif self._is_multidiscrete:
+            low = 0
+            high = 1  # one-hot encoding
+            self._action_shape = int(sum(self.env.action_space.nvec))
+        else:
+            low = 0
+            high = 1  # one-hot encoding
+            self._action_shape = int(self.env.action_space.n)
+        self.observation_space["action_stack"] = gym.spaces.Box(
+            low=low, high=high, shape=(self._action_shape * num_stack,), dtype=np.float32
+        )
+        if self._is_continuous:
+            if isinstance(noop, list):
+                raise ValueError(f"The noop actions must be a float for continuous action spaces, got: {noop}")
+            self.noop = np.full((self._action_shape,), noop, dtype=np.float32)
+        elif self._is_multidiscrete:
+            if not isinstance(noop, list):
+                raise ValueError(f"The noop actions must be a list for multi-discrete action spaces, got: {noop}")
+            if len(self.env.action_space.nvec) != len(noop):
+                raise RuntimeError(
+                    "The number of noop actions must be equal to the number of actions of the environment. "
+                    f"Got env_action_space = {self.env.action_space.nvec} and noop = {noop}"
+                )
+            self.noop = self._one_hot(noop)
+        else:
+            if isinstance(noop, (list, float)):
+                raise ValueError(f"The noop actions must be an integer for discrete action spaces, got: {noop}")
+            self.noop = self._one_hot(noop)
+
+    def _one_hot(self, action: Any) -> np.ndarray:
+        if self._is_continuous:
+            return np.asarray(action, dtype=np.float32).reshape(-1)
+        if self._is_multidiscrete:
+            parts = []
+            for act, n in zip(action, self.env.action_space.nvec):
+                one = np.zeros((n,), dtype=np.float32)
+                one[act] = 1.0
+                parts.append(one)
+            return np.concatenate(parts, axis=-1)
+        one = np.zeros((self._action_shape,), dtype=np.float32)
+        one[action] = 1.0
+        return one
+
+    def step(self, action: Any):
+        self._actions.append(self._one_hot(action))
+        obs, reward, done, truncated, info = super().step(action)
+        obs["action_stack"] = self._get_actions_stack()
+        return obs, reward, done, truncated, info
+
+    def reset(self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
+        obs, info = super().reset(seed=seed, options=options)
+        self._actions.clear()
+        for _ in range(self._num_stack * self._dilation):
+            self._actions.append(self.noop)
+        obs["action_stack"] = self._get_actions_stack()
+        return obs, info
+
+    def _get_actions_stack(self) -> np.ndarray:
+        actions_stack = list(self._actions)[self._dilation - 1 :: self._dilation]
+        return np.concatenate(actions_stack, axis=-1).astype(np.float32)

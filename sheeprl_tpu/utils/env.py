@@ -1,0 +1,239 @@
+"""Environment factory: the dict-obs normalization pipeline.
+
+Parity with the reference `make_env` (sheeprl/utils/env.py:26-231): given the
+composed config it returns a thunk building one fully-wrapped env — wrapper
+instantiation, action repeat, velocity masking, dict-ification of the obs
+space, resize/grayscale via cv2, channel handling, frame stack, actions/
+reward-as-observation, time limit, episode statistics, video capture.
+
+Deliberate TPU-layout divergence: pixels stay **channel-last (H, W, C)**
+through the whole pipeline (the reference transposes to CHW for torch at
+env.py:194). Built on gymnasium >= 1.0 (TransformObservation takes the new
+observation_space argument; AddRenderObservation replaces
+PixelObservationWrapper; RecordVideo replaces RecordVideoV0).
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Any, Callable, Dict, Optional
+
+import cv2
+import gymnasium as gym
+import numpy as np
+
+from sheeprl_tpu.config.instantiate import instantiate
+from sheeprl_tpu.envs.wrappers import (
+    ActionRepeat,
+    ActionsAsObservationWrapper,
+    FrameStack,
+    GrayscaleRenderWrapper,
+    MaskVelocityWrapper,
+    RewardAsObservationWrapper,
+)
+
+
+def make_env(
+    cfg: Dict[str, Any],
+    seed: int,
+    rank: int,
+    run_name: Optional[str] = None,
+    prefix: str = "",
+    vector_env_idx: int = 0,
+) -> Callable[[], gym.Env]:
+    """Return a thunk that builds one wrapped environment (the unit the
+    vector-env constructors consume)."""
+
+    def thunk() -> gym.Env:
+        instantiate_kwargs = {}
+        if "seed" in cfg.env.wrapper:
+            instantiate_kwargs["seed"] = seed
+        if "rank" in cfg.env.wrapper:
+            instantiate_kwargs["rank"] = rank + vector_env_idx
+        env = instantiate(cfg.env.wrapper, **instantiate_kwargs)
+
+        is_atari = "AtariPreprocessing" in str(cfg.env.wrapper.get("_target_", ""))
+        if cfg.env.action_repeat > 1 and not is_atari:
+            # Atari frame skip lives inside AtariPreprocessing already.
+            env = ActionRepeat(env, cfg.env.action_repeat)
+
+        if cfg.env.get("mask_velocities", False):
+            env = MaskVelocityWrapper(env)
+
+        if not (
+            isinstance(cfg.algo.mlp_keys.encoder, list)
+            and isinstance(cfg.algo.cnn_keys.encoder, list)
+            and len(cfg.algo.cnn_keys.encoder + cfg.algo.mlp_keys.encoder) > 0
+        ):
+            raise ValueError(
+                "`algo.cnn_keys.encoder` and `algo.mlp_keys.encoder` must be lists of strings, got: "
+                f"cnn encoder keys `{cfg.algo.cnn_keys.encoder}` of type `{type(cfg.algo.cnn_keys.encoder)}` "
+                f"and mlp encoder keys `{cfg.algo.mlp_keys.encoder}` of type `{type(cfg.algo.mlp_keys.encoder)}`. "
+                "Both must be non-empty lists."
+            )
+
+        # ------------------------------------------------- dict-ify the obs
+        encoder_cnn_keys_length = len(cfg.algo.cnn_keys.encoder)
+        encoder_mlp_keys_length = len(cfg.algo.mlp_keys.encoder)
+        if isinstance(env.observation_space, gym.spaces.Box) and len(env.observation_space.shape) < 2:
+            # Vector-only observation
+            if encoder_cnn_keys_length > 0:
+                if encoder_cnn_keys_length > 1:
+                    warnings.warn(
+                        "Multiple cnn keys have been specified and only one pixel observation "
+                        f"is allowed in {cfg.env.id}, only the first one is kept: {cfg.algo.cnn_keys.encoder[0]}"
+                    )
+                # Render-as-pixels (reference used PixelObservationWrapper)
+                env = gym.wrappers.AddRenderObservation(
+                    env,
+                    render_only=encoder_mlp_keys_length == 0,
+                    render_key=cfg.algo.cnn_keys.encoder[0],
+                    obs_key=cfg.algo.mlp_keys.encoder[0] if encoder_mlp_keys_length > 0 else "state",
+                )
+            else:
+                if encoder_mlp_keys_length > 1:
+                    warnings.warn(
+                        "Multiple mlp keys have been specified and only one vector observation "
+                        f"is allowed in {cfg.env.id}, only the first one is kept: {cfg.algo.mlp_keys.encoder[0]}"
+                    )
+                mlp_key = cfg.algo.mlp_keys.encoder[0]
+                env = gym.wrappers.TransformObservation(
+                    env,
+                    lambda obs: {mlp_key: obs},
+                    gym.spaces.Dict({mlp_key: env.observation_space}),
+                )
+        elif isinstance(env.observation_space, gym.spaces.Box) and 2 <= len(env.observation_space.shape) <= 3:
+            # Pixel-only observation
+            if encoder_cnn_keys_length > 1:
+                warnings.warn(
+                    "Multiple cnn keys have been specified and only one pixel observation "
+                    f"is allowed in {cfg.env.id}, only the first one is kept: {cfg.algo.cnn_keys.encoder[0]}"
+                )
+            elif encoder_cnn_keys_length == 0:
+                raise ValueError(
+                    "You have selected a pixel observation but no cnn key has been specified. "
+                    "Please set at least one cnn key in the config file: `algo.cnn_keys.encoder=[your_cnn_key]`"
+                )
+            cnn_key = cfg.algo.cnn_keys.encoder[0]
+            env = gym.wrappers.TransformObservation(
+                env,
+                lambda obs: {cnn_key: obs},
+                gym.spaces.Dict({cnn_key: env.observation_space}),
+            )
+
+        requested = set(cfg.algo.mlp_keys.encoder + cfg.algo.cnn_keys.encoder)
+        if len(requested.intersection(set(env.observation_space.keys()))) == 0:
+            raise ValueError(
+                f"The user specified keys `{sorted(requested)}` are not a subset of the "
+                f"environment `{sorted(env.observation_space.keys())}` observation keys. "
+                "Please check your config file."
+            )
+
+        env_cnn_keys = set(
+            k for k in env.observation_space.spaces.keys() if len(env.observation_space[k].shape) in {2, 3}
+        )
+        cnn_keys = env_cnn_keys.intersection(set(cfg.algo.cnn_keys.encoder))
+
+        # --------------------------------------- pixel pipeline (stay HWC)
+        screen = cfg.env.screen_size
+
+        def transform_obs(obs: Dict[str, Any]) -> Dict[str, Any]:
+            for k in cnn_keys:
+                current_obs = obs[k]
+                shape = current_obs.shape
+                is_3d = len(shape) == 3
+                is_grayscale = not is_3d or shape[0] == 1 or shape[-1] == 1
+                channel_first = is_3d and shape[0] in (1, 3) and shape[-1] not in (1, 3)
+
+                # to 3-D, channel-last (cv2-native)
+                if not is_3d:
+                    current_obs = np.expand_dims(current_obs, axis=-1)
+                elif channel_first:
+                    current_obs = np.transpose(current_obs, (1, 2, 0))
+
+                if current_obs.shape[:-1] != (screen, screen):
+                    current_obs = cv2.resize(current_obs, (screen, screen), interpolation=cv2.INTER_AREA)
+
+                if cfg.env.grayscale and not is_grayscale:
+                    current_obs = cv2.cvtColor(current_obs, cv2.COLOR_RGB2GRAY)
+
+                # cv2 drops the trailing single channel; restore to 3-D HWC
+                if len(current_obs.shape) == 2:
+                    current_obs = np.expand_dims(current_obs, axis=-1)
+                    if not cfg.env.grayscale:
+                        current_obs = np.repeat(current_obs, 3, axis=-1)
+
+                obs[k] = current_obs
+            return obs
+
+        new_spaces = dict(env.observation_space.spaces)
+        for k in cnn_keys:
+            new_spaces[k] = gym.spaces.Box(0, 255, (screen, screen, 1 if cfg.env.grayscale else 3), np.uint8)
+        env = gym.wrappers.TransformObservation(env, transform_obs, gym.spaces.Dict(new_spaces))
+
+        if cnn_keys is not None and len(cnn_keys) > 0 and cfg.env.frame_stack > 1:
+            if cfg.env.frame_stack_dilation <= 0:
+                raise ValueError(
+                    f"The frame stack dilation argument must be greater than zero, got: {cfg.env.frame_stack_dilation}"
+                )
+            env = FrameStack(env, cfg.env.frame_stack, cnn_keys, cfg.env.frame_stack_dilation)
+
+        if cfg.env.actions_as_observation.num_stack > 0:
+            env = ActionsAsObservationWrapper(env, **cfg.env.actions_as_observation)
+
+        if cfg.env.reward_as_observation:
+            env = RewardAsObservationWrapper(env)
+
+        env.action_space.seed(seed)
+        env.observation_space.seed(seed)
+        if cfg.env.max_episode_steps and cfg.env.max_episode_steps > 0:
+            env = gym.wrappers.TimeLimit(env, max_episode_steps=cfg.env.max_episode_steps)
+        env = gym.wrappers.RecordEpisodeStatistics(env)
+        if cfg.env.capture_video and rank == 0 and vector_env_idx == 0 and run_name is not None:
+            if cfg.env.grayscale:
+                env = GrayscaleRenderWrapper(env)
+            env = gym.wrappers.RecordVideo(
+                env,
+                os.path.join(run_name, prefix + "_videos" if prefix else "videos"),
+                disable_logger=True,
+            )
+        return env
+
+    return thunk
+
+
+def get_dummy_env(id: str, **kwargs: Any) -> gym.Env:
+    """Instantiate a deterministic test env by id substring
+    (reference: sheeprl/utils/env.py:234-249)."""
+    if "continuous" in id:
+        from sheeprl_tpu.envs.dummy import ContinuousDummyEnv
+
+        return ContinuousDummyEnv(**kwargs)
+    elif "multidiscrete" in id:
+        from sheeprl_tpu.envs.dummy import MultiDiscreteDummyEnv
+
+        return MultiDiscreteDummyEnv(**kwargs)
+    elif "discrete" in id:
+        from sheeprl_tpu.envs.dummy import DiscreteDummyEnv
+
+        return DiscreteDummyEnv(**kwargs)
+    raise ValueError(f"Unrecognized dummy environment: {id}")
+
+
+def make_vector_env(
+    cfg: Dict[str, Any],
+    seed: int,
+    rank: int,
+    run_name: Optional[str] = None,
+    prefix: str = "",
+) -> gym.vector.VectorEnv:
+    """Build the Sync/AsyncVectorEnv of `cfg.env.num_envs` wrapped envs
+    (reference pattern: e.g. sheeprl/algos/ppo/ppo.py:137-150)."""
+    thunks = [
+        make_env(cfg, seed + rank * cfg.env.num_envs + i, rank, run_name, prefix, vector_env_idx=i)
+        for i in range(cfg.env.num_envs)
+    ]
+    if cfg.env.sync_env:
+        return gym.vector.SyncVectorEnv(thunks, autoreset_mode=gym.vector.AutoresetMode.SAME_STEP)
+    return gym.vector.AsyncVectorEnv(thunks, autoreset_mode=gym.vector.AutoresetMode.SAME_STEP)

@@ -1,0 +1,137 @@
+"""make_env factory tests (reference parity: tests/test_envs/test_make_env)."""
+
+import gymnasium as gym
+import numpy as np
+import pytest
+
+from sheeprl_tpu.utils.env import get_dummy_env, make_env, make_vector_env
+from sheeprl_tpu.utils.utils import dotdict
+
+
+def base_cfg(**env_overrides):
+    env = dict(
+        id="discrete_dummy",
+        num_envs=2,
+        frame_stack=1,
+        sync_env=True,
+        screen_size=64,
+        action_repeat=1,
+        grayscale=False,
+        clip_rewards=False,
+        capture_video=False,
+        frame_stack_dilation=1,
+        actions_as_observation=dict(num_stack=-1, noop=0, dilation=1),
+        max_episode_steps=None,
+        reward_as_observation=False,
+        wrapper={"_target_": "sheeprl_tpu.utils.env.get_dummy_env", "id": "discrete_dummy"},
+    )
+    env.update(env_overrides)
+    return dotdict(
+        {
+            "env": env,
+            "algo": {"cnn_keys": {"encoder": ["rgb"]}, "mlp_keys": {"encoder": ["state"]}},
+        }
+    )
+
+
+class TestMakeEnv:
+    def test_dummy_dict_obs_channel_last(self):
+        env = make_env(base_cfg(), seed=0, rank=0)()
+        assert isinstance(env.observation_space, gym.spaces.Dict)
+        assert env.observation_space["rgb"].shape == (64, 64, 3)
+        obs, _ = env.reset()
+        assert obs["rgb"].shape == (64, 64, 3)
+        assert obs["rgb"].dtype == np.uint8
+        assert obs["state"].shape == (10,)
+
+    def test_resize_pipeline(self):
+        cfg = base_cfg(screen_size=32)
+        cfg.env.wrapper["id"] = "discrete_dummy"
+        env = make_env(cfg, seed=0, rank=0)()
+        obs, _ = env.reset()
+        assert obs["rgb"].shape == (32, 32, 3)
+
+    def test_grayscale(self):
+        cfg = base_cfg(grayscale=True)
+        env = make_env(cfg, seed=0, rank=0)()
+        obs, _ = env.reset()
+        assert obs["rgb"].shape == (64, 64, 1)
+
+    def test_frame_stack_channels(self):
+        cfg = base_cfg(frame_stack=4)
+        env = make_env(cfg, seed=0, rank=0)()
+        obs, _ = env.reset()
+        assert obs["rgb"].shape == (64, 64, 12)
+
+    def test_vector_only_env_dictified(self):
+        cfg = base_cfg(wrapper={"_target_": "gymnasium.make", "id": "CartPole-v1"}, id="CartPole-v1")
+        cfg.algo = dotdict({"cnn_keys": {"encoder": []}, "mlp_keys": {"encoder": ["state"]}})
+        env = make_env(cfg, seed=0, rank=0)()
+        obs, _ = env.reset(seed=0)
+        assert set(obs.keys()) == {"state"}
+        assert obs["state"].shape == (4,)
+
+    def test_time_limit(self):
+        cfg = base_cfg(max_episode_steps=3)
+        cfg.env.wrapper["n_steps"] = 1000
+        env = make_env(cfg, seed=0, rank=0)()
+        env.reset()
+        truncated = False
+        for _ in range(3):
+            *_, truncated, _ = env.step(0)
+        assert truncated
+
+    def test_reward_as_observation(self):
+        cfg = base_cfg(reward_as_observation=True)
+        env = make_env(cfg, seed=0, rank=0)()
+        obs, _ = env.reset()
+        assert "reward" in obs
+
+    def test_actions_as_observation(self):
+        cfg = base_cfg(actions_as_observation=dict(num_stack=3, noop=0, dilation=1))
+        env = make_env(cfg, seed=0, rank=0)()
+        obs, _ = env.reset()
+        assert obs["action_stack"].shape == (6,)  # 2 actions × 3 stack
+
+    def test_bad_keys_raise(self):
+        cfg = base_cfg()
+        cfg.algo = dotdict({"cnn_keys": {"encoder": ["nope"]}, "mlp_keys": {"encoder": ["missing"]}})
+        with pytest.raises(ValueError, match="not a subset"):
+            make_env(cfg, seed=0, rank=0)()
+
+    def test_episode_statistics_recorded(self):
+        cfg = base_cfg(max_episode_steps=2)
+        env = make_env(cfg, seed=0, rank=0)()
+        env.reset()
+        infos = {}
+        for _ in range(2):
+            *_, infos = env.step(0)
+        assert "episode" in infos
+
+
+class TestVectorEnv:
+    def test_sync_vector_env(self):
+        envs = make_vector_env(base_cfg(), seed=0, rank=0)
+        assert envs.num_envs == 2
+        obs, _ = envs.reset()
+        assert obs["rgb"].shape == (2, 64, 64, 3)
+        obs, rewards, dones, truncs, infos = envs.step(np.zeros(2, np.int64))
+        assert rewards.shape == (2,)
+        envs.close()
+
+
+class TestGetDummyEnv:
+    @pytest.mark.parametrize(
+        "id,space",
+        [
+            ("discrete_dummy", gym.spaces.Discrete),
+            ("multidiscrete_dummy", gym.spaces.MultiDiscrete),
+            ("continuous_dummy", gym.spaces.Box),
+        ],
+    )
+    def test_ids(self, id, space):
+        assert isinstance(get_dummy_env(id).action_space, space)
+
+    def test_unknown(self):
+        with pytest.raises(ValueError):
+            get_dummy_env("nope")

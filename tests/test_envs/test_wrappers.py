@@ -1,0 +1,195 @@
+"""Wrapper tests (parity targets: reference tests/test_envs/*)."""
+
+import gymnasium as gym
+import numpy as np
+import pytest
+
+from sheeprl_tpu.envs.dummy import ContinuousDummyEnv, DiscreteDummyEnv, MultiDiscreteDummyEnv
+from sheeprl_tpu.envs.wrappers import (
+    ActionRepeat,
+    ActionsAsObservationWrapper,
+    FrameStack,
+    MaskVelocityWrapper,
+    RestartOnException,
+    RewardAsObservationWrapper,
+)
+
+
+class TestActionRepeat:
+    def test_accumulates_reward_and_counts_steps(self):
+        class CountingEnv(gym.Env):
+            observation_space = gym.spaces.Box(-1, 1, (1,))
+            action_space = gym.spaces.Discrete(2)
+
+            def __init__(self):
+                self.steps = 0
+
+            def reset(self, seed=None, options=None):
+                return np.zeros(1, np.float32), {}
+
+            def step(self, action):
+                self.steps += 1
+                return np.zeros(1, np.float32), 1.0, False, False, {}
+
+        env = ActionRepeat(CountingEnv(), 3)
+        env.reset()
+        _, reward, *_ = env.step(0)
+        assert reward == 3.0
+        assert env.unwrapped.steps == 3
+
+    def test_stops_on_done(self):
+        class DoneEnv(gym.Env):
+            observation_space = gym.spaces.Box(-1, 1, (1,))
+            action_space = gym.spaces.Discrete(2)
+
+            def __init__(self):
+                self.steps = 0
+
+            def reset(self, seed=None, options=None):
+                return np.zeros(1, np.float32), {}
+
+            def step(self, action):
+                self.steps += 1
+                return np.zeros(1, np.float32), 1.0, self.steps >= 2, False, {}
+
+        env = ActionRepeat(DoneEnv(), 5)
+        env.reset()
+        _, reward, done, *_ = env.step(0)
+        assert done and reward == 2.0
+
+    def test_invalid_amount(self):
+        with pytest.raises(ValueError):
+            ActionRepeat(DiscreteDummyEnv(), 0)
+
+
+class TestFrameStack:
+    def test_channel_concat_layout(self):
+        env = FrameStack(DiscreteDummyEnv(image_size=(8, 8, 3)), num_stack=4, cnn_keys=["rgb"])
+        assert env.observation_space["rgb"].shape == (8, 8, 12)
+        obs, _ = env.reset()
+        assert obs["rgb"].shape == (8, 8, 12)
+        # after reset all stacked frames are copies of frame 0
+        assert (obs["rgb"][..., :3] == obs["rgb"][..., 9:]).all()
+
+    def test_stacking_progression(self):
+        env = FrameStack(DiscreteDummyEnv(image_size=(4, 4, 1), n_steps=100), num_stack=2, cnn_keys=["rgb"])
+        env.reset()
+        obs, *_ = env.step(0)
+        # dummy env obs value == current step: frame t-1 then frame t
+        assert obs["rgb"][0, 0, 0] == 0
+        assert obs["rgb"][0, 0, 1] == 1
+
+    def test_dilation(self):
+        env = FrameStack(DiscreteDummyEnv(image_size=(4, 4, 1), n_steps=100), num_stack=2, cnn_keys=["rgb"], dilation=2)
+        env.reset()
+        for _ in range(4):
+            obs, *_ = env.step(0)
+        # frames kept: every 2nd of the last 4 → steps 2 and 4
+        assert obs["rgb"][0, 0, 0] == 2
+        assert obs["rgb"][0, 0, 1] == 4
+
+    def test_requires_dict_space(self):
+        with pytest.raises(RuntimeError):
+            FrameStack(gym.make("CartPole-v1"), 2, ["rgb"])
+
+    def test_requires_cnn_key(self):
+        with pytest.raises(RuntimeError, match="at least one valid cnn key"):
+            FrameStack(DiscreteDummyEnv(), 2, [])
+
+
+class TestMaskVelocity:
+    def test_cartpole_mask(self):
+        env = MaskVelocityWrapper(gym.make("CartPole-v1"))
+        obs, _ = env.reset(seed=0)
+        assert obs[1] == 0.0 and obs[3] == 0.0
+
+    def test_unsupported_env(self):
+        with pytest.raises(NotImplementedError):
+            MaskVelocityWrapper(gym.make("Acrobot-v1"))
+
+
+class TestRewardAsObservation:
+    def test_dict_env_gains_reward_key(self):
+        env = RewardAsObservationWrapper(DiscreteDummyEnv())
+        assert "reward" in env.observation_space.spaces
+        obs, _ = env.reset()
+        assert obs["reward"].shape == (1,) and obs["reward"][0] == 0
+        obs, *_ = env.step(0)
+        assert obs["reward"].shape == (1,)
+
+    def test_box_env_wrapped_into_dict(self):
+        env = RewardAsObservationWrapper(gym.make("CartPole-v1"))
+        assert set(env.observation_space.spaces) == {"obs", "reward"}
+        obs, _ = env.reset(seed=0)
+        assert set(obs) == {"obs", "reward"}
+
+
+class TestActionsAsObservation:
+    def test_discrete_onehot_stack(self):
+        env = ActionsAsObservationWrapper(DiscreteDummyEnv(action_dim=3), num_stack=2, noop=0)
+        assert env.observation_space["action_stack"].shape == (6,)
+        obs, _ = env.reset()
+        np.testing.assert_array_equal(obs["action_stack"], [1, 0, 0, 1, 0, 0])
+        obs, *_ = env.step(2)
+        np.testing.assert_array_equal(obs["action_stack"], [1, 0, 0, 0, 0, 1])
+
+    def test_continuous_stack(self):
+        env = ActionsAsObservationWrapper(ContinuousDummyEnv(action_dim=2), num_stack=3, noop=0.0)
+        obs, _ = env.reset()
+        assert obs["action_stack"].shape == (6,)
+        np.testing.assert_array_equal(obs["action_stack"], np.zeros(6))
+
+    def test_multidiscrete_noop_list(self):
+        env = ActionsAsObservationWrapper(MultiDiscreteDummyEnv(action_dims=[2, 3]), num_stack=1, noop=[0, 1])
+        obs, _ = env.reset()
+        np.testing.assert_array_equal(obs["action_stack"], [1, 0, 0, 1, 0])
+
+    @pytest.mark.parametrize("noop", [[0], 1.5])
+    def test_discrete_noop_type_errors(self, noop):
+        with pytest.raises(ValueError):
+            ActionsAsObservationWrapper(DiscreteDummyEnv(), num_stack=2, noop=noop)
+
+    def test_multidiscrete_noop_length_mismatch(self):
+        with pytest.raises(RuntimeError):
+            ActionsAsObservationWrapper(MultiDiscreteDummyEnv(action_dims=[2, 3]), num_stack=1, noop=[0])
+
+
+class TestRestartOnException:
+    def test_restart_on_step_failure(self):
+        calls = {"n": 0}
+
+        class FlakyEnv(gym.Env):
+            observation_space = gym.spaces.Box(-1, 1, (1,))
+            action_space = gym.spaces.Discrete(2)
+
+            def reset(self, seed=None, options=None):
+                return np.zeros(1, np.float32), {}
+
+            def step(self, action):
+                calls["n"] += 1
+                if calls["n"] == 1:
+                    raise RuntimeError("sim crashed")
+                return np.ones(1, np.float32), 1.0, False, False, {}
+
+        env = RestartOnException(lambda: FlakyEnv(), window=300, maxfails=2, wait=0)
+        env.reset()
+        obs, reward, done, truncated, info = env.step(0)
+        assert info.get("restart_on_exception") is True
+        assert reward == 0.0 and not done
+
+    def test_too_many_failures_raises(self):
+        class AlwaysBroken(gym.Env):
+            observation_space = gym.spaces.Box(-1, 1, (1,))
+            action_space = gym.spaces.Discrete(2)
+
+            def reset(self, seed=None, options=None):
+                return np.zeros(1, np.float32), {}
+
+            def step(self, action):
+                raise RuntimeError("boom")
+
+        env = RestartOnException(lambda: AlwaysBroken(), window=300, maxfails=1, wait=0)
+        env.reset()
+        env.step(0)  # first failure triggers restart
+        with pytest.raises(RuntimeError, match="crashed too many times"):
+            env.step(0)
