@@ -1,0 +1,6 @@
+"""`python -m sheeprl_tpu` → training CLI (reference console script `sheeprl`)."""
+
+from sheeprl_tpu.cli import run
+
+if __name__ == "__main__":
+    run()

@@ -1,0 +1,339 @@
+"""PPO agent (flax): dict-obs feature extractor → actor heads + critic.
+
+Capability parity with the reference agent (sheeprl/algos/ppo/agent.py:20-369)
+in a functional JAX shape: one `PPOAgentModule` holds every parameter; the
+reference's separate train-agent / single-device player pair (with `.data`
+weight tying, agent.py:362-368) collapses to a single params pytree applied by
+jitted pure functions — the "player" is just the same apply on un-sharded
+inputs, so tying is structural and free.
+
+Action-space handling (reference parity):
+- continuous: one head emitting 2*sum(actions_dim) (mean ‖ log_std), Normal or
+  tanh-squashed Normal with the softplus log-det correction (agent.py:194-206);
+- discrete / multi-discrete: one head per action dim, OneHotCategorical each,
+  log-probs and entropies summed across dims (agent.py:220-239).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from sheeprl_tpu.models import MLP, MultiEncoder, NatureCNN
+from sheeprl_tpu.utils.distribution import Independent, Normal, OneHotCategorical
+from sheeprl_tpu.utils.ops import safeatanh, safetanh
+
+_EPS = 1e-6  # tanh clamp resolution (reference uses dtype resolution)
+
+
+class CNNEncoder(nn.Module):
+    """Concat pixel keys along channels (HWC) → NatureCNN features
+    (reference: agent.py:20-36, NCHW there)."""
+
+    keys: Sequence[str]
+    features_dim: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        x = jnp.concatenate([obs[k] for k in self.keys], axis=-1)
+        return NatureCNN(features_dim=self.features_dim, dtype=self.dtype, name="model")(x)
+
+
+class MLPEncoder(nn.Module):
+    """Concat vector keys → MLP features (reference: agent.py:39-69)."""
+
+    keys: Sequence[str]
+    features_dim: Optional[int]
+    dense_units: int = 64
+    mlp_layers: int = 2
+    dense_act: str = "relu"
+    layer_norm: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        x = jnp.concatenate([obs[k] for k in self.keys], axis=-1)
+        if self.mlp_layers == 0:
+            return x
+        return MLP(
+            hidden_sizes=[self.dense_units] * self.mlp_layers,
+            output_dim=self.features_dim,
+            activation=self.dense_act,
+            norm_layer="layer_norm" if self.layer_norm else None,
+            dtype=self.dtype,
+            name="model",
+        )(x)
+
+
+class PPOActor(nn.Module):
+    """MLP backbone + one head per action dim (reference: agent.py:72-88)."""
+
+    actions_dim: Sequence[int]
+    is_continuous: bool
+    dense_units: int = 64
+    mlp_layers: int = 2
+    dense_act: str = "relu"
+    layer_norm: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> List[jax.Array]:
+        if self.mlp_layers > 0:
+            x = MLP(
+                hidden_sizes=[self.dense_units] * self.mlp_layers,
+                activation=self.dense_act,
+                norm_layer="layer_norm" if self.layer_norm else None,
+                dtype=self.dtype,
+                name="backbone",
+            )(x)
+        if self.is_continuous:
+            return [nn.Dense(sum(self.actions_dim) * 2, dtype=self.dtype, name="head_0")(x)]
+        return [
+            nn.Dense(dim, dtype=self.dtype, name=f"head_{i}")(x) for i, dim in enumerate(self.actions_dim)
+        ]
+
+
+class PPOAgentModule(nn.Module):
+    """Full PPO parameter set: MultiEncoder features → actor outs + value
+    (reference: PPOAgent, agent.py:91-184)."""
+
+    actions_dim: Sequence[int]
+    is_continuous: bool
+    cnn_keys: Sequence[str]
+    mlp_keys: Sequence[str]
+    encoder_cfg: Dict[str, Any]
+    actor_cfg: Dict[str, Any]
+    critic_cfg: Dict[str, Any]
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> Tuple[List[jax.Array], jax.Array]:
+        cnn_encoder = (
+            CNNEncoder(
+                keys=list(self.cnn_keys),
+                features_dim=self.encoder_cfg["cnn_features_dim"],
+                dtype=self.dtype,
+                name="cnn_encoder",
+            )
+            if len(self.cnn_keys) > 0
+            else None
+        )
+        mlp_encoder = (
+            MLPEncoder(
+                keys=list(self.mlp_keys),
+                features_dim=self.encoder_cfg["mlp_features_dim"],
+                dense_units=self.encoder_cfg["dense_units"],
+                mlp_layers=self.encoder_cfg["mlp_layers"],
+                dense_act=self.encoder_cfg["dense_act"],
+                layer_norm=self.encoder_cfg["layer_norm"],
+                dtype=self.dtype,
+                name="mlp_encoder",
+            )
+            if len(self.mlp_keys) > 0
+            else None
+        )
+        feat = MultiEncoder(cnn_encoder, mlp_encoder, name="feature_extractor")(obs)
+        actor_out = PPOActor(
+            actions_dim=self.actions_dim,
+            is_continuous=self.is_continuous,
+            dense_units=self.actor_cfg["dense_units"],
+            mlp_layers=self.actor_cfg["mlp_layers"],
+            dense_act=self.actor_cfg["dense_act"],
+            layer_norm=self.actor_cfg["layer_norm"],
+            dtype=self.dtype,
+            name="actor",
+        )(feat)
+        values = MLP(
+            hidden_sizes=[self.critic_cfg["dense_units"]] * self.critic_cfg["mlp_layers"],
+            output_dim=1,
+            activation=self.critic_cfg["dense_act"],
+            norm_layer="layer_norm" if self.critic_cfg["layer_norm"] else None,
+            dtype=self.dtype,
+            name="critic",
+        )(feat)
+        return actor_out, values
+
+
+def _tanh_correction(tanh_actions: jax.Array) -> jax.Array:
+    """Summed log|d tanh/dx| with the softplus-stable formula
+    (reference: agent.py:201-205)."""
+    return 2.0 * (jnp.log(2.0) - tanh_actions - jax.nn.softplus(-2.0 * tanh_actions)).sum(-1)
+
+
+@dataclass(frozen=True)
+class PPOAgent:
+    """Bundles the module with the action-space metadata the pure functions
+    need. `params` live outside (passed explicitly) — the player/trainer
+    split of the reference becomes call-site jit boundaries."""
+
+    module: PPOAgentModule
+    actions_dim: Tuple[int, ...]
+    is_continuous: bool
+    distribution: str  # "normal" | "tanh_normal" | "discrete"
+
+    # ----------------------------------------------------------- training
+    def evaluate_actions(
+        self, params: Any, obs: Dict[str, jax.Array], actions: jax.Array
+    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """(logprobs[B,1], entropy[B,1], values[B,1]) for stored `actions`
+        (concatenated one-hots / raw continuous), reference agent.forward
+        (agent.py:208-239)."""
+        actor_out, values = self.module.apply(params, obs)
+        if self.is_continuous:
+            mean, log_std = jnp.split(actor_out[0], 2, axis=-1)
+            dist = Independent(Normal(mean, jnp.exp(log_std)), 1)
+            if self.distribution == "tanh_normal":
+                tanh_actions = actions
+                raw = safeatanh(tanh_actions, _EPS)
+                logprob = dist.log_prob(raw) - _tanh_correction(tanh_actions)
+            else:
+                logprob = dist.log_prob(actions)
+            return logprob[..., None], dist.entropy()[..., None], values
+        logprobs = []
+        entropies = []
+        splits = np.cumsum(self.actions_dim)[:-1]
+        per_dim_actions = jnp.split(actions, splits, axis=-1)
+        for logits, act in zip(actor_out, per_dim_actions):
+            dist = OneHotCategorical(logits=logits)
+            logprobs.append(dist.log_prob(act))
+            entropies.append(dist.entropy())
+        return (
+            jnp.stack(logprobs, -1).sum(-1, keepdims=True),
+            jnp.stack(entropies, -1).sum(-1, keepdims=True),
+            values,
+        )
+
+    # ------------------------------------------------------------- player
+    def player_step(
+        self, params: Any, obs: Dict[str, jax.Array], key: jax.Array
+    ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+        """Sample actions for the rollout: (actions_cat, real_actions,
+        logprobs[B,1], values[B,1]); real_actions is what the env consumes
+        (indices for discrete, raw for continuous) — reference PPOPlayer
+        (agent.py:271-293)."""
+        actor_out, values = self.module.apply(params, obs)
+        if self.is_continuous:
+            mean, log_std = jnp.split(actor_out[0], 2, axis=-1)
+            dist = Independent(Normal(mean, jnp.exp(log_std)), 1)
+            actions = dist.sample(key)
+            if self.distribution == "tanh_normal":
+                tanh_actions = safetanh(actions, _EPS)
+                logprob = dist.log_prob(actions) - _tanh_correction(tanh_actions)
+                actions = tanh_actions
+            else:
+                logprob = dist.log_prob(actions)
+            return actions, actions, logprob[..., None], values
+        actions = []
+        real_actions = []
+        logprobs = []
+        keys = jax.random.split(key, len(actor_out))
+        for logits, k in zip(actor_out, keys):
+            dist = OneHotCategorical(logits=logits)
+            a = dist.sample(k)
+            actions.append(a)
+            real_actions.append(jnp.argmax(a, axis=-1))
+            logprobs.append(dist.log_prob(a))
+        return (
+            jnp.concatenate(actions, -1),
+            jnp.stack(real_actions, -1),
+            jnp.stack(logprobs, -1).sum(-1, keepdims=True),
+            values,
+        )
+
+    def get_values(self, params: Any, obs: Dict[str, jax.Array]) -> jax.Array:
+        _, values = self.module.apply(params, obs)
+        return values
+
+    def get_actions(
+        self, params: Any, obs: Dict[str, jax.Array], key: Optional[jax.Array] = None, greedy: bool = False
+    ) -> jax.Array:
+        """Env-facing actions only (test/eval path) — reference
+        PPOPlayer.get_actions (agent.py:299-322)."""
+        actor_out, _ = self.module.apply(params, obs)
+        if self.is_continuous:
+            mean, log_std = jnp.split(actor_out[0], 2, axis=-1)
+            if greedy:
+                actions = mean
+            else:
+                actions = Independent(Normal(mean, jnp.exp(log_std)), 1).sample(key)
+            if self.distribution == "tanh_normal":
+                actions = safetanh(actions, _EPS)
+            return actions
+        real_actions = []
+        keys = jax.random.split(key, len(actor_out)) if key is not None else [None] * len(actor_out)
+        for logits, k in zip(actor_out, keys):
+            dist = OneHotCategorical(logits=logits)
+            a = dist.mode if greedy else dist.sample(k)
+            real_actions.append(jnp.argmax(a, axis=-1))
+        return jnp.stack(real_actions, -1)
+
+
+def actions_metadata(action_space) -> Tuple[Tuple[int, ...], bool]:
+    """(actions_dim, is_continuous) from a gymnasium action space
+    (reference pattern: ppo.py:165-171)."""
+    is_continuous = isinstance(action_space, gymnasium.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gymnasium.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    return actions_dim, is_continuous
+
+
+def build_agent(
+    runtime,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+    agent_state: Optional[Any] = None,
+) -> Tuple[PPOAgent, Any]:
+    """Construct module + initial (or restored) params
+    (reference: build_agent, agent.py:325-369 — no Fabric/DDP setup needed:
+    sharding is decided by the jit call sites)."""
+    distribution = str(cfg.distribution.get("type", "auto")).lower()
+    if distribution not in ("auto", "normal", "tanh_normal", "discrete"):
+        raise ValueError(
+            "The distribution must be on of: `auto`, `discrete`, `normal` and `tanh_normal`. "
+            f"Found: {distribution}"
+        )
+    if distribution == "discrete" and is_continuous:
+        raise ValueError("You have choose a discrete distribution but `is_continuous` is true")
+    if distribution not in ("discrete", "auto") and not is_continuous:
+        raise ValueError("You have choose a continuous distribution but `is_continuous` is false")
+    if distribution == "auto":
+        distribution = "normal" if is_continuous else "discrete"
+
+    module = PPOAgentModule(
+        actions_dim=tuple(actions_dim),
+        is_continuous=is_continuous,
+        cnn_keys=tuple(cfg.algo.cnn_keys.encoder),
+        mlp_keys=tuple(cfg.algo.mlp_keys.encoder),
+        encoder_cfg=dict(cfg.algo.encoder),
+        actor_cfg=dict(cfg.algo.actor),
+        critic_cfg=dict(cfg.algo.critic),
+        dtype=runtime.precision.compute_dtype,
+    )
+    agent = PPOAgent(
+        module=module,
+        actions_dim=tuple(int(d) for d in actions_dim),
+        is_continuous=is_continuous,
+        distribution=distribution,
+    )
+    if agent_state is not None:
+        params = jax.tree_util.tree_map(jnp.asarray, agent_state)
+    else:
+        sample_obs = {
+            k: jnp.zeros((1, *obs_space[k].shape), jnp.float32)
+            for k in list(cfg.algo.cnn_keys.encoder) + list(cfg.algo.mlp_keys.encoder)
+        }
+        params = module.init(runtime.root_key, sample_obs)
+    return agent, params

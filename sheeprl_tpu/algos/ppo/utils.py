@@ -1,0 +1,65 @@
+"""PPO auxiliary contract: aggregator keys, obs preparation, greedy test.
+
+Parity: sheeprl/algos/ppo/utils.py:21-72 (AGGREGATOR_KEYS, MODELS_TO_REGISTER,
+prepare_obs/normalize_obs pixel scaling, greedy `test`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.utils.env import make_env
+
+AGGREGATOR_KEYS = {"Rewards/rew_avg", "Game/ep_len_avg", "Loss/value_loss", "Loss/policy_loss", "Loss/entropy_loss"}
+MODELS_TO_REGISTER = {"agent"}
+
+
+def normalize_obs(
+    obs: Dict[str, jax.Array], cnn_keys: Sequence[str], obs_keys: Sequence[str]
+) -> Dict[str, jax.Array]:
+    """Pixel keys → [-0.5, 0.5] floats (reference: utils.py:69-72). Called
+    inside jit so uint8 frames cross host→device untouched."""
+    return {k: obs[k] / 255.0 - 0.5 if k in cnn_keys else obs[k] for k in obs_keys}
+
+
+def prepare_obs(
+    obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), num_envs: int = 1, **kwargs: Any
+) -> Dict[str, jax.Array]:
+    """Host obs dict → float device arrays [num_envs, ...] with pixel scaling
+    (reference: utils.py:25-35; no CHW reshape — pixels are already HWC)."""
+    jnp_obs = {}
+    for k, v in obs.items():
+        arr = jnp.asarray(v)
+        if k not in cnn_keys:
+            arr = arr.reshape(num_envs, -1)
+        else:
+            arr = arr.reshape(num_envs, *arr.shape[-3:])
+        jnp_obs[k] = arr.astype(jnp.float32)
+    return normalize_obs(jnp_obs, cnn_keys, list(jnp_obs.keys()))
+
+
+def test(agent, params, runtime, cfg: Dict[str, Any], log_dir: str, logger=None) -> float:
+    """One greedy episode + cumulative-reward logging
+    (reference: utils.py:38-66)."""
+    env = make_env(cfg, None, 0, log_dir, "test", vector_env_idx=0)()
+    done = False
+    cumulative_rew = 0.0
+    obs = env.reset(seed=cfg.seed)[0]
+    get_actions = jax.jit(lambda p, o: agent.get_actions(p, o, greedy=True))
+    while not done:
+        jnp_obs = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder)
+        real_actions = np.asarray(get_actions(params, jnp_obs))
+        obs, reward, done, truncated, _ = env.step(real_actions.reshape(env.action_space.shape))
+        done = done or truncated
+        cumulative_rew += reward
+        if cfg.dry_run:
+            done = True
+    runtime.print("Test - Reward:", cumulative_rew)
+    if cfg.metric.log_level > 0 and logger is not None:
+        logger.log_dict({"Test/cumulative_reward": cumulative_rew}, 0)
+    env.close()
+    return cumulative_rew

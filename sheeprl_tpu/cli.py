@@ -1,0 +1,232 @@
+"""CLI dispatcher: config composition → registry lookup → Runtime → algorithm.
+
+Parity with the reference CLI (sheeprl/cli.py:23-450): `run` composes the
+config (native composition engine instead of Hydra), handles resume-config
+merging, prunes metric/model-manager keys against the algorithm's declared
+sets, instantiates the substrate (Runtime instead of Fabric), seeds, and
+invokes the registered entrypoint. `evaluation` rebuilds a single-device
+runtime from a checkpoint's saved config and calls the registered eval fn.
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import pathlib
+import sys
+import warnings
+from typing import Any, Dict, List, Optional, Sequence
+
+from sheeprl_tpu.config.instantiate import instantiate
+from sheeprl_tpu.config.loader import compose
+from sheeprl_tpu.registry import algorithm_registry, evaluation_registry
+from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import dotdict, print_config
+
+
+def resume_from_checkpoint(cfg: dotdict) -> dotdict:
+    """Force-merge the original run's config.yaml, keeping the new run's
+    total_steps/paths (reference: cli.py:23-57)."""
+    import yaml
+
+    ckpt_path = pathlib.Path(cfg.checkpoint.resume_from)
+    with open(ckpt_path.parent.parent / "config.yaml") as fp:
+        old_cfg = dotdict(yaml.safe_load(fp))
+    if old_cfg.env.id != cfg.env.id:
+        raise ValueError(
+            "This experiment is run with a different environment from the one of the experiment you want to restart. "
+            f"Got '{cfg.env.id}', but the environment of the experiment of the checkpoint was {old_cfg.env.id}. "
+            "Set properly the environment for restarting the experiment."
+        )
+    if old_cfg.algo.name != cfg.algo.name:
+        raise ValueError(
+            "This experiment is run with a different algorithm from the one of the experiment you want to restart. "
+            f"Got '{cfg.algo.name}', but the algorithm of the experiment of the checkpoint was {old_cfg.algo.name}. "
+            "Set properly the algorithm name for restarting the experiment."
+        )
+    if old_cfg.algo.get("learning_starts", 0) > 0:
+        warnings.warn(
+            "The `algo.learning_starts` parameter is greater than zero. "
+            "This means that the resuming experiment will pre-fill the buffer for `algo.learning_starts` steps. "
+            "If this is not intended please set the `algo.learning_starts=0` parameter in the experiment "
+            "configuration or through the CLI."
+        )
+    old = old_cfg.as_dict()
+    old.pop("root_dir", None)
+    old.pop("run_name", None)
+    old.get("algo", {}).pop("total_steps", None)
+    old.get("algo", {}).pop("learning_starts", None)
+    old.get("checkpoint", {}).pop("resume_from", None)
+
+    def merge(dst: Dict[str, Any], src: Dict[str, Any]) -> None:
+        for k, v in src.items():
+            if isinstance(v, dict) and isinstance(dst.get(k), dict):
+                merge(dst[k], v)
+            else:
+                dst[k] = v
+
+    merged = cfg.as_dict()
+    merge(merged, old)
+    return dotdict(merged)
+
+
+def check_configs(cfg: dotdict) -> None:
+    """Imperative config validation (reference: cli.py:271-345, minus the
+    DDP-strategy matrix that has no JAX counterpart)."""
+    if cfg.algo.name not in algorithm_registry:
+        raise RuntimeError(
+            f"Given the algorithm named '{cfg.algo.name}', no entrypoint has been registered. "
+            f"Available: {sorted(algorithm_registry)}"
+        )
+    accelerator = str(cfg.fabric.get("accelerator", "auto")).lower()
+    if accelerator not in ("auto", "cpu", "tpu", "axon"):
+        raise ValueError(f"Unknown fabric.accelerator '{accelerator}'. Valid: auto | cpu | tpu | axon")
+    entry = algorithm_registry[cfg.algo.name]
+    if entry.decoupled and int(os.environ.get("SHEEPRL_NUM_PROCS", "1")) < 2 and cfg.fabric.get("devices", 1) in (1, "1"):
+        raise RuntimeError(
+            f"The decoupled algorithm '{cfg.algo.name}' requires at least 2 devices/processes "
+            "(one player + at least one trainer)."
+        )
+
+
+def _prune_metric_and_model_keys(cfg: dotdict, utils_module) -> None:
+    """Keep only the metric/model keys the algorithm declares
+    (reference: cli.py:151-181)."""
+    if cfg.get("metric") is not None:
+        predefined = set()
+        if not hasattr(utils_module, "AGGREGATOR_KEYS"):
+            warnings.warn(
+                f"No 'AGGREGATOR_KEYS' set found for the {cfg.algo.name} algorithm. No metric will be logged.",
+                UserWarning,
+            )
+        else:
+            predefined = utils_module.AGGREGATOR_KEYS
+        timer.disabled = cfg.metric.log_level == 0 or cfg.metric.disable_timer
+        for k in set(cfg.metric.aggregator.metrics.keys()) - predefined:
+            cfg.metric.aggregator.metrics.pop(k, None)
+        MetricAggregator.disabled = cfg.metric.log_level == 0 or len(cfg.metric.aggregator.metrics) == 0
+
+    if cfg.get("model_manager") is not None and not cfg.model_manager.disabled:
+        predefined = set()
+        if not hasattr(utils_module, "MODELS_TO_REGISTER"):
+            warnings.warn(
+                f"No 'MODELS_TO_REGISTER' set found for the {cfg.algo.name} algorithm. "
+                "No model will be registered.",
+                UserWarning,
+            )
+        else:
+            predefined = utils_module.MODELS_TO_REGISTER
+        for k in set(cfg.model_manager.models.keys()) - predefined:
+            cfg.model_manager.models.pop(k, None)
+        if len(cfg.model_manager.models) == 0:
+            cfg.model_manager.disabled = True
+
+
+def run_algorithm(cfg: dotdict) -> None:
+    """Registry lookup + Runtime construction + entrypoint call
+    (reference: cli.py:60-199; fabric.launch collapses to a plain call —
+    JAX multi-host processes are launched externally, one per host)."""
+    entry = algorithm_registry[cfg.algo.name]
+    task = importlib.import_module(entry.module)
+    utils_module = importlib.import_module(entry.module.rsplit(".", 1)[0] + ".utils")
+    command = task.__dict__[entry.entrypoint.__name__]
+
+    _prune_metric_and_model_keys(cfg, utils_module)
+
+    runtime = instantiate(cfg.fabric)
+    runtime.launch()
+    runtime.seed_everything(cfg.seed)
+    import jax
+
+    # Eager ops and un-sharded jits must land on the chosen accelerator (the
+    # host may pin a different default backend, e.g. a tunneled TPU while the
+    # config selects cpu or vice versa).
+    with jax.default_device(runtime.device):
+        command(runtime, cfg)
+
+
+def run(args: Optional[Sequence[str]] = None) -> None:
+    """Training entry: `python -m sheeprl_tpu exp=... [overrides...]`
+    (reference: cli.run, cli.py:358-366)."""
+    import sheeprl_tpu
+
+    sheeprl_tpu.register_all()
+    overrides = list(args) if args is not None else sys.argv[1:]
+    cfg = compose("config", overrides)
+    os.environ.setdefault("OMP_NUM_THREADS", str(cfg.get("num_threads", 1)))
+    if cfg.checkpoint.resume_from:
+        cfg = resume_from_checkpoint(cfg)
+    if cfg.metric.log_level > 0:
+        print_config(cfg)
+    check_configs(cfg)
+    run_algorithm(cfg)
+
+
+def evaluation(args: Optional[Sequence[str]] = None) -> None:
+    """Evaluation entry: `python -m sheeprl_tpu.eval checkpoint_path=... [overrides]`
+    (reference: cli.evaluation, cli.py:369-405 + eval_algorithm 202-268)."""
+    import yaml
+
+    import sheeprl_tpu
+
+    sheeprl_tpu.register_all()
+    overrides = list(args) if args is not None else sys.argv[1:]
+    ckpt_override = [o for o in overrides if o.startswith("checkpoint_path=")]
+    if not ckpt_override:
+        raise ValueError("You must specify checkpoint_path=<path-to-checkpoint>")
+    checkpoint_path = pathlib.Path(ckpt_override[-1].split("=", 1)[1])
+    rest: List[str] = [o for o in overrides if not o.startswith("checkpoint_path=")]
+
+    with open(checkpoint_path.parent.parent / "config.yaml") as fp:
+        ckpt_cfg = dotdict(yaml.safe_load(fp))
+
+    # Start from the run's config, let CLI overrides win, force eval-time keys.
+    cfg = ckpt_cfg
+    for ov in rest:
+        from sheeprl_tpu.utils.utils import set_by_path
+        from sheeprl_tpu.config.loader import _parse_value
+
+        k, v = ov.split("=", 1)
+        set_by_path(cfg, k.lstrip("+"), _parse_value(v))
+    # <run_name>/<version_N>/evaluation next to the original run
+    # (reference: cli.py:393-401 — root_dir becomes the absolute run root).
+    cfg.root_dir = str(checkpoint_path.parent.parent.parent.parent)
+    cfg.run_name = str(
+        os.path.join(
+            os.path.basename(checkpoint_path.parent.parent.parent),
+            os.path.basename(checkpoint_path.parent.parent),
+            "evaluation",
+        )
+    )
+    cfg.checkpoint.resume_from = str(checkpoint_path)
+    cfg.env.num_envs = 1
+    cfg.fabric = dotdict(
+        {
+            "_target_": cfg.fabric.get("_target_", "sheeprl_tpu.core.runtime.Runtime"),
+            "devices": 1,
+            "num_nodes": 1,
+            "strategy": "single_device",
+            "accelerator": cfg.fabric.get("accelerator", "auto"),
+            "precision": cfg.fabric.get("precision", "32-true"),
+            "model_axis": 1,
+        }
+    )
+
+    if cfg.algo.name not in evaluation_registry:
+        raise RuntimeError(
+            f"Given the algorithm named '{cfg.algo.name}', no evaluation entrypoint has been registered. "
+            f"Available: {sorted(evaluation_registry)}"
+        )
+    entry = evaluation_registry[cfg.algo.name]
+    task = importlib.import_module(entry.module)
+    command = task.__dict__[entry.entrypoint.__name__]
+
+    from sheeprl_tpu.utils.checkpoint import load_checkpoint
+
+    state = load_checkpoint(str(checkpoint_path))
+
+    runtime = instantiate(cfg.fabric)
+    runtime.launch()
+    runtime.seed_everything(cfg.seed)
+    command(runtime, cfg, state)

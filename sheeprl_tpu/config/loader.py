@@ -41,6 +41,29 @@ _PACKAGE_RE = re.compile(r"^#\s*@package\s+(\S+)\s*$", re.MULTILINE)
 _INTERP_RE = re.compile(r"\$\{([^${}]+)\}")
 
 
+class _YamlLoader(yaml.SafeLoader):
+    """SafeLoader with YAML-1.2 float resolution: PyYAML's 1.1 regex parses
+    `1e-4` (no dot) as a *string*, which silently poisons optimizer configs."""
+
+
+_YamlLoader.add_implicit_resolver(
+    "tag:yaml.org,2002:float",
+    re.compile(
+        r"""^(?:[-+]?(?:[0-9][0-9_]*)\.[0-9_]*(?:[eE][-+]?[0-9]+)?
+        |[-+]?(?:[0-9][0-9_]*)(?:[eE][-+]?[0-9]+)
+        |\.[0-9_]+(?:[eE][-+][0-9]+)?
+        |[-+]?\.(?:inf|Inf|INF)
+        |\.(?:nan|NaN|NAN))$""",
+        re.X,
+    ),
+    list("-+0123456789."),
+)
+
+
+def _yaml_load(text: str) -> Any:
+    return yaml.load(text, Loader=_YamlLoader)
+
+
 class ConfigError(Exception):
     pass
 
@@ -127,7 +150,7 @@ class Composer:
             text = fp.read()
         pkg_match = _PACKAGE_RE.search(text)
         pkg_header = pkg_match.group(1) if pkg_match else None
-        content = yaml.safe_load(text) or {}
+        content = _yaml_load(text) or {}
         if not isinstance(content, dict):
             raise ConfigError(f"Config file {path} must contain a mapping at top level")
         defaults = content.pop("defaults", [])
@@ -321,7 +344,7 @@ class Composer:
 
 def _parse_value(text: str) -> Any:
     try:
-        return yaml.safe_load(text)
+        return _yaml_load(text)
     except yaml.YAMLError:
         return text
 

@@ -1,0 +1,129 @@
+"""Checkpoint write/read with keep-last garbage collection.
+
+Parity with the reference CheckpointCallback (sheeprl/utils/callback.py:14-148):
+state = model params + optimizer states + counters (+ algorithm extras such as
+replay buffers), written at `<log_dir>/checkpoint/ckpt_<policy_step>_<rank>.ckpt`
+with at most `keep_last` checkpoints retained.
+
+Backend: Orbax `StandardCheckpointer` over a pure-numpy pytree — every jax
+Array is pulled to host first so saves never hold device memory, and restores
+return numpy leaves that algorithms re-shard themselves (the TPU equivalent of
+torch's map_location). A checkpoint is a *directory* (Orbax layout), not a
+single file; the `.ckpt` suffix is kept for reference-parity path printing.
+Non-array leaves (ints, floats, strings, None) are pickled alongside in
+`aux.pkl` because Orbax handles only array-like leaves.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import re
+import shutil
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+_CKPT_RE = re.compile(r"ckpt_(\d+)_\d+\.ckpt$")
+
+_ARRAY_TYPES = (np.ndarray, np.generic, jax.Array)
+
+
+def _split_state(tree: Any, path: str = ""):
+    """Split a pytree into (array-only tree with None placeholders, aux dict
+    of path->non-array leaf)."""
+    aux: Dict[str, Any] = {}
+
+    def walk(node: Any, prefix: str) -> Any:
+        if isinstance(node, dict):
+            return {k: walk(v, f"{prefix}/{k}") for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            walked = [walk(v, f"{prefix}/{i}") for i, v in enumerate(node)]
+            if hasattr(node, "_fields"):  # namedtuple (optax states)
+                return type(node)(*walked)
+            return tuple(walked) if isinstance(node, tuple) else walked
+        if isinstance(node, _ARRAY_TYPES):
+            return np.asarray(node)
+        aux[prefix] = node
+        return None
+
+    return walk(tree, path), aux
+
+
+def _merge_state(tree: Any, aux: Dict[str, Any], path: str = "") -> Any:
+    def walk(node: Any, prefix: str) -> Any:
+        if isinstance(node, dict):
+            return {k: walk(v, f"{prefix}/{k}") for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            walked = [walk(v, f"{prefix}/{i}") for i, v in enumerate(node)]
+            if hasattr(node, "_fields"):
+                return type(node)(*walked)
+            return tuple(walked) if isinstance(node, tuple) else walked
+        if node is None and prefix in aux:
+            return aux[prefix]
+        return node
+
+    return walk(tree, path)
+
+
+def save_checkpoint(ckpt_path: str, state: Dict[str, Any], keep_last: Optional[int] = None) -> str:
+    """Write `state` (pytree) to `ckpt_path` and GC old checkpoints in the
+    same directory down to `keep_last` (reference: callback.py:30-38,144-148).
+    """
+    import orbax.checkpoint as ocp
+
+    ckpt_path = os.path.abspath(ckpt_path)
+    os.makedirs(os.path.dirname(ckpt_path), exist_ok=True)
+    host_state = jax.tree_util.tree_map(lambda x: np.asarray(x) if isinstance(x, jax.Array) else x, state)
+    arrays, aux = _split_state(host_state)
+    if os.path.exists(ckpt_path):
+        shutil.rmtree(ckpt_path)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(ckpt_path, arrays)
+    with open(os.path.join(ckpt_path, "aux.pkl"), "wb") as fp:
+        pickle.dump(aux, fp)
+    if keep_last is not None and keep_last > 0:
+        _gc_old_checkpoints(os.path.dirname(ckpt_path), keep_last)
+    return ckpt_path
+
+
+def load_checkpoint(ckpt_path: str, target: Optional[Any] = None) -> Dict[str, Any]:
+    """Restore a checkpoint as a pytree of numpy leaves.
+
+    Without `target`, Orbax returns generic containers (tuples/namedtuples
+    come back as lists) — fine for counters and raw params. Pass a template
+    pytree of the same structure (e.g. a freshly initialized train state) to
+    restore exact container types, the moral equivalent of the reference's
+    `load_state_dict` onto freshly-built modules.
+    """
+    import orbax.checkpoint as ocp
+
+    ckpt_path = os.path.abspath(ckpt_path)
+    aux_file = os.path.join(ckpt_path, "aux.pkl")
+    aux: Dict[str, Any] = {}
+    if os.path.exists(aux_file):
+        with open(aux_file, "rb") as fp:
+            aux = pickle.load(fp)
+    with ocp.StandardCheckpointer() as ckptr:
+        if target is not None:
+            template, _ = _split_state(
+                jax.tree_util.tree_map(lambda x: np.asarray(x) if isinstance(x, jax.Array) else x, target)
+            )
+            arrays = ckptr.restore(ckpt_path, template)
+        else:
+            arrays = ckptr.restore(ckpt_path)
+    return _merge_state(arrays, aux)
+
+
+def _gc_old_checkpoints(ckpt_dir: str, keep_last: int) -> None:
+    """Delete all but the newest `keep_last` checkpoints, ordered by the
+    policy-step embedded in the name (reference: callback.py:144-148)."""
+    entries = []
+    for name in os.listdir(ckpt_dir):
+        m = _CKPT_RE.search(name)
+        if m:
+            entries.append((int(m.group(1)), os.path.join(ckpt_dir, name)))
+    entries.sort()
+    for _, path in entries[:-keep_last] if keep_last < len(entries) else []:
+        shutil.rmtree(path, ignore_errors=True)

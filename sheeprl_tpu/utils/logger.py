@@ -1,0 +1,129 @@
+"""Experiment loggers.
+
+Parity with the reference logger layer (sheeprl/utils/logger.py:12-89):
+rank-0-only logger creation, versioned run directories, and log-dir sharing
+across processes. TensorBoard writing uses tensorboardX; the multi-process
+log-dir broadcast uses jax.experimental.multihost_utils instead of a
+TorchCollective gloo group (DCN-level host sync, no NCCL/gloo).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import warnings
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from sheeprl_tpu.config.instantiate import instantiate
+
+
+class TensorBoardLogger:
+    """Thin tensorboardX wrapper exposing the log/log_dict/log_hyperparams
+    surface the algorithms use (reference calls fabric.log/log_dict)."""
+
+    def __init__(self, root_dir: str, run_name: str):
+        self.root_dir = root_dir
+        self.run_name = run_name
+        self._log_dir = _versioned_dir(os.path.join(root_dir, run_name))
+        self._writer = None
+
+    @property
+    def log_dir(self) -> str:
+        return self._log_dir
+
+    @property
+    def writer(self):
+        if self._writer is None:
+            from tensorboardX import SummaryWriter
+
+            os.makedirs(self._log_dir, exist_ok=True)
+            self._writer = SummaryWriter(self._log_dir)
+        return self._writer
+
+    def log(self, name: str, value: Any, step: int) -> None:
+        self.writer.add_scalar(name, float(np.asarray(value)), step)
+
+    def log_dict(self, metrics: Dict[str, Any], step: int) -> None:
+        for k, v in metrics.items():
+            self.log(k, v, step)
+
+    def log_hyperparams(self, cfg: Dict[str, Any]) -> None:
+        os.makedirs(self._log_dir, exist_ok=True)
+        try:
+            with open(os.path.join(self._log_dir, "hparams.json"), "w") as fp:
+                json.dump(cfg, fp, default=str, indent=2)
+        except TypeError:  # pragma: no cover - unserializable config values
+            pass
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+
+
+def _versioned_dir(save_dir: str) -> str:
+    """logs/.../run_name/version_N with N = max existing + 1
+    (reference: sheeprl/utils/logger.py:66-85)."""
+    try:
+        existing = [
+            int(d.split("_")[1])
+            for d in os.listdir(save_dir)
+            if d.startswith("version_") and os.path.isdir(os.path.join(save_dir, d))
+        ]
+    except OSError:
+        existing = []
+    version = max(existing) + 1 if existing else 0
+    return os.path.join(save_dir, f"version_{version}")
+
+
+def get_logger(runtime, cfg: Dict[str, Any]) -> Optional[TensorBoardLogger]:
+    """Rank-0-only logger instantiation (reference: logger.py:12-38)."""
+    if not runtime.is_global_zero or cfg.metric.log_level <= 0:
+        return None
+    target = str(cfg.metric.logger.get("_target_", ""))
+    if "tensorboard" in target.lower():
+        root_dir = os.path.join(cfg.log_root, cfg.root_dir)
+        if root_dir != cfg.metric.logger.root_dir:
+            warnings.warn(
+                "The specified root directory for the TensorBoardLogger is different from the experiment one, "
+                "so the logger one will be ignored and replaced with the experiment root directory",
+                UserWarning,
+            )
+        cfg.metric.logger.root_dir = root_dir
+        cfg.metric.logger.run_name = cfg.run_name
+    return instantiate(cfg.metric.logger)
+
+
+def get_log_dir(runtime, root_dir: str, run_name: str, share: bool = True, logger=None) -> str:
+    """Create (rank-0) and share the run's log directory
+    (reference: logger.py:41-89)."""
+    import jax
+
+    log_dir: Optional[str] = None
+    if runtime.is_global_zero:
+        if logger is not None and logger.log_dir is not None:
+            log_dir = logger.log_dir
+        else:
+            log_dir = _versioned_dir(os.path.join("logs", "runs", root_dir, run_name))
+        os.makedirs(log_dir, exist_ok=True)
+    if jax.process_count() > 1 and share:
+        log_dir = _broadcast_str(log_dir)
+    return log_dir
+
+
+def _broadcast_str(value: Optional[str]) -> str:
+    """Broadcast a string from process 0 to all processes via a fixed-size
+    uint8 buffer (multihost_utils broadcasts arrays, not objects)."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    max_len = 4096
+    buf = np.zeros(max_len, np.uint8)
+    if jax.process_index() == 0 and value is not None:
+        raw = value.encode()[:max_len]
+        buf[: len(raw)] = np.frombuffer(raw, np.uint8)
+    out = multihost_utils.broadcast_one_to_all(buf)
+    out = np.asarray(out).tobytes().rstrip(b"\x00")
+    return out.decode()

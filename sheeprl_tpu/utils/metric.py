@@ -1,0 +1,210 @@
+"""Metric aggregation (host-side, numpy).
+
+API parity with the reference's torchmetrics-backed aggregator
+(sheeprl/utils/metric.py:17-196) without the torch dependency: metrics are
+tiny host accumulators updated with numbers/arrays (jax.Array values are
+pulled to host — call sites pass already-computed scalars, so this never
+forces a device sync inside a hot loop). `sync_on_compute` is accepted for
+config parity; cross-process reduction is the caller's concern (single-host
+runs dominate on TPU, and multi-host metric sync happens through the logger).
+"""
+
+from __future__ import annotations
+
+import warnings
+from math import isnan
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class MetricAggregatorException(Exception):
+    """A custom exception used to report errors in use of the aggregator."""
+
+
+class Metric:
+    """Minimal metric interface: update / compute / reset."""
+
+    def __init__(self, sync_on_compute: bool = False):
+        self.sync_on_compute = sync_on_compute
+        self.reset()
+
+    def update(self, value: Any) -> None:
+        raise NotImplementedError
+
+    def compute(self) -> float:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    @staticmethod
+    def _to_float(value: Any) -> float:
+        arr = np.asarray(value, dtype=np.float64)
+        return float(arr.mean()) if arr.ndim > 0 else float(arr)
+
+
+class MeanMetric(Metric):
+    def update(self, value: Any) -> None:
+        arr = np.asarray(value, dtype=np.float64).reshape(-1)
+        self._sum += float(arr.sum())
+        self._count += arr.size
+
+    def compute(self) -> float:
+        return self._sum / self._count if self._count else float("nan")
+
+    def reset(self) -> None:
+        self._sum = 0.0
+        self._count = 0
+
+
+class SumMetric(Metric):
+    def update(self, value: Any) -> None:
+        self._sum += float(np.asarray(value, dtype=np.float64).sum())
+
+    def compute(self) -> float:
+        return self._sum
+
+    def reset(self) -> None:
+        self._sum = 0.0
+
+
+class MaxMetric(Metric):
+    def update(self, value: Any) -> None:
+        self._max = max(self._max, float(np.asarray(value, dtype=np.float64).max()))
+
+    def compute(self) -> float:
+        return self._max
+
+    def reset(self) -> None:
+        self._max = float("-inf")
+
+
+class MinMetric(Metric):
+    def update(self, value: Any) -> None:
+        self._min = min(self._min, float(np.asarray(value, dtype=np.float64).min()))
+
+    def compute(self) -> float:
+        return self._min
+
+    def reset(self) -> None:
+        self._min = float("inf")
+
+
+class LastMetric(Metric):
+    def update(self, value: Any) -> None:
+        self._last = self._to_float(value)
+
+    def compute(self) -> float:
+        return self._last
+
+    def reset(self) -> None:
+        self._last = float("nan")
+
+
+class MetricAggregator:
+    """Aggregate named metrics (reference: sheeprl/utils/metric.py:17-143).
+
+    The class-level `disabled` flag mirrors the reference's global disable
+    (set from `metric.log_level == 0` at startup); `compute()` drops NaN
+    results the same way.
+    """
+
+    disabled: bool = False
+
+    def __init__(self, metrics: Optional[Dict[str, Metric]] = None, raise_on_missing: bool = False):
+        self.metrics: Dict[str, Metric] = metrics if metrics is not None else {}
+        self._raise_on_missing = raise_on_missing
+
+    def __iter__(self):
+        return iter(self.metrics.keys())
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.metrics
+
+    def add(self, name: str, metric: Metric) -> None:
+        if self.disabled:
+            return
+        if name not in self.metrics:
+            self.metrics[name] = metric
+        elif self._raise_on_missing:
+            raise MetricAggregatorException(f"Metric {name} already exists")
+        else:
+            warnings.warn(f"The key '{name}' is already in the metric aggregator. Nothing will be added.", UserWarning)
+
+    def update(self, name: str, value: Any) -> None:
+        if self.disabled:
+            return
+        if name not in self.metrics:
+            if self._raise_on_missing:
+                raise MetricAggregatorException(f"Metric {name} does not exist")
+            warnings.warn(f"The key '{name}' is missing from the metric aggregator. Nothing will be added.", UserWarning)
+            return
+        self.metrics[name].update(value)
+
+    def pop(self, name: str) -> None:
+        if self.disabled:
+            return
+        if name not in self.metrics:
+            if self._raise_on_missing:
+                raise MetricAggregatorException(f"Metric {name} does not exist")
+            warnings.warn(f"The key '{name}' is missing from the metric aggregator. Nothing will be popped.", UserWarning)
+        self.metrics.pop(name, None)
+
+    def reset(self) -> None:
+        if self.disabled:
+            return
+        for metric in self.metrics.values():
+            metric.reset()
+
+    def to(self, device: Any = None) -> "MetricAggregator":
+        # Device-placement no-op: metrics live on host (kept for API parity).
+        return self
+
+    def compute(self) -> Dict[str, float]:
+        reduced: Dict[str, float] = {}
+        if self.disabled:
+            return reduced
+        for k, v in self.metrics.items():
+            value = v.compute()
+            if isinstance(value, float) and isnan(value):
+                continue
+            reduced[k] = value
+        return reduced
+
+
+class RankIndependentMetricAggregator:
+    """Per-rank metric streams (reference: sheeprl/utils/metric.py:146-196).
+
+    compute() returns the per-process values as a list indexed by process;
+    on a single host that is a one-element list. Multi-host gathering uses
+    jax.experimental.multihost_utils when more than one process is present.
+    """
+
+    def __init__(self, metrics: "Dict[str, Metric] | MetricAggregator") -> None:
+        self._aggregator = metrics if isinstance(metrics, MetricAggregator) else MetricAggregator(metrics)
+        for m in self._aggregator.metrics.values():
+            m.sync_on_compute = False
+
+    def update(self, name: str, value: Any) -> None:
+        self._aggregator.update(name, value)
+
+    def compute(self) -> List[Dict[str, float]]:
+        computed = self._aggregator.compute()
+        import jax
+
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            gathered = multihost_utils.process_allgather(
+                {k: np.asarray(v, np.float64) for k, v in computed.items()}
+            )
+            n = jax.process_count()
+            return [{k: float(np.asarray(v)[i]) for k, v in gathered.items()} for i in range(n)]
+        return [computed]
+
+    def to(self, device: Any = None) -> "RankIndependentMetricAggregator":
+        return self
+
+    def reset(self) -> None:
+        self._aggregator.reset()

@@ -1,0 +1,62 @@
+"""Phase wall-clock timers.
+
+Parity with the reference `timer` ContextDecorator
+(sheeprl/utils/timer.py:16-84): accumulates elapsed seconds per key into a
+process-global store, with a global disable flag, compute() and reset().
+On TPU the caller is responsible for bounding timed regions with
+`jax.block_until_ready` where async dispatch would make wall-clock lie
+(the algorithms do this around their jitted update calls).
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import ContextDecorator
+from typing import Any, ClassVar, Dict, Optional
+
+
+class TimerError(Exception):
+    """A custom exception used to report errors in use of timer class."""
+
+
+class timer(ContextDecorator):
+    disabled: ClassVar[bool] = False
+    timers: ClassVar[Dict[str, float]] = {}
+    _start_times: ClassVar[Dict[str, float]] = {}
+
+    def __init__(self, name: str, metric: Any = None, **kwargs: Any) -> None:
+        # `metric` accepted for reference-call-site parity (SumMetric etc.);
+        # accumulation is always a float sum here.
+        self.name = name
+
+    def start(self) -> None:
+        if self.disabled:
+            return
+        if self.name in type(self)._start_times:
+            raise TimerError(f"Timer '{self.name}' is running. Use .stop() to stop it")
+        type(self)._start_times[self.name] = time.perf_counter()
+
+    def stop(self) -> float:
+        if self.disabled:
+            return 0.0
+        if self.name not in type(self)._start_times:
+            raise TimerError(f"Timer '{self.name}' is not running. Use .start() to start it")
+        elapsed = time.perf_counter() - type(self)._start_times.pop(self.name)
+        type(self).timers[self.name] = type(self).timers.get(self.name, 0.0) + elapsed
+        return elapsed
+
+    def __enter__(self) -> "timer":
+        self.start()
+        return self
+
+    def __exit__(self, *exc_info: Any) -> None:
+        self.stop()
+
+    @classmethod
+    def compute(cls) -> Dict[str, float]:
+        return dict(cls.timers) if not cls.disabled else {}
+
+    @classmethod
+    def reset(cls) -> None:
+        cls.timers = {}
+        cls._start_times = {}

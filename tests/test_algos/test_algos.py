@@ -1,0 +1,97 @@
+"""End-to-end algorithm smoke tests: CLI dry runs on dummy/classic envs
+(reference strategy: tests/test_algos/test_algos.py — one-iteration runs with
+tiny models; multi-device exercised via the virtual 8-device CPU platform in
+conftest.py instead of gloo processes)."""
+
+import os
+
+import pytest
+
+from sheeprl_tpu.cli import evaluation, run
+
+
+def ppo_overrides(tmp_path, **extra):
+    args = [
+        "exp=ppo",
+        "env=dummy",
+        "dry_run=True",
+        "metric.log_level=0",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "algo.rollout_steps=8",
+        "algo.per_rank_batch_size=4",
+        "algo.update_epochs=2",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.encoder.cnn_features_dim=16",
+        "algo.encoder.mlp_features_dim=8",
+        "algo.mlp_keys.encoder=[state]",
+        "buffer.memmap=False",
+        "checkpoint.every=0",
+    ]
+    for k, v in extra.items():
+        args.append(f"{k}={v}")
+    return args
+
+
+@pytest.fixture(autouse=True)
+def _chdir_tmp(tmp_path, monkeypatch):
+    # Keep logs/ out of the repo (runs write ./logs/runs relative to cwd).
+    monkeypatch.chdir(tmp_path)
+
+
+class TestPPO:
+    @pytest.mark.parametrize("devices", [1, 2])
+    def test_dry_run_mlp(self, tmp_path, devices):
+        run(ppo_overrides(tmp_path, **{"fabric.devices": devices, "fabric.accelerator": "cpu"}))
+
+    def test_dry_run_pixel_and_mlp(self, tmp_path):
+        args = ppo_overrides(tmp_path)
+        args = [a for a in args if not a.startswith("algo.mlp_keys")]
+        args += [
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[state]",
+            "env.screen_size=64",
+            "fabric.accelerator=cpu",
+        ]
+        run(args)
+
+    def test_dry_run_continuous(self, tmp_path):
+        args = ppo_overrides(tmp_path, **{"env.id": "continuous_dummy", "fabric.accelerator": "cpu"})
+        args.append("env.wrapper.id=continuous_dummy")
+        run(args)
+
+    def test_dry_run_multidiscrete(self, tmp_path):
+        args = ppo_overrides(tmp_path, **{"env.id": "multidiscrete_dummy", "fabric.accelerator": "cpu"})
+        args.append("env.wrapper.id=multidiscrete_dummy")
+        run(args)
+
+    def test_checkpoint_and_eval_roundtrip(self, tmp_path):
+        args = ppo_overrides(tmp_path, **{"fabric.accelerator": "cpu"})
+        args = [a for a in args if not a.startswith("checkpoint.every")]
+        args += ["checkpoint.every=16", "checkpoint.save_last=True"]
+        run(args)
+        # find the checkpoint under the run dir
+        ckpts = []
+        for root, dirs, files in os.walk(tmp_path / "logs"):
+            for d in dirs:
+                if d.startswith("ckpt_") and d.endswith(".ckpt"):
+                    ckpts.append(os.path.join(root, d))
+        assert ckpts, "no checkpoint written"
+        evaluation([f"checkpoint_path={sorted(ckpts)[-1]}", "fabric.accelerator=cpu"])
+
+    def test_resume_from_checkpoint(self, tmp_path):
+        args = ppo_overrides(tmp_path, **{"fabric.accelerator": "cpu"})
+        args = [a for a in args if not a.startswith("checkpoint.every")]
+        args += ["checkpoint.every=16", "checkpoint.save_last=True"]
+        run(args)
+        ckpts = []
+        for root, dirs, files in os.walk(tmp_path / "logs"):
+            for d in dirs:
+                if d.startswith("ckpt_") and d.endswith(".ckpt"):
+                    ckpts.append(os.path.join(root, d))
+        assert ckpts
+        resume_args = ppo_overrides(tmp_path, **{"fabric.accelerator": "cpu"})
+        resume_args.append(f"checkpoint.resume_from={sorted(ckpts)[-1]}")
+        run(resume_args)
