@@ -1,22 +1,10 @@
-"""PPO, coupled training loop (reference: sheeprl/algos/ppo/ppo.py:30-453).
+"""A2C, coupled training loop (reference: sheeprl/algos/a2c/a2c.py:26-440).
 
-TPU-first structure:
-- Rollout: the jitted `player_step` samples actions on device; env stepping
-  stays host python (gymnasium vector env). Pixels travel host→device as
-  uint8; normalization happens inside jit.
-- GAE: one reverse `lax.scan` on device (the reference loops in python,
-  utils.py:63-100).
-- Update: ALL epochs × minibatches run inside ONE jitted call — permutations
-  drawn in-graph, `lax.scan` over minibatches, `lax.scan` over epochs. The
-  batch is sharded over the mesh's `data` axis and params are replicated, so
-  XLA inserts the gradient all-reduce exactly where DDP would (SURVEY §2.1).
-- Annealing (lr / clip / entropy coefs): host-computed scalars passed as
-  traced args — no retrace per iteration.
-
-Minibatching divergence (documented): the reference keeps a smaller final
-minibatch (BatchSampler(drop_last=False), ppo.py:50). Static shapes require
-equal minibatches, so when batch_size does not divide the rollout the index
-permutation wraps modulo N — a few samples are seen twice per epoch instead.
+Same rollout/GAE structure as PPO (the reference reuses the PPO agent,
+a2c.py:14), but the update is a single pass with gradients ACCUMULATED over
+minibatches and one optimizer step (reference: no_backward_sync accumulation,
+a2c.py:64-112). Here that is a `lax.scan` over minibatches summing gradients,
+followed by one `tx.update` — all inside one jitted call.
 """
 
 from __future__ import annotations
@@ -32,9 +20,11 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from sheeprl_tpu.algos.a2c.loss import policy_loss, value_loss
+from sheeprl_tpu.algos.a2c.utils import prepare_obs, test
 from sheeprl_tpu.algos.ppo.agent import PPOAgent, actions_metadata, build_agent
-from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
-from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
+from sheeprl_tpu.algos.ppo.loss import entropy_loss
+from sheeprl_tpu.config.instantiate import instantiate, locate
 from sheeprl_tpu.core.mesh import DATA_AXIS
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.registry import register_algorithm
@@ -45,77 +35,60 @@ from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.ops import gae, normalize_tensor
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
-from sheeprl_tpu.config.instantiate import instantiate
 
 
 def make_train_step(agent: PPOAgent, tx: optax.GradientTransformation, cfg: Dict[str, Any], mesh):
-    """Build the jitted full-update function (epochs × minibatches in-graph)."""
+    """One jitted update: scan minibatches accumulating grads, single step."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    update_epochs = int(cfg.algo.update_epochs)
     mb_size = int(cfg.algo.per_rank_batch_size)
-    cnn_keys = list(cfg.algo.cnn_keys.encoder)
-    obs_keys = cnn_keys + list(cfg.algo.mlp_keys.encoder)
-    normalize_advantages = bool(cfg.algo.normalize_advantages)
-    clip_vloss = bool(cfg.algo.clip_vloss)
+    obs_keys = list(cfg.algo.mlp_keys.encoder)
+    normalize_advantages = bool(cfg.algo.get("normalize_advantages", False))
     reduction = cfg.algo.loss_reduction
     vf_coef = float(cfg.algo.vf_coef)
+    ent_coef = float(cfg.algo.get("ent_coef", 0.0))
 
-    def loss_fn(params, batch, clip_coef, ent_coef):
-        obs = normalize_obs({k: batch[k] for k in obs_keys}, cnn_keys, obs_keys)
-        new_logprobs, entropy, new_values = agent.evaluate_actions(params, obs, batch["actions"])
+    def loss_fn(params, batch):
+        obs = {k: batch[k] for k in obs_keys}
+        logprobs, entropy, new_values = agent.evaluate_actions(params, obs, batch["actions"])
         advantages = batch["advantages"]
         if normalize_advantages:
             advantages = normalize_tensor(advantages)
-        pg_loss = policy_loss(new_logprobs, batch["logprobs"], advantages, clip_coef, reduction)
-        v_loss = value_loss(new_values, batch["values"], batch["returns"], clip_coef, clip_vloss, reduction)
+        pg_loss = policy_loss(logprobs, advantages, reduction)
+        v_loss = value_loss(new_values, batch["returns"], reduction)
         ent_loss = entropy_loss(entropy, reduction)
         total = pg_loss + vf_coef * v_loss + ent_coef * ent_loss
-        return total, (pg_loss, v_loss, ent_loss)
+        return total, (pg_loss, v_loss)
 
     batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
 
     @partial(jax.jit, donate_argnums=(0, 1))
-    def train_step(params, opt_state, data, key, clip_coef, ent_coef):
+    def train_step(params, opt_state, data, key):
         n = data["actions"].shape[0]
-        num_mb = max(1, -(-n // mb_size))  # ceil
+        num_mb = max(1, -(-n // mb_size))
+        perm = jax.random.permutation(key, n)
+        idx = perm[jnp.arange(num_mb * mb_size) % n].reshape(num_mb, mb_size)
+        zero_grads = jax.tree_util.tree_map(jnp.zeros_like, params)
 
-        def epoch_body(carry, epoch_key):
-            params, opt_state = carry
-            perm = jax.random.permutation(epoch_key, n)
-            # wrap modulo n so every minibatch has static size mb_size
-            idx = jnp.arange(num_mb * mb_size) % n
-            idx = perm[idx].reshape(num_mb, mb_size)
+        def mb_body(grads_acc, mb_idx):
+            batch = {k: jnp.take(v, mb_idx, axis=0) for k, v in data.items()}
+            batch = jax.lax.with_sharding_constraint(batch, {k: batch_sharding for k in batch})
+            (_, (pg, vl)), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
+            return jax.tree_util.tree_map(jnp.add, grads_acc, grads), jnp.stack([pg, vl])
 
-            def mb_body(carry, mb_idx):
-                params, opt_state = carry
-                batch = {k: jnp.take(v, mb_idx, axis=0) for k, v in data.items()}
-                batch = jax.lax.with_sharding_constraint(
-                    batch, {k: batch_sharding for k in batch}
-                )
-                (loss, (pg, vl, ent)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                    params, batch, clip_coef, ent_coef
-                )
-                updates, opt_state = tx.update(grads, opt_state, params)
-                params = optax.apply_updates(params, updates)
-                return (params, opt_state), jnp.stack([pg, vl, ent])
-
-            (params, opt_state), metrics = jax.lax.scan(mb_body, (params, opt_state), idx)
-            return (params, opt_state), metrics.mean(0)
-
-        keys = jax.random.split(key, update_epochs)
-        (params, opt_state), metrics = jax.lax.scan(epoch_body, (params, opt_state), keys)
+        grads_sum, metrics = jax.lax.scan(mb_body, zero_grads, idx)
+        updates, opt_state = tx.update(grads_sum, opt_state, params)
+        params = optax.apply_updates(params, updates)
         m = metrics.mean(0)
-        return params, opt_state, {"policy_loss": m[0], "value_loss": m[1], "entropy_loss": m[2]}
+        return params, opt_state, {"policy_loss": m[0], "value_loss": m[1]}
 
     return train_step
 
 
 @register_algorithm()
 def main(runtime, cfg: Dict[str, Any]):
-    initial_ent_coef = float(cfg.algo.ent_coef)
-    initial_clip_coef = float(cfg.algo.clip_coef)
     mesh = runtime.mesh
+    rank = runtime.global_rank
 
     state = None
     if cfg.checkpoint.resume_from:
@@ -127,8 +100,6 @@ def main(runtime, cfg: Dict[str, Any]):
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
     runtime.print(f"Log dir: {log_dir}")
 
-    # ----------------------------------------------------------------- envs
-    rank = runtime.global_rank
     vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
     envs = vectorized_env(
         [
@@ -147,34 +118,24 @@ def main(runtime, cfg: Dict[str, Any]):
     observation_space = envs.single_observation_space
     if not isinstance(observation_space, gym.spaces.Dict):
         raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
-    if cfg.algo.cnn_keys.encoder + cfg.algo.mlp_keys.encoder == []:
-        raise RuntimeError(
-            "You should specify at least one CNN keys or MLP keys from the cli: "
-            "`algo.cnn_keys.encoder=[rgb]` or `algo.mlp_keys.encoder=[state]`"
-        )
+    if len(cfg.algo.mlp_keys.encoder) == 0:
+        raise RuntimeError("You should specify at least one MLP key for the A2C agent: `algo.mlp_keys.encoder=[state]`")
     if cfg.metric.log_level > 0:
-        runtime.print("Encoder CNN keys:", cfg.algo.cnn_keys.encoder)
         runtime.print("Encoder MLP keys:", cfg.algo.mlp_keys.encoder)
-    obs_keys = cfg.algo.cnn_keys.encoder + cfg.algo.mlp_keys.encoder
-    cnn_keys = cfg.algo.cnn_keys.encoder
+    obs_keys = list(cfg.algo.mlp_keys.encoder)
 
     actions_dim, is_continuous = actions_metadata(envs.single_action_space)
-    clip_rewards_fn = (lambda r: np.tanh(r)) if cfg.env.clip_rewards else (lambda r: r)
 
-    # ---------------------------------------------------------------- agent
     agent, params = build_agent(
         runtime, actions_dim, is_continuous, cfg, observation_space,
         state["agent"] if state is not None else None,
     )
 
-    # optimizer: inject lr so annealing is a hyperparam update, not a rebuild
     optim_cfg = dict(cfg.algo.optimizer)
     optim_target = optim_cfg.pop("_target_")
     base_lr = float(optim_cfg.pop("lr"))
 
     def make_tx(lr):
-        from sheeprl_tpu.config.instantiate import locate
-
         inner = locate(optim_target)(lr=lr, **optim_cfg)
         if cfg.algo.max_grad_norm > 0.0:
             return optax.chain(optax.clip_by_global_norm(cfg.algo.max_grad_norm), inner)
@@ -188,12 +149,10 @@ def main(runtime, cfg: Dict[str, Any]):
     if runtime.is_global_zero:
         save_configs(cfg, log_dir)
 
-    # -------------------------------------------------------------- metrics
     aggregator = None
     if not MetricAggregator.disabled:
         aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
 
-    # --------------------------------------------------------------- buffer
     if cfg.buffer.size < cfg.algo.rollout_steps:
         raise ValueError(
             f"The size of the buffer ({cfg.buffer.size}) cannot be lower "
@@ -207,7 +166,6 @@ def main(runtime, cfg: Dict[str, Any]):
         obs_keys=obs_keys,
     )
 
-    # ------------------------------------------------------------- counters
     world_size = jax.process_count()
     last_train = 0
     train_step_count = 0
@@ -233,7 +191,6 @@ def main(runtime, cfg: Dict[str, Any]):
             "the checkpoint will be saved at the nearest greater multiple of the policy_steps_per_iter value."
         )
 
-    # ---------------------------------------------------------- jitted fns
     player_step_fn = jax.jit(agent.player_step)
     get_values_fn = jax.jit(agent.get_values)
     gae_fn = jax.jit(
@@ -245,7 +202,6 @@ def main(runtime, cfg: Dict[str, Any]):
 
     rollout_key, train_key = jax.random.split(jax.random.fold_in(runtime.root_key, rank))
 
-    # --------------------------------------------------------------- loop
     step_data = {}
     next_obs = envs.reset(seed=cfg.seed)[0]
     for k in obs_keys:
@@ -256,7 +212,7 @@ def main(runtime, cfg: Dict[str, Any]):
             policy_step += cfg.env.num_envs * world_size
 
             with timer("Time/env_interaction_time"):
-                jnp_obs = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
+                jnp_obs = prepare_obs(next_obs, mlp_keys=obs_keys, num_envs=cfg.env.num_envs)
                 rollout_key, sub = jax.random.split(rollout_key)
                 actions, real_actions, logprobs, values = player_step_fn(params, jnp_obs, sub)
                 real_actions_np = np.asarray(real_actions)
@@ -266,18 +222,16 @@ def main(runtime, cfg: Dict[str, Any]):
                 )
                 truncated_envs = np.nonzero(truncated)[0]
                 if len(truncated_envs) > 0:
-                    # Bootstrap truncated episodes with V(final_obs)
-                    # (reference: ppo.py:287-306).
                     final_obs = info["final_obs"]
                     real_next_obs = {
                         k: np.stack([np.asarray(final_obs[e][k], np.float32) for e in truncated_envs])
                         for k in obs_keys
                     }
-                    jnp_next = prepare_obs(real_next_obs, cnn_keys=cnn_keys, num_envs=len(truncated_envs))
+                    jnp_next = prepare_obs(real_next_obs, mlp_keys=obs_keys, num_envs=len(truncated_envs))
                     vals = np.asarray(get_values_fn(params, jnp_next))
                     rewards[truncated_envs] += cfg.algo.gamma * vals.reshape(rewards[truncated_envs].shape)
                 dones = np.logical_or(terminated, truncated).reshape(cfg.env.num_envs, -1).astype(np.uint8)
-                rewards = clip_rewards_fn(rewards).reshape(cfg.env.num_envs, -1).astype(np.float32)
+                rewards = rewards.reshape(cfg.env.num_envs, -1).astype(np.float32)
 
             step_data["dones"] = dones[np.newaxis]
             step_data["values"] = np.asarray(values)[np.newaxis]
@@ -306,9 +260,8 @@ def main(runtime, cfg: Dict[str, Any]):
                         aggregator.update("Game/ep_len_avg", ep_len)
                     runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
 
-        # ------------------------------------------------- GAE + flatten
         local_data = rb.to_tensor()
-        jnp_obs = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
+        jnp_obs = prepare_obs(next_obs, mlp_keys=obs_keys, num_envs=cfg.env.num_envs)
         next_values = get_values_fn(params, jnp_obs)
         returns, advantages = gae_fn(
             jnp.asarray(np.asarray(local_data["rewards"]), jnp.float32),
@@ -319,15 +272,8 @@ def main(runtime, cfg: Dict[str, Any]):
         local_data["returns"] = np.asarray(returns)
         local_data["advantages"] = np.asarray(advantages)
 
-        # Flatten [T, N_envs] → [T·N_envs] and ship to the mesh, batch
-        # sharded over `data` (pixels stay uint8 until inside jit).
-        flat = {
-            k: np.asarray(v).reshape(-1, *np.asarray(v).shape[2:]) for k, v in local_data.items()
-        }
+        flat = {k: np.asarray(v).reshape(-1, *np.asarray(v).shape[2:]) for k, v in local_data.items()}
         if cfg.buffer.get("share_data", False) and world_size > 1:
-            # Every process trains on the union of all rollouts
-            # (reference: fabric.all_gather, ppo.py:363-367) — DCN-level
-            # host gather; within one process the mesh already sees all data.
             from jax.experimental import multihost_utils
 
             gathered = multihost_utils.process_allgather(flat)
@@ -336,28 +282,15 @@ def main(runtime, cfg: Dict[str, Any]):
 
         with timer("Time/train_time"):
             train_key, sub = jax.random.split(train_key)
-            params, opt_state, train_metrics = train_fn(
-                params,
-                opt_state,
-                sharded,
-                sub,
-                jnp.asarray(cfg.algo.clip_coef, jnp.float32),
-                jnp.asarray(cfg.algo.ent_coef, jnp.float32),
-            )
+            params, opt_state, train_metrics = train_fn(params, opt_state, sharded, sub)
             jax.block_until_ready(params)
         train_step_count += world_size
 
         if aggregator and not aggregator.disabled:
             aggregator.update("Loss/policy_loss", np.asarray(train_metrics["policy_loss"]))
             aggregator.update("Loss/value_loss", np.asarray(train_metrics["value_loss"]))
-            aggregator.update("Loss/entropy_loss", np.asarray(train_metrics["entropy_loss"]))
 
-        # ------------------------------------------------------- logging
         if cfg.metric.log_level > 0 and logger is not None:
-            logger.log("Info/learning_rate", _current_lr(opt_state, base_lr), policy_step)
-            logger.log("Info/clip_coef", cfg.algo.clip_coef, policy_step)
-            logger.log("Info/ent_coef", cfg.algo.ent_coef, policy_step)
-
             if policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters:
                 if aggregator and not aggregator.disabled:
                     logger.log_dict(aggregator.compute(), policy_step)
@@ -381,20 +314,10 @@ def main(runtime, cfg: Dict[str, Any]):
                 last_log = policy_step
                 last_train = train_step_count
 
-        # ----------------------------------------------------- annealing
         if cfg.algo.anneal_lr:
             new_lr = polynomial_decay(iter_num, initial=base_lr, final=0.0, max_decay_steps=total_iters, power=1.0)
             opt_state.hyperparams["lr"] = jnp.asarray(new_lr, jnp.float32)
-        if cfg.algo.anneal_clip_coef:
-            cfg.algo.clip_coef = polynomial_decay(
-                iter_num, initial=initial_clip_coef, final=0.0, max_decay_steps=total_iters, power=1.0
-            )
-        if cfg.algo.anneal_ent_coef:
-            cfg.algo.ent_coef = polynomial_decay(
-                iter_num, initial=initial_ent_coef, final=0.0, max_decay_steps=total_iters, power=1.0
-            )
 
-        # ---------------------------------------------------- checkpoint
         if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
             iter_num == total_iters and cfg.checkpoint.save_last
         ):
@@ -417,10 +340,3 @@ def main(runtime, cfg: Dict[str, Any]):
 
     if logger is not None:
         logger.close()
-
-
-def _current_lr(opt_state, base_lr: float) -> float:
-    try:
-        return float(np.asarray(opt_state.hyperparams["lr"]))
-    except Exception:
-        return base_lr

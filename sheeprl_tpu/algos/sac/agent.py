@@ -1,0 +1,199 @@
+"""SAC agent (flax): squashed-Gaussian actor + vmapped twin-Q ensemble.
+
+Capability parity with the reference agent (sheeprl/algos/sac/agent.py:20-371),
+restructured for TPU:
+- The reference keeps a python list of critic modules and concatenates their
+  outputs (agent.py:248-253). Here the ensemble is ONE module vmapped over a
+  leading `n_critics` parameter axis (`nn.vmap`), so all critics run as a
+  single batched matmul — MXU-friendly, no per-critic dispatch.
+- Target critics are a params COPY in the train state (EMA by tree_map lerp,
+  reference qfs_target_ema at agent.py:264-267), not modules.
+- The player/trainer weight tying of the reference (agent.py:368-370) is
+  structural: the same actor params serve both paths.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from math import prod
+from typing import Any, Dict, Optional, Tuple
+
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from sheeprl_tpu.models import MLP
+
+LOG_STD_MIN = -5
+LOG_STD_MAX = 2
+
+
+class SACActorModule(nn.Module):
+    """2-layer MLP trunk → (mean, log_std) heads
+    (reference: SACActor, agent.py:57-142)."""
+
+    action_dim: int
+    hidden_size: int = 256
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        x = MLP(
+            hidden_sizes=(self.hidden_size, self.hidden_size),
+            activation="relu",
+            dtype=self.dtype,
+            name="model",
+        )(obs)
+        mean = nn.Dense(self.action_dim, dtype=self.dtype, name="fc_mean")(x)
+        log_std = nn.Dense(self.action_dim, dtype=self.dtype, name="fc_logstd")(x)
+        return mean, log_std
+
+
+class SACCriticModule(nn.Module):
+    """Q(obs, act) MLP (reference: SACCritic, agent.py:20-54)."""
+
+    hidden_size: int = 256
+    num_critics: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, action: jax.Array) -> jax.Array:
+        x = jnp.concatenate([obs, action], axis=-1)
+        return MLP(
+            hidden_sizes=(self.hidden_size, self.hidden_size),
+            output_dim=self.num_critics,
+            activation="relu",
+            dtype=self.dtype,
+            name="model",
+        )(x)
+
+
+class SACCriticEnsemble(nn.Module):
+    """N independent critics as one vmapped module: params gain a leading
+    [n] axis, outputs stack to [B, n]."""
+
+    n: int
+    hidden_size: int = 256
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, action: jax.Array) -> jax.Array:
+        ensemble = nn.vmap(
+            SACCriticModule,
+            in_axes=None,
+            out_axes=-1,
+            axis_size=self.n,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+        )(hidden_size=self.hidden_size, num_critics=1, dtype=self.dtype, name="qfs")
+        return ensemble(obs, action)[..., 0, :]  # [B, 1, n] → [B, n]
+
+
+def squash_and_logprob(
+    mean: jax.Array,
+    log_std: jax.Array,
+    key: jax.Array,
+    action_scale: jax.Array,
+    action_bias: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Reparameterized tanh-squashed sample, rescaled to env bounds, with the
+    eq. 26 log-prob correction (reference: agent.py:110-142)."""
+    std = jnp.exp(jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX))
+    x_t = mean + std * jax.random.normal(key, mean.shape, mean.dtype)
+    y_t = jnp.tanh(x_t)
+    action = y_t * action_scale + action_bias
+    log_prob = -((x_t - mean) ** 2) / (2 * std**2) - jnp.log(std) - 0.5 * jnp.log(2 * jnp.pi)
+    log_prob = log_prob - jnp.log(action_scale * (1 - y_t**2) + 1e-6)
+    return action, log_prob.sum(-1, keepdims=True)
+
+
+@dataclass(frozen=True)
+class SACAgent:
+    """Bundles modules + action-space metadata; params/targets live in the
+    train state dict: {actor, qfs, qfs_target, log_alpha}."""
+
+    actor: SACActorModule
+    critics: SACCriticEnsemble
+    action_scale: np.ndarray
+    action_bias: np.ndarray
+    target_entropy: float
+    tau: float
+    num_critics: int
+
+    def actions_and_log_probs(self, actor_params, obs: jax.Array, key: jax.Array):
+        mean, log_std = self.actor.apply(actor_params, obs)
+        return squash_and_logprob(
+            mean, log_std, key, jnp.asarray(self.action_scale), jnp.asarray(self.action_bias)
+        )
+
+    def q_values(self, qf_params, obs: jax.Array, action: jax.Array) -> jax.Array:
+        return self.critics.apply(qf_params, obs, action)
+
+    def next_target_q_values(
+        self, state: Dict[str, Any], next_obs, rewards, terminated, gamma: float, key: jax.Array
+    ) -> jax.Array:
+        """Soft Bellman target (reference: get_next_target_q_values,
+        agent.py:256-262)."""
+        next_actions, next_log_pi = self.actions_and_log_probs(state["actor"], next_obs, key)
+        qf_next = self.q_values(state["qfs_target"], next_obs, next_actions)
+        alpha = jnp.exp(state["log_alpha"])
+        min_qf_next = jnp.min(qf_next, axis=-1, keepdims=True) - alpha * next_log_pi
+        return rewards + (1 - terminated) * gamma * min_qf_next
+
+    def target_ema(self, qf_params, qf_target_params, tau: Optional[jax.Array] = None):
+        """Polyak update (reference: qfs_target_ema, agent.py:264-267)."""
+        t = self.tau if tau is None else tau
+        return jax.tree_util.tree_map(lambda p, tp: t * p + (1 - t) * tp, qf_params, qf_target_params)
+
+    def get_actions(self, actor_params, obs: jax.Array, key: Optional[jax.Array] = None, greedy: bool = False):
+        """Env-facing actions (reference: SACPlayer, agent.py:288-314)."""
+        mean, log_std = self.actor.apply(actor_params, obs)
+        scale = jnp.asarray(self.action_scale)
+        bias = jnp.asarray(self.action_bias)
+        if greedy:
+            return jnp.tanh(mean) * scale + bias
+        std = jnp.exp(jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX))
+        x_t = mean + std * jax.random.normal(key, mean.shape, mean.dtype)
+        return jnp.tanh(x_t) * scale + bias
+
+
+def build_agent(
+    runtime,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+    action_space: gymnasium.spaces.Box,
+    agent_state: Optional[Dict[str, Any]] = None,
+) -> Tuple[SACAgent, Dict[str, Any]]:
+    """Construct modules + initial (or restored) train state
+    (reference: build_agent, agent.py:317-371)."""
+    act_dim = int(prod(action_space.shape))
+    obs_dim = int(sum(prod(obs_space[k].shape) for k in cfg.algo.mlp_keys.encoder))
+    dtype = runtime.precision.compute_dtype
+    actor = SACActorModule(action_dim=act_dim, hidden_size=cfg.algo.actor.hidden_size, dtype=dtype)
+    critics = SACCriticEnsemble(n=cfg.algo.critic.n, hidden_size=cfg.algo.critic.hidden_size, dtype=dtype)
+    agent = SACAgent(
+        actor=actor,
+        critics=critics,
+        action_scale=np.asarray((action_space.high - action_space.low) / 2.0, np.float32),
+        action_bias=np.asarray((action_space.high + action_space.low) / 2.0, np.float32),
+        target_entropy=float(-act_dim),
+        tau=float(cfg.algo.tau),
+        num_critics=int(cfg.algo.critic.n),
+    )
+    if agent_state is not None:
+        state = jax.tree_util.tree_map(jnp.asarray, agent_state)
+    else:
+        k_actor, k_qfs = jax.random.split(runtime.root_key)
+        dummy_obs = jnp.zeros((1, obs_dim), jnp.float32)
+        dummy_act = jnp.zeros((1, act_dim), jnp.float32)
+        actor_params = actor.init(k_actor, dummy_obs)
+        qf_params = critics.init(k_qfs, dummy_obs, dummy_act)
+        state = {
+            "actor": actor_params,
+            "qfs": qf_params,
+            "qfs_target": jax.tree_util.tree_map(jnp.copy, qf_params),
+            "log_alpha": jnp.log(jnp.asarray([float(cfg.algo.alpha.alpha)], jnp.float32)),
+        }
+    return agent, state

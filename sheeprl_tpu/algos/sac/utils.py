@@ -1,0 +1,52 @@
+"""SAC auxiliary contract (reference: sheeprl/algos/sac/utils.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.utils.env import make_env
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/alpha_loss",
+}
+MODELS_TO_REGISTER = {"agent"}
+
+
+def prepare_obs(
+    obs: Dict[str, np.ndarray], *, mlp_keys: Sequence[str] = (), num_envs: int = 1, **kwargs: Any
+) -> jax.Array:
+    """Vector obs → single concatenated float array [num_envs, D]
+    (reference: utils.py:31-36)."""
+    return jnp.concatenate(
+        [jnp.asarray(obs[k], jnp.float32) for k in mlp_keys], axis=-1
+    ).reshape(num_envs, -1)
+
+
+def test(agent, state, runtime, cfg: Dict[str, Any], log_dir: str, logger=None) -> float:
+    """One greedy episode (reference: utils.py:39-61)."""
+    env = make_env(cfg, None, 0, log_dir, "test", vector_env_idx=0)()
+    done = False
+    cumulative_rew = 0.0
+    obs = env.reset(seed=cfg.seed)[0]
+    get_actions = jax.jit(lambda p, o: agent.get_actions(p, o, greedy=True))
+    while not done:
+        jnp_obs = prepare_obs(obs, mlp_keys=cfg.algo.mlp_keys.encoder)
+        action = np.asarray(get_actions(state["actor"], jnp_obs))
+        obs, reward, done, truncated, _ = env.step(action.reshape(env.action_space.shape))
+        done = done or truncated
+        cumulative_rew += reward
+        if cfg.dry_run:
+            done = True
+    runtime.print("Test - Reward:", cumulative_rew)
+    if cfg.metric.log_level > 0 and logger is not None:
+        logger.log_dict({"Test/cumulative_reward": cumulative_rew}, 0)
+    env.close()
+    return cumulative_rew

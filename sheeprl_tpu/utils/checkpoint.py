@@ -116,6 +116,26 @@ def load_checkpoint(ckpt_path: str, target: Optional[Any] = None) -> Dict[str, A
     return _merge_state(arrays, aux)
 
 
+def restore_opt_state(fresh_opt_state: Any, ckpt_opt_state: Any) -> Any:
+    """Pour restored optimizer leaves into a freshly-built optax state.
+
+    Checkpoints store generic containers (namedtuples degrade on restore
+    without a target); the authoritative structure comes from `tx.init`.
+    Raises a readable error when the two trees disagree (e.g. the optimizer
+    config changed between the run and the resume).
+    """
+    import jax.numpy as jnp
+
+    structure = jax.tree_util.tree_structure(fresh_opt_state)
+    leaves = jax.tree_util.tree_leaves(jax.tree_util.tree_map(jnp.asarray, ckpt_opt_state))
+    if structure.num_leaves != len(leaves):
+        raise ValueError(
+            f"Checkpointed optimizer state has {len(leaves)} leaves but the freshly-built "
+            f"optimizer expects {structure.num_leaves} — did the optimizer config change since the checkpoint?"
+        )
+    return jax.tree_util.tree_unflatten(structure, leaves)
+
+
 def _gc_old_checkpoints(ckpt_dir: str, keep_last: int) -> None:
     """Delete all but the newest `keep_last` checkpoints, ordered by the
     policy-step embedded in the name (reference: callback.py:144-148)."""

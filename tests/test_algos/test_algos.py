@@ -95,3 +95,96 @@ class TestPPO:
         resume_args = ppo_overrides(tmp_path, **{"fabric.accelerator": "cpu"})
         resume_args.append(f"checkpoint.resume_from={sorted(ckpts)[-1]}")
         run(resume_args)
+
+
+class TestA2C:
+    def test_a2c_dry_run(self, tmp_path):
+        run([
+            "exp=a2c",
+            "env=dummy",
+            "dry_run=True",
+            "metric.log_level=0",
+            "env.num_envs=2",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "algo.rollout_steps=8",
+            "algo.per_rank_batch_size=4",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.mlp_keys.encoder=[state]",
+            "buffer.memmap=False",
+            "checkpoint.every=0",
+            "fabric.accelerator=cpu",
+        ])
+
+class TestSAC:
+    @pytest.mark.parametrize("devices", [1, 2])
+    def test_sac_dry_run(self, tmp_path, devices):
+        run([
+            "exp=sac",
+            "env=dummy",
+            "env.id=continuous_dummy",
+            "env.wrapper.id=continuous_dummy",
+            "dry_run=True",
+            "metric.log_level=0",
+            "env.num_envs=2",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "algo.per_rank_batch_size=4",
+            "algo.learning_starts=0",
+            "algo.hidden_size=8",
+            "buffer.memmap=False",
+            "buffer.size=64",
+            "checkpoint.every=0",
+            "fabric.accelerator=cpu",
+            f"fabric.devices={devices}",
+        ])
+
+    def test_sac_checkpoint_buffer_and_eval(self, tmp_path):
+        run([
+            "exp=sac",
+            "env=dummy",
+            "env.id=continuous_dummy",
+            "env.wrapper.id=continuous_dummy",
+            "metric.log_level=0",
+            "env.num_envs=2",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "algo.total_steps=16",
+            "algo.per_rank_batch_size=4",
+            "algo.learning_starts=4",
+            "algo.hidden_size=8",
+            "buffer.memmap=False",
+            "buffer.size=64",
+            "buffer.checkpoint=True",
+            "checkpoint.every=8",
+            "checkpoint.save_last=True",
+            "fabric.accelerator=cpu",
+        ])
+        ckpts = []
+        for root, dirs, files in os.walk(tmp_path / "logs"):
+            for d in dirs:
+                if d.startswith("ckpt_") and d.endswith(".ckpt"):
+                    ckpts.append(os.path.join(root, d))
+        assert ckpts, "no checkpoint written"
+        evaluation([f"checkpoint_path={sorted(ckpts)[-1]}", "fabric.accelerator=cpu"])
+        # resume with buffer restore
+        run([
+            "exp=sac",
+            "env=dummy",
+            "env.id=continuous_dummy",
+            "env.wrapper.id=continuous_dummy",
+            "metric.log_level=0",
+            "env.num_envs=2",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "algo.total_steps=16",
+            "algo.per_rank_batch_size=4",
+            "algo.learning_starts=0",
+            "algo.hidden_size=8",
+            "buffer.memmap=False",
+            "buffer.size=64",
+            "checkpoint.every=0",
+            "fabric.accelerator=cpu",
+            f"checkpoint.resume_from={sorted(ckpts)[-1]}",
+        ])
