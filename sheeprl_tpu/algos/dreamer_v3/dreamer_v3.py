@@ -1,0 +1,706 @@
+"""DreamerV3 training loop (reference: sheeprl/algos/dreamer_v3/dreamer_v3.py).
+
+TPU-first structure (SURVEY §3.3 / §7.2):
+- Dynamic learning: the RSSM runs as ONE `lax.scan` over the sequence axis
+  (the reference python-loops per-step GRU cells, dreamer_v3.py:134-145) —
+  carry = (h, z), stacked outputs (h_t, z_t, logits).
+- Behaviour learning: imagination is a second `lax.scan` over the horizon
+  starting from every (t, b) posterior flattened to one batch, with per-step
+  PRNG keys for actor sampling.
+- λ-returns: reverse scan (ops.compute_lambda_values); Moments state is a
+  pytree threaded through the jitted step, its quantile a global reduction
+  under the mesh sharding.
+- The whole gradient step (world model + actor + critic, three optax
+  optimizers with clipping) is ONE jitted, donated call; the target-critic
+  EMA cadence stays on host (tau passed as a traced scalar, 0 = no-op).
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import warnings
+from functools import partial
+from typing import Any, Dict, Sequence
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sheeprl_tpu.algos.dreamer_v3.agent import (
+    DV3Agent,
+    WorldModel,
+    actor_forward,
+    build_agent,
+    continuous_log_prob_and_entropy,
+)
+from sheeprl_tpu.algos.dreamer_v3.loss import reconstruction_loss
+from sheeprl_tpu.algos.dreamer_v3.utils import prepare_obs, test
+from sheeprl_tpu.algos.ppo.agent import actions_metadata
+from sheeprl_tpu.config.instantiate import instantiate, locate
+from sheeprl_tpu.core.mesh import DATA_AXIS
+from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.envs.wrappers import RestartOnException
+from sheeprl_tpu.registry import register_algorithm
+from sheeprl_tpu.utils.checkpoint import load_checkpoint, restore_opt_state, save_checkpoint
+from sheeprl_tpu.utils.distribution import (
+    BernoulliSafeMode,
+    Independent,
+    MSEDistribution,
+    OneHotCategorical,
+    SymlogDistribution,
+    TwoHotEncodingDistribution,
+)
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.ops import compute_lambda_values, init_moments, update_moments
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import Ratio, save_configs
+
+
+def _make_optimizer(optim_cfg: Dict[str, Any], clip: float) -> optax.GradientTransformation:
+    optim_cfg = dict(optim_cfg)
+    target = optim_cfg.pop("_target_")
+    inner = locate(target)(**optim_cfg)
+    if clip is not None and clip > 0:
+        return optax.chain(optax.clip_by_global_norm(clip), inner)
+    return inner
+
+
+def make_train_step(agent: DV3Agent, txs: Dict[str, optax.GradientTransformation], cfg: Dict[str, Any], mesh):
+    """Build the jitted single-gradient-step function over a [T, B] batch."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    wm_cfg = cfg.algo.world_model
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    cnn_dec_keys = list(cfg.algo.cnn_keys.decoder)
+    mlp_dec_keys = list(cfg.algo.mlp_keys.decoder)
+    stochastic_size = int(wm_cfg.stochastic_size)
+    discrete_size = int(wm_cfg.discrete_size)
+    stoch_state_size = stochastic_size * discrete_size
+    recurrent_state_size = int(wm_cfg.recurrent_model.recurrent_state_size)
+    horizon = int(cfg.algo.horizon)
+    gamma = float(cfg.algo.gamma)
+    lmbda = float(cfg.algo.lmbda)
+    ent_coef = float(cfg.algo.actor.ent_coef)
+    moments_cfg = cfg.algo.actor.moments
+    decoupled = bool(wm_cfg.decoupled_rssm)
+    spec = agent.actor_spec
+    actions_dim = agent.actions_dim
+
+    batch_sharding = NamedSharding(mesh, P(None, DATA_AXIS))
+
+    def world_loss_fn(wm_params, data, batch_obs, keys):
+        T, B = data["rewards"].shape[:2]
+        embedded = agent.wm(wm_params, batch_obs, method="embed_obs")  # [T, B, E]
+
+        batch_actions = jnp.concatenate(
+            [jnp.zeros_like(data["actions"][:1]), data["actions"][:-1]], axis=0
+        )
+        is_first = data["is_first"].at[0].set(1.0)
+
+        h0 = jnp.zeros((B, recurrent_state_size), embedded.dtype)
+        z0 = jnp.zeros((B, stoch_state_size), embedded.dtype)
+
+        def step(carry, x):
+            h, z = carry
+            action, emb, first, key = x
+            h, post, prior, post_logits, prior_logits = agent.world_model.apply(
+                wm_params, z, h, action, emb, first, key, method=WorldModel.dynamic
+            )
+            return (h, post), (h, post, post_logits, prior_logits)
+
+        (_, _), (recurrent_states, posteriors, posteriors_logits, priors_logits) = jax.lax.scan(
+            step, (h0, z0), (batch_actions, embedded, is_first, keys)
+        )
+        latent_states = jnp.concatenate([posteriors, recurrent_states], -1)
+
+        reconstructed_obs = agent.wm(wm_params, latent_states, method="decode")
+        po = {
+            k: MSEDistribution(reconstructed_obs[k], dims=len(reconstructed_obs[k].shape[2:]))
+            for k in cnn_dec_keys
+        }
+        po.update(
+            {
+                k: SymlogDistribution(reconstructed_obs[k], dims=len(reconstructed_obs[k].shape[2:]))
+                for k in mlp_dec_keys
+            }
+        )
+        pr = TwoHotEncodingDistribution(agent.wm(wm_params, latent_states, method="reward_logits"), dims=1)
+        pc = Independent(
+            BernoulliSafeMode(logits=agent.wm(wm_params, latent_states, method="continue_logits")), 1
+        )
+        continues_targets = 1 - data["terminated"]
+
+        pl = priors_logits.reshape(*priors_logits.shape[:-1], stochastic_size, discrete_size)
+        pol = posteriors_logits.reshape(*posteriors_logits.shape[:-1], stochastic_size, discrete_size)
+        rec_loss, kl, state_loss, reward_loss, observation_loss, continue_loss = reconstruction_loss(
+            po,
+            batch_obs,
+            pr,
+            data["rewards"],
+            pl,
+            pol,
+            wm_cfg.kl_dynamic,
+            wm_cfg.kl_representation,
+            wm_cfg.kl_free_nats,
+            wm_cfg.kl_regularizer,
+            pc,
+            continues_targets,
+            wm_cfg.continue_scale_factor,
+        )
+        aux = {
+            "posteriors": posteriors,
+            "recurrent_states": recurrent_states,
+            "posteriors_logits": pol,
+            "priors_logits": pl,
+            "kl": kl,
+            "state_loss": state_loss,
+            "reward_loss": reward_loss,
+            "observation_loss": observation_loss,
+            "continue_loss": continue_loss,
+        }
+        return rec_loss, aux
+
+    @partial(jax.jit, donate_argnums=(0, 1, 2))
+    def train_step(state, opt_states, moments_state, data, key, tau):
+        T, B = data["rewards"].shape[:2]
+        data = jax.lax.with_sharding_constraint(data, {k: batch_sharding for k in data})
+        batch_obs = {k: data[k] / 255.0 - 0.5 for k in cnn_keys}
+        batch_obs.update({k: data[k] for k in mlp_keys})
+
+        k_dyn, k_img0, k_img, k_actor = jax.random.split(key, 4)
+        dyn_keys = jax.random.split(k_dyn, T)
+
+        # ---------------------------------------------- world model update
+        (rec_loss, aux), wm_grads = jax.value_and_grad(world_loss_fn, has_aux=True)(
+            state["world_model"], data, batch_obs, dyn_keys
+        )
+        wm_updates, wm_opt = txs["world_model"].update(
+            wm_grads, opt_states["world_model"], state["world_model"]
+        )
+        state["world_model"] = optax.apply_updates(state["world_model"], wm_updates)
+
+        # --------------------------------------------- behaviour learning
+        sg = jax.lax.stop_gradient
+        imagined_prior = sg(aux["posteriors"]).reshape(-1, stoch_state_size)
+        recurrent_state = sg(aux["recurrent_states"]).reshape(-1, recurrent_state_size)
+        latent0 = jnp.concatenate([imagined_prior, recurrent_state], -1)
+
+        def actor_sample(actor_params, latent, k):
+            pre = agent.actor.apply(actor_params, sg(latent))
+            actions, _ = actor_forward(pre, spec, k, greedy=False)
+            return jnp.concatenate(actions, -1)
+
+        def imagine_loss_fn(actor_params):
+            # Imagination rollout (actions re-sampled from the CURRENT actor
+            # params so the pathwise gradient flows; reference does the same
+            # through in-place module weights, dreamer_v3.py:219-241).
+            a0 = actor_sample(actor_params, latent0, k_img0)
+
+            def img_step(carry, k):
+                prior, h, actions = carry
+                prior, h = agent.world_model.apply(
+                    state["world_model"], prior, h, actions, k, method=WorldModel.imagination
+                )
+                latent = jnp.concatenate([prior, h], -1)
+                next_actions = actor_sample(actor_params, latent, k)
+                return (prior, h, next_actions), (latent, next_actions)
+
+            img_keys = jax.random.split(k_img, horizon)
+            _, (latents, img_actions) = jax.lax.scan(
+                img_step, (imagined_prior, recurrent_state, a0), img_keys
+            )
+            imagined_trajectories = jnp.concatenate([latent0[None], latents], 0)  # [H+1, TB, L]
+            imagined_actions = jnp.concatenate([a0[None], img_actions], 0)
+
+            # Predict values / rewards / continues on the imagined rollout
+            predicted_values = TwoHotEncodingDistribution(
+                agent.critic_logits(state["critic"], imagined_trajectories), dims=1
+            ).mean
+            predicted_rewards = TwoHotEncodingDistribution(
+                agent.wm(state["world_model"], imagined_trajectories, method="reward_logits"), dims=1
+            ).mean
+            continues = Independent(
+                BernoulliSafeMode(
+                    logits=agent.wm(state["world_model"], imagined_trajectories, method="continue_logits")
+                ),
+                1,
+            ).mode
+            true_continue = (1 - data["terminated"]).reshape(1, -1, 1)
+            continues = jnp.concatenate([true_continue, continues[1:]], 0)
+
+            lambda_values = compute_lambda_values(
+                predicted_rewards[1:], predicted_values[1:], continues[1:] * gamma, lmbda
+            )
+            discount = sg(jnp.cumprod(continues * gamma, 0) / gamma)
+
+            # Actor objective (reference: dreamer_v3.py:262-297)
+            new_moments, (offset, invscale) = update_moments(
+                moments_state,
+                lambda_values,
+                decay=moments_cfg.decay,
+                max_=moments_cfg.max,
+                percentile_low=moments_cfg.percentile.low,
+                percentile_high=moments_cfg.percentile.high,
+            )
+            baseline = predicted_values[:-1]
+            normed_lambda_values = (lambda_values - offset) / invscale
+            normed_baseline = (baseline - offset) / invscale
+            advantage = normed_lambda_values - normed_baseline
+
+            pre = agent.actor.apply(actor_params, sg(imagined_trajectories))
+            _, policies = actor_forward(pre, spec, k_actor, greedy=False)
+            if spec.is_continuous:
+                objective = advantage
+                _, entropy = continuous_log_prob_and_entropy(policies[0], imagined_actions, spec)
+                entropy = ent_coef * entropy if entropy is not None else jnp.zeros(advantage.shape[:-1])
+            else:
+                splits = np.cumsum(actions_dim)[:-1]
+                per_dim = jnp.split(imagined_actions, splits, -1)
+                logp = jnp.stack(
+                    [p.log_prob(sg(a))[..., None][:-1] for p, a in zip(policies, per_dim)], -1
+                ).sum(-1)
+                objective = logp * sg(advantage)
+                entropy = ent_coef * jnp.stack([p.entropy() for p in policies], -1).sum(-1)
+            policy_loss = -jnp.mean(sg(discount[:-1]) * (objective + entropy[..., None][:-1]))
+            img_aux = {
+                "imagined_trajectories": sg(imagined_trajectories),
+                "lambda_values": sg(lambda_values),
+                "discount": discount,
+                "moments": new_moments,
+            }
+            return policy_loss, img_aux
+
+        (policy_loss, img_aux), actor_grads = jax.value_and_grad(imagine_loss_fn, has_aux=True)(
+            state["actor"]
+        )
+        actor_updates, actor_opt = txs["actor"].update(actor_grads, opt_states["actor"], state["actor"])
+        state["actor"] = optax.apply_updates(state["actor"], actor_updates)
+
+        # ------------------------------------------------- critic update
+        traj = img_aux["imagined_trajectories"][:-1]
+        lambda_values = img_aux["lambda_values"]
+        discount = img_aux["discount"]
+        predicted_target_values = TwoHotEncodingDistribution(
+            agent.critic_logits(state["target_critic"], traj), dims=1
+        ).mean
+
+        def critic_loss_fn(critic_params):
+            qv = TwoHotEncodingDistribution(agent.critic_logits(critic_params, traj), dims=1)
+            value_loss = -qv.log_prob(lambda_values)
+            value_loss = value_loss - qv.log_prob(sg(predicted_target_values))
+            return jnp.mean(value_loss * discount[:-1].squeeze(-1))
+
+        value_loss, critic_grads = jax.value_and_grad(critic_loss_fn)(state["critic"])
+        critic_updates, critic_opt = txs["critic"].update(
+            critic_grads, opt_states["critic"], state["critic"]
+        )
+        state["critic"] = optax.apply_updates(state["critic"], critic_updates)
+
+        # target critic EMA (host decides tau; 0 = frozen)
+        state["target_critic"] = jax.tree_util.tree_map(
+            lambda p, tp: tau * p + (1 - tau) * tp, state["critic"], state["target_critic"]
+        )
+
+        opt_states = {"world_model": wm_opt, "actor": actor_opt, "critic": critic_opt}
+        metrics = {
+            "Loss/world_model_loss": rec_loss,
+            "Loss/observation_loss": aux["observation_loss"],
+            "Loss/reward_loss": aux["reward_loss"],
+            "Loss/state_loss": aux["state_loss"],
+            "Loss/continue_loss": aux["continue_loss"],
+            "State/kl": aux["kl"],
+            "State/post_entropy": Independent(
+                OneHotCategorical(logits=aux["posteriors_logits"]), 1
+            ).entropy().mean(),
+            "State/prior_entropy": Independent(
+                OneHotCategorical(logits=aux["priors_logits"]), 1
+            ).entropy().mean(),
+            "Loss/policy_loss": policy_loss,
+            "Loss/value_loss": value_loss,
+            "Grads/world_model": optax.global_norm(wm_grads),
+            "Grads/actor": optax.global_norm(actor_grads),
+            "Grads/critic": optax.global_norm(critic_grads),
+        }
+        return state, opt_states, img_aux["moments"], metrics
+
+    return train_step
+
+
+@register_algorithm()
+def main(runtime, cfg: Dict[str, Any]):
+    mesh = runtime.mesh
+    rank = runtime.global_rank
+    world_size = jax.process_count()
+
+    state_ckpt = None
+    if cfg.checkpoint.resume_from:
+        state_ckpt = load_checkpoint(cfg.checkpoint.resume_from)
+
+    # These arguments cannot be changed
+    cfg.env.frame_stack = -1
+    if 2 ** int(np.log2(cfg.env.screen_size)) != cfg.env.screen_size:
+        raise ValueError(f"The screen size must be a power of 2, got: {cfg.env.screen_size}")
+
+    logger = get_logger(runtime, cfg)
+    if logger is not None:
+        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
+    runtime.print(f"Log dir: {log_dir}")
+
+    vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
+    envs = vectorized_env(
+        [
+            partial(
+                RestartOnException,
+                make_env(
+                    cfg,
+                    cfg.seed + rank * cfg.env.num_envs + i,
+                    rank * cfg.env.num_envs,
+                    log_dir if rank == 0 else None,
+                    "train",
+                    vector_env_idx=i,
+                ),
+            )
+            for i in range(cfg.env.num_envs)
+        ],
+        autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
+    )
+    action_space = envs.single_action_space
+    observation_space = envs.single_observation_space
+
+    actions_dim, is_continuous = actions_metadata(action_space)
+    clip_rewards_fn = (lambda r: np.tanh(r)) if cfg.env.clip_rewards else (lambda r: r)
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    if (
+        len(set(cfg.algo.cnn_keys.encoder).intersection(set(cfg.algo.cnn_keys.decoder))) == 0
+        and len(set(cfg.algo.mlp_keys.encoder).intersection(set(cfg.algo.mlp_keys.decoder))) == 0
+    ):
+        raise RuntimeError("The CNN keys or the MLP keys of the encoder and decoder must not be disjointed")
+    if len(set(cfg.algo.cnn_keys.decoder) - set(cfg.algo.cnn_keys.encoder)) > 0:
+        raise RuntimeError(
+            "The CNN keys of the decoder must be contained in the encoder ones, "
+            f"got: decoder = {cfg.algo.cnn_keys.decoder}, encoder = {cfg.algo.cnn_keys.encoder}"
+        )
+    if len(set(cfg.algo.mlp_keys.decoder) - set(cfg.algo.mlp_keys.encoder)) > 0:
+        raise RuntimeError(
+            "The MLP keys of the decoder must be contained in the encoder ones, "
+            f"got: decoder = {cfg.algo.mlp_keys.decoder}, encoder = {cfg.algo.mlp_keys.encoder}"
+        )
+    if cfg.metric.log_level > 0:
+        runtime.print("Encoder CNN keys:", cfg.algo.cnn_keys.encoder)
+        runtime.print("Encoder MLP keys:", cfg.algo.mlp_keys.encoder)
+        runtime.print("Decoder CNN keys:", cfg.algo.cnn_keys.decoder)
+        runtime.print("Decoder MLP keys:", cfg.algo.mlp_keys.decoder)
+    obs_keys = list(cfg.algo.cnn_keys.encoder) + list(cfg.algo.mlp_keys.encoder)
+
+    agent, agent_state = build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        observation_space,
+        state_ckpt["world_model"] if state_ckpt is not None else None,
+        state_ckpt["actor"] if state_ckpt is not None else None,
+        state_ckpt["critic"] if state_ckpt is not None else None,
+        state_ckpt["target_critic"] if state_ckpt is not None else None,
+    )
+
+    txs = {
+        "world_model": _make_optimizer(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients),
+        "actor": _make_optimizer(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients),
+        "critic": _make_optimizer(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients),
+    }
+    opt_states = {
+        "world_model": txs["world_model"].init(agent_state["world_model"]),
+        "actor": txs["actor"].init(agent_state["actor"]),
+        "critic": txs["critic"].init(agent_state["critic"]),
+    }
+    if state_ckpt is not None:
+        for name, ckpt_key in (
+            ("world_model", "world_optimizer"),
+            ("actor", "actor_optimizer"),
+            ("critic", "critic_optimizer"),
+        ):
+            opt_states[name] = restore_opt_state(opt_states[name], state_ckpt[ckpt_key])
+
+    moments_state = init_moments()
+    if state_ckpt is not None and "moments" in state_ckpt:
+        moments_state = jax.tree_util.tree_map(jnp.asarray, state_ckpt["moments"])
+
+    if runtime.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
+
+    buffer_size = cfg.buffer.size // int(cfg.env.num_envs * world_size) if not cfg.dry_run else 2
+    rb = EnvIndependentReplayBuffer(
+        buffer_size,
+        n_envs=cfg.env.num_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+        buffer_cls=SequentialReplayBuffer,
+    )
+    if state_ckpt is not None and cfg.buffer.checkpoint and state_ckpt.get("rb") is not None:
+        rb = state_ckpt["rb"]
+
+    train_step_count = 0
+    last_train = 0
+    start_iter = (state_ckpt["iter_num"] // world_size) + 1 if state_ckpt is not None else 1
+    policy_step = state_ckpt["iter_num"] * cfg.env.num_envs if state_ckpt is not None else 0
+    last_log = state_ckpt["last_log"] if state_ckpt is not None else 0
+    last_checkpoint = state_ckpt["last_checkpoint"] if state_ckpt is not None else 0
+    policy_steps_per_iter = int(cfg.env.num_envs * world_size)
+    total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
+    prefill_steps = learning_starts - int(learning_starts > 0)
+    if state_ckpt is not None:
+        cfg.algo.per_rank_batch_size = state_ckpt["batch_size"] // world_size
+        learning_starts += start_iter
+        prefill_steps += start_iter
+
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    if state_ckpt is not None:
+        ratio.load_state_dict(state_ckpt["ratio"])
+
+    if cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"The metric.log_every parameter ({cfg.metric.log_every}) is not a multiple of the "
+            f"policy_steps_per_iter value ({policy_steps_per_iter}), so "
+            "the metrics will be logged at the nearest greater multiple of the policy_steps_per_iter value."
+        )
+    if cfg.checkpoint.every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"The checkpoint.every parameter ({cfg.checkpoint.every}) is not a multiple of the "
+            f"policy_steps_per_iter value ({policy_steps_per_iter}), so "
+            "the checkpoint will be saved at the nearest greater multiple of the policy_steps_per_iter value."
+        )
+
+    train_fn = make_train_step(agent, txs, cfg, mesh)
+    player_step_fn = jax.jit(
+        lambda wm, a, s, o, k: agent.player_step(wm, a, s, o, k, greedy=False)
+    )
+    init_player_fn = jax.jit(agent.init_player_state, static_argnums=(1,))
+    reset_player_fn = jax.jit(agent.reset_player_state)
+
+    rollout_key, train_key = jax.random.split(jax.random.fold_in(runtime.root_key, rank))
+
+    step_data = {}
+    obs = envs.reset(seed=cfg.seed)[0]
+    for k in obs_keys:
+        step_data[k] = obs[k][np.newaxis]
+    step_data["rewards"] = np.zeros((1, cfg.env.num_envs, 1), np.float32)
+    step_data["truncated"] = np.zeros((1, cfg.env.num_envs, 1), np.float32)
+    step_data["terminated"] = np.zeros((1, cfg.env.num_envs, 1), np.float32)
+    step_data["is_first"] = np.ones_like(step_data["terminated"])
+    player_state = init_player_fn(agent_state["world_model"], cfg.env.num_envs)
+
+    cumulative_per_rank_gradient_steps = 0
+    for iter_num in range(start_iter, total_iters + 1):
+        policy_step += policy_steps_per_iter
+
+        with timer("Time/env_interaction_time"):
+            if iter_num <= learning_starts and cfg.checkpoint.resume_from is None:
+                real_actions = actions = np.array(envs.action_space.sample())
+                if not is_continuous:
+                    actions = np.concatenate(
+                        [
+                            np.eye(act_dim, dtype=np.float32)[act]
+                            for act, act_dim in zip(actions.reshape(len(actions_dim), -1), actions_dim)
+                        ],
+                        axis=-1,
+                    )
+            else:
+                jnp_obs = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
+                rollout_key, sub = jax.random.split(rollout_key)
+                actions_cat, real_actions_j, player_state = player_step_fn(
+                    agent_state["world_model"], agent_state["actor"], player_state, jnp_obs, sub
+                )
+                actions = np.asarray(actions_cat)
+                real_actions = np.asarray(real_actions_j)
+
+            step_data["actions"] = actions.reshape((1, cfg.env.num_envs, -1))
+            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+            next_obs, rewards, terminated, truncated, infos = envs.step(
+                real_actions.reshape(envs.action_space.shape)
+            )
+            dones = np.logical_or(terminated, truncated).astype(np.uint8)
+
+        step_data["is_first"] = np.zeros_like(step_data["terminated"])
+        if "restart_on_exception" in infos:
+            for i, agent_roe in enumerate(infos["restart_on_exception"]):
+                if agent_roe and not dones[i]:
+                    # Patch the broken episode's tail in the buffer: mark it
+                    # truncated, restart a fresh episode
+                    # (reference: dreamer_v3.py:595-608).
+                    last_inserted_idx = (rb.buffer[i]._pos - 1) % rb.buffer[i].buffer_size
+                    rb.buffer[i]["terminated"][last_inserted_idx] = np.zeros_like(
+                        rb.buffer[i]["terminated"][last_inserted_idx]
+                    )
+                    rb.buffer[i]["truncated"][last_inserted_idx] = np.ones_like(
+                        rb.buffer[i]["truncated"][last_inserted_idx]
+                    )
+                    rb.buffer[i]["is_first"][last_inserted_idx] = np.zeros_like(
+                        rb.buffer[i]["is_first"][last_inserted_idx]
+                    )
+                    step_data["is_first"][:, i] = np.ones_like(step_data["is_first"][:, i])
+
+        if cfg.metric.log_level > 0 and "final_info" in infos:
+            fi = infos["final_info"]
+            for i in np.nonzero(fi.get("_episode", []))[0]:
+                ep_rew = float(fi["episode"]["r"][i])
+                ep_len = float(fi["episode"]["l"][i])
+                if aggregator and not aggregator.disabled:
+                    aggregator.update("Rewards/rew_avg", ep_rew)
+                    aggregator.update("Game/ep_len_avg", ep_len)
+                runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+
+        real_next_obs = copy.deepcopy(next_obs)
+        if "final_obs" in infos:
+            for idx in np.nonzero(dones)[0]:
+                final = infos["final_obs"][idx]
+                if final is not None:
+                    for k, v in final.items():
+                        real_next_obs[k][idx] = v
+
+        for k in obs_keys:
+            step_data[k] = next_obs[k][np.newaxis]
+        obs = next_obs
+
+        rewards = rewards.reshape((1, cfg.env.num_envs, -1))
+        step_data["terminated"] = terminated.reshape((1, cfg.env.num_envs, -1)).astype(np.float32)
+        step_data["truncated"] = truncated.reshape((1, cfg.env.num_envs, -1)).astype(np.float32)
+        step_data["rewards"] = clip_rewards_fn(rewards).astype(np.float32)
+
+        dones_idxes = dones.nonzero()[0].tolist()
+        reset_envs = len(dones_idxes)
+        if reset_envs > 0:
+            reset_data = {}
+            for k in obs_keys:
+                reset_data[k] = (real_next_obs[k][dones_idxes])[np.newaxis]
+            reset_data["terminated"] = step_data["terminated"][:, dones_idxes]
+            reset_data["truncated"] = step_data["truncated"][:, dones_idxes]
+            reset_data["actions"] = np.zeros((1, reset_envs, int(np.sum(actions_dim))), np.float32)
+            reset_data["rewards"] = step_data["rewards"][:, dones_idxes]
+            reset_data["is_first"] = np.zeros_like(reset_data["terminated"])
+            rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
+
+            step_data["rewards"][:, dones_idxes] = np.zeros_like(reset_data["rewards"])
+            step_data["terminated"][:, dones_idxes] = np.zeros_like(step_data["terminated"][:, dones_idxes])
+            step_data["truncated"][:, dones_idxes] = np.zeros_like(step_data["truncated"][:, dones_idxes])
+            step_data["is_first"][:, dones_idxes] = np.ones_like(step_data["is_first"][:, dones_idxes])
+            reset_mask = np.zeros((cfg.env.num_envs,), np.float32)
+            reset_mask[dones_idxes] = 1.0
+            player_state = reset_player_fn(agent_state["world_model"], player_state, jnp.asarray(reset_mask))
+
+        # ------------------------------------------------------- training
+        if iter_num >= learning_starts:
+            ratio_steps = policy_step - prefill_steps * policy_steps_per_iter
+            per_rank_gradient_steps = ratio(ratio_steps / world_size)
+            if per_rank_gradient_steps > 0:
+                local_data = rb.sample_tensors(
+                    cfg.algo.per_rank_batch_size,
+                    sequence_length=cfg.algo.per_rank_sequence_length,
+                    n_samples=per_rank_gradient_steps,
+                )
+                with timer("Time/train_time"):
+                    for i in range(per_rank_gradient_steps):
+                        if (
+                            cumulative_per_rank_gradient_steps
+                            % cfg.algo.critic.per_rank_target_network_update_freq
+                            == 0
+                        ):
+                            tau = 1.0 if cumulative_per_rank_gradient_steps == 0 else cfg.algo.critic.tau
+                        else:
+                            tau = 0.0
+                        batch = {
+                            k: jnp.asarray(np.asarray(v[i]), jnp.float32) if k not in cfg.algo.cnn_keys.encoder
+                            else jnp.asarray(np.asarray(v[i]))
+                            for k, v in local_data.items()
+                        }
+                        train_key, sub = jax.random.split(train_key)
+                        agent_state, opt_states, moments_state, train_metrics = train_fn(
+                            agent_state, opt_states, moments_state, batch, sub, jnp.asarray(tau, jnp.float32)
+                        )
+                        cumulative_per_rank_gradient_steps += 1
+                    jax.block_until_ready(agent_state["world_model"])
+                    train_step_count += world_size
+
+                if aggregator and not aggregator.disabled:
+                    for k, v in train_metrics.items():
+                        if k in aggregator:
+                            aggregator.update(k, np.asarray(v))
+
+        # -------------------------------------------------------- logging
+        if cfg.metric.log_level > 0 and logger is not None and (
+            policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
+        ):
+            if aggregator and not aggregator.disabled:
+                logger.log_dict(aggregator.compute(), policy_step)
+                aggregator.reset()
+            if policy_step > 0:
+                logger.log(
+                    "Params/replay_ratio",
+                    cumulative_per_rank_gradient_steps * world_size / policy_step,
+                    policy_step,
+                )
+            if not timer.disabled:
+                timer_metrics = timer.compute()
+                if timer_metrics.get("Time/train_time", 0) > 0:
+                    logger.log(
+                        "Time/sps_train",
+                        (train_step_count - last_train) / timer_metrics["Time/train_time"],
+                        policy_step,
+                    )
+                if timer_metrics.get("Time/env_interaction_time", 0) > 0:
+                    logger.log(
+                        "Time/sps_env_interaction",
+                        ((policy_step - last_log) / world_size * cfg.env.action_repeat)
+                        / timer_metrics["Time/env_interaction_time"],
+                        policy_step,
+                    )
+                timer.reset()
+            last_log = policy_step
+            last_train = train_step_count
+
+        # ----------------------------------------------------- checkpoint
+        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+            iter_num == total_iters and cfg.checkpoint.save_last
+        ):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "world_model": agent_state["world_model"],
+                "actor": agent_state["actor"],
+                "critic": agent_state["critic"],
+                "target_critic": agent_state["target_critic"],
+                "world_optimizer": opt_states["world_model"],
+                "actor_optimizer": opt_states["actor"],
+                "critic_optimizer": opt_states["critic"],
+                "moments": moments_state,
+                "ratio": ratio.state_dict(),
+                "iter_num": iter_num * world_size,
+                "batch_size": cfg.algo.per_rank_batch_size * world_size,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            if cfg.buffer.checkpoint:
+                ckpt_state["rb"] = rb
+            ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{rank}.ckpt")
+            if runtime.is_global_zero:
+                save_checkpoint(ckpt_path, ckpt_state, keep_last=cfg.checkpoint.keep_last)
+
+    envs.close()
+    if runtime.is_global_zero and cfg.algo.run_test:
+        test(agent, agent_state, runtime, cfg, log_dir, logger)
+
+    if logger is not None:
+        logger.close()

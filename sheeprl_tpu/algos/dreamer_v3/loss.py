@@ -1,0 +1,58 @@
+"""DreamerV3 world-model loss (reference: sheeprl/algos/dreamer_v3/loss.py:9-88;
+eq. 5 of the DreamerV3 paper)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.utils.distribution import Independent, OneHotCategoricalStraightThrough, kl_divergence
+
+
+def reconstruction_loss(
+    po: Dict[str, Any],
+    observations: Dict[str, jax.Array],
+    pr: Any,
+    rewards: jax.Array,
+    priors_logits: jax.Array,
+    posteriors_logits: jax.Array,
+    kl_dynamic: float = 0.5,
+    kl_representation: float = 0.1,
+    kl_free_nats: float = 1.0,
+    kl_regularizer: float = 1.0,
+    pc: Optional[Any] = None,
+    continue_targets: Optional[jax.Array] = None,
+    continue_scale_factor: float = 1.0,
+) -> Tuple[jax.Array, ...]:
+    """KL-balanced world-model objective. `priors_logits`/`posteriors_logits`
+    arrive shaped [..., stoch, discrete]."""
+    observation_loss = -sum(po[k].log_prob(observations[k]) for k in po.keys())
+    reward_loss = -pr.log_prob(rewards)
+    sg = jax.lax.stop_gradient
+    dyn_loss = kl = kl_divergence(
+        Independent(OneHotCategoricalStraightThrough(logits=sg(posteriors_logits)), 1),
+        Independent(OneHotCategoricalStraightThrough(logits=priors_logits), 1),
+    )
+    free_nats = jnp.full_like(dyn_loss, kl_free_nats)
+    dyn_loss = kl_dynamic * jnp.maximum(dyn_loss, free_nats)
+    repr_loss = kl_divergence(
+        Independent(OneHotCategoricalStraightThrough(logits=posteriors_logits), 1),
+        Independent(OneHotCategoricalStraightThrough(logits=sg(priors_logits)), 1),
+    )
+    repr_loss = kl_representation * jnp.maximum(repr_loss, free_nats)
+    kl_loss = dyn_loss + repr_loss
+    if pc is not None and continue_targets is not None:
+        continue_loss = continue_scale_factor * -pc.log_prob(continue_targets)
+    else:
+        continue_loss = jnp.zeros_like(reward_loss)
+    rec_loss = (kl_regularizer * kl_loss + observation_loss + reward_loss + continue_loss).mean()
+    return (
+        rec_loss,
+        kl.mean(),
+        kl_loss.mean(),
+        reward_loss.mean(),
+        observation_loss.mean(),
+        continue_loss.mean(),
+    )

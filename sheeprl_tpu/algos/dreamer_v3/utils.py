@@ -1,0 +1,79 @@
+"""DreamerV3 auxiliary contract (reference: sheeprl/algos/dreamer_v3/utils.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.ops import compute_lambda_values, init_moments, update_moments  # noqa: F401 (re-export)
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/kl",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "Grads/world_model",
+    "Grads/actor",
+    "Grads/critic",
+}
+MODELS_TO_REGISTER = {"world_model", "actor", "critic", "target_critic", "moments"}
+
+
+def prepare_obs(
+    obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), num_envs: int = 1, **kwargs: Any
+) -> Dict[str, jax.Array]:
+    """Host obs → float device arrays [num_envs, ...]; pixels → [-0.5, 0.5]
+    (reference: utils.py:80-91, without the CHW reshape — HWC layout)."""
+    out: Dict[str, jax.Array] = {}
+    for k, v in obs.items():
+        arr = jnp.asarray(v)
+        if k in cnn_keys:
+            arr = arr.reshape(num_envs, *arr.shape[-3:]).astype(jnp.float32) / 255.0 - 0.5
+        else:
+            arr = arr.reshape(num_envs, -1).astype(jnp.float32)
+        out[k] = arr
+    return out
+
+
+def test(agent, state, runtime, cfg: Dict[str, Any], log_dir: str, logger=None, sample_actions: bool = False) -> float:
+    """One greedy episode with the stateful (functional) player
+    (reference: utils.py:94-139)."""
+    env = make_env(cfg, None, 0, log_dir, "test", vector_env_idx=0)()
+    done = False
+    cumulative_rew = 0.0
+    obs = env.reset(seed=cfg.seed)[0]
+    player_step = jax.jit(
+        lambda wm, a, s, o, k: agent.player_step(wm, a, s, o, k, greedy=not sample_actions)
+    )
+    player_state = jax.jit(agent.init_player_state, static_argnums=(1,))(state["world_model"], 1)
+    key = jax.random.PRNGKey(cfg.seed if cfg.seed is not None else 0)
+    while not done:
+        key, sub = jax.random.split(key)
+        jnp_obs = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=1)
+        _, real_actions, player_state = player_step(
+            state["world_model"], state["actor"], player_state, jnp_obs, sub
+        )
+        obs, reward, done, truncated, _ = env.step(
+            np.asarray(real_actions).reshape(env.action_space.shape)
+        )
+        done = done or truncated
+        cumulative_rew += reward
+        if cfg.dry_run:
+            done = True
+    runtime.print("Test - Reward:", cumulative_rew)
+    if cfg.metric.log_level > 0 and logger is not None:
+        logger.log_dict({"Test/cumulative_reward": cumulative_rew}, 0)
+    env.close()
+    return cumulative_rew

@@ -113,6 +113,9 @@ def make_norm(norm: Union[None, str, Callable[..., nn.Module]], args: Optional[d
     args = dict(args or {})
     # torch LayerNorm configs carry normalized_shape; flax infers it.
     args.pop("normalized_shape", None)
+    # torch spells the epsilon kwarg "eps".
+    if "eps" in args:
+        args["epsilon"] = args.pop("eps")
     if callable(norm) and not isinstance(norm, str):
         return norm(**args)
     try:
@@ -160,6 +163,8 @@ class MLP(nn.Module):
     dropout: Union[None, float, Sequence[Optional[float]]] = None
     layer_args: Any = None
     flatten_dim: Optional[int] = None
+    kernel_init: Optional[Callable] = None
+    output_kernel_init: Optional[Callable] = None
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
 
@@ -179,12 +184,14 @@ class MLP(nn.Module):
         x = x.astype(self.dtype)
         for i, size in enumerate(self.hidden_sizes):
             kw = dict(largs[i] or {})
+            init_kw = {"kernel_init": self.kernel_init} if self.kernel_init is not None else {}
             x = nn.Dense(
                 size,
                 use_bias=kw.get("bias", True),
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
                 name=f"dense_{i}",
+                **init_kw,
             )(x)
             x = _apply_block(
                 x,
@@ -195,8 +202,10 @@ class MLP(nn.Module):
                 deterministic=deterministic,
             )
         if self.output_dim is not None:
+            out_init = self.output_kernel_init or self.kernel_init
+            init_kw = {"kernel_init": out_init} if out_init is not None else {}
             x = nn.Dense(
-                self.output_dim, dtype=self.dtype, param_dtype=self.param_dtype, name="output"
+                self.output_dim, dtype=self.dtype, param_dtype=self.param_dtype, name="output", **init_kw
             )(x)
         return x
 
@@ -222,6 +231,7 @@ class CNN(nn.Module):
     norm_args: Any = None
     dropout: Union[None, float, Sequence[Optional[float]]] = None
     layer_args: Any = None
+    kernel_init: Optional[Callable] = None
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
 
@@ -235,6 +245,7 @@ class CNN(nn.Module):
         norm_args = _per_layer(self.norm_args, n, "norm_args")
         drops = _per_layer(self.dropout, n, "dropout")
         largs = _per_layer(self.layer_args, n, "layer_args")
+        init_kw = {"kernel_init": self.kernel_init} if self.kernel_init is not None else {}
         x = x.astype(self.dtype)
         for i, ch in enumerate(self.hidden_channels):
             kw = dict(largs[i] or {})
@@ -251,6 +262,7 @@ class CNN(nn.Module):
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
                 name=f"conv_{i}",
+                **init_kw,
             )(x)
             x = _apply_block(
                 x,
@@ -278,6 +290,7 @@ class DeCNN(nn.Module):
     norm_args: Any = None
     dropout: Union[None, float, Sequence[Optional[float]]] = None
     layer_args: Any = None
+    kernel_init: Any = None
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
 
@@ -291,6 +304,7 @@ class DeCNN(nn.Module):
         norm_args = _per_layer(self.norm_args, n, "norm_args")
         drops = _per_layer(self.dropout, n, "dropout")
         largs = _per_layer(self.layer_args, n, "layer_args")
+        kernel_inits = _per_layer(self.kernel_init, n, "kernel_init")
         x = x.astype(self.dtype)
         for i, ch in enumerate(self.hidden_channels):
             kw = dict(largs[i] or {})
@@ -305,6 +319,7 @@ class DeCNN(nn.Module):
                 (kernel[0] - 1 - pad[0], kernel[0] - 1 - pad[0] + out_pad[0]),
                 (kernel[1] - 1 - pad[1], kernel[1] - 1 - pad[1] + out_pad[1]),
             ]
+            init_kw = {"kernel_init": kernel_inits[i]} if kernel_inits[i] is not None else {}
             x = nn.ConvTranspose(
                 ch,
                 kernel_size=kernel,
@@ -314,6 +329,7 @@ class DeCNN(nn.Module):
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
                 name=f"deconv_{i}",
+                **init_kw,
             )(x)
             x = _apply_block(
                 x,
