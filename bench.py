@@ -1,12 +1,24 @@
-"""Driver benchmark: PPO CartPole-v1 env-steps/sec (current flagship slice).
+"""Driver benchmark. Prints exactly ONE JSON line:
+{"metric", "value", "unit", "vs_baseline"}.
 
-Reference baseline: the SheepRL README PPO benchmark — 65,536 env steps in
-81.27 s on 4 CPUs (README.md:100-117), i.e. ~806 env-steps/sec. This script
-runs the same workload (exp=ppo_benchmarks: 1 env, rollout 128, batch 64,
-10 epochs) for a fixed number of steps and reports steady-state throughput,
-excluding the first two iterations (XLA compile warmup).
+Default workload: **DreamerV3** — the north-star metric (BASELINE.json) — on
+the reference benchmark recipe (configs/exp/dreamer_v3_benchmarks.yaml:1-41):
+16,384 policy steps, 1 env, micro world model (dense_units=8, discrete=4,
+stochastic=4, recurrent=8), learning_starts=1024, replay_ratio=0.0625,
+batch 16 × sequence 64. Reference wall-clock: 1589.30 s on 4 CPUs
+(README.md:168-176) → ~10.31 env-steps/sec.
 
-Prints exactly one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Divergence (documented): the reference benchmark steps MsPacman through ALE;
+ALE is not installed in this image, so the env is the deterministic dummy
+pixel env (64×64×3 uint8 — one channel MORE than the reference's grayscale
+Atari frames). The ALE emulator contributes only a few seconds of the
+reference's 1589 s (it runs at ~10k fps), so the comparison remains dominated
+by what the benchmark actually measures: the world-model/actor/critic
+training step and the per-step policy latency.
+
+Select the secondary workload with `python bench.py ppo`:
+PPO CartPole-v1, 16,384 steps vs the README PPO benchmark (65,536 steps in
+81.27 s, README.md:100-117).
 """
 
 import json
@@ -14,11 +26,8 @@ import os
 import sys
 import time
 
-BASELINE_STEPS_PER_SEC = 65536 / 81.27  # reference PPO benchmark (README.md:100-117)
-BENCH_STEPS = 16384
 
-
-def main() -> None:
+def _setup_jax():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import jax
 
@@ -27,57 +36,106 @@ def main() -> None:
     jax.config.update("jax_compilation_cache_dir", "/tmp/sheeprl_tpu_jax_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
-    import sheeprl_tpu
-    from sheeprl_tpu.cli import check_configs, run_algorithm  # noqa: F401
-    from sheeprl_tpu.config.loader import compose
 
-    sheeprl_tpu.register_all()
-    cfg = compose(
-        "config",
-        [
-            "exp=ppo_benchmarks",
-            f"algo.total_steps={BENCH_STEPS}",
-            "checkpoint.every=0",
-            "checkpoint.save_last=False",
-        ],
-    )
-    check_configs(cfg)
-
-    # Time iterations ourselves: wrap the registered entrypoint's timer by
-    # timing full-run wall clock minus the compile-heavy first iterations.
-    # Simpler and robust: run twice — a tiny warmup run (compiles cached in
-    # process) then the measured run.
+def _run_silent(cfg):
     import io
     import contextlib
 
-    warmup_cfg = compose(
-        "config",
-        [
-            "exp=ppo_benchmarks",
-            "algo.total_steps=256",
-            "checkpoint.every=0",
-            "checkpoint.save_last=False",
-        ],
-    )
-    with contextlib.redirect_stdout(io.StringIO()):
-        run_algorithm(warmup_cfg)
+    from sheeprl_tpu.cli import run_algorithm
 
-    start = time.perf_counter()
     with contextlib.redirect_stdout(io.StringIO()):
         run_algorithm(cfg)
-    elapsed = time.perf_counter() - start
 
-    steps_per_sec = BENCH_STEPS / elapsed
-    print(
-        json.dumps(
-            {
-                "metric": "ppo_cartpole_env_steps_per_sec",
-                "value": round(steps_per_sec, 2),
-                "unit": "env-steps/sec",
-                "vs_baseline": round(steps_per_sec / BASELINE_STEPS_PER_SEC, 3),
-            }
-        )
+
+def bench_ppo():
+    from sheeprl_tpu.cli import check_configs
+    from sheeprl_tpu.config.loader import compose
+
+    steps = 16384
+    baseline_sps = 65536 / 81.27  # README.md:100-117
+    common = [
+        "exp=ppo_benchmarks",
+        "checkpoint.every=0",
+        "checkpoint.save_last=False",
+    ]
+    cfg = compose("config", common + [f"algo.total_steps={steps}"])
+    check_configs(cfg)
+    warmup = compose("config", common + ["algo.total_steps=256"])
+    _run_silent(warmup)
+    start = time.perf_counter()
+    _run_silent(cfg)
+    elapsed = time.perf_counter() - start
+    sps = steps / elapsed
+    return {
+        "metric": "ppo_cartpole_env_steps_per_sec",
+        "value": round(sps, 2),
+        "unit": "env-steps/sec",
+        "vs_baseline": round(sps / baseline_sps, 3),
+    }
+
+
+def bench_dreamer_v3():
+    from sheeprl_tpu.cli import check_configs
+    from sheeprl_tpu.config.loader import compose
+
+    steps = 16384
+    baseline_sps = 16384 / 1589.30  # README.md:168-176 (V100-class 4-CPU box)
+    common = [
+        "exp=dreamer_v3",
+        "env=dummy",
+        "env.num_envs=1",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "env.screen_size=64",
+        "algo.cnn_keys.encoder=[rgb]",
+        "algo.mlp_keys.encoder=[]",
+        # micro world model, reference benchmark sizes
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.world_model.discrete_size=4",
+        "algo.world_model.stochastic_size=4",
+        "algo.world_model.encoder.cnn_channels_multiplier=2",
+        "algo.world_model.recurrent_model.recurrent_state_size=8",
+        "algo.world_model.transition_model.hidden_size=8",
+        "algo.world_model.representation_model.hidden_size=8",
+        "algo.replay_ratio=0.0625",
+        "algo.run_test=False",
+        "buffer.size=16384",
+        "buffer.memmap=False",
+        "checkpoint.every=0",
+        "checkpoint.save_last=False",
+        "metric.log_level=0",
+    ]
+    cfg = compose(
+        "config", common + [f"algo.total_steps={steps}", "algo.learning_starts=1024"]
     )
+    check_configs(cfg)
+    # Warmup compiles the player step AND the train step (learning must start
+    # within the warmup horizon).
+    warmup = compose(
+        "config", common + ["algo.total_steps=1536", "algo.learning_starts=128"]
+    )
+    _run_silent(warmup)
+    start = time.perf_counter()
+    _run_silent(cfg)
+    elapsed = time.perf_counter() - start
+    sps = steps / elapsed
+    return {
+        "metric": "dreamer_v3_env_steps_per_sec",
+        "value": round(sps, 2),
+        "unit": "env-steps/sec",
+        "vs_baseline": round(sps / baseline_sps, 3),
+    }
+
+
+def main() -> None:
+    _setup_jax()
+    import sheeprl_tpu
+
+    sheeprl_tpu.register_all()
+    which = sys.argv[1] if len(sys.argv) > 1 else "dreamer_v3"
+    result = {"dreamer_v3": bench_dreamer_v3, "ppo": bench_ppo}[which]()
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -40,12 +40,30 @@ _EVAL_MODULES = [m.rsplit(".", 1)[0] + ".evaluate" for m in _ALGO_MODULES]
 
 
 def register_all() -> None:
-    """Import all algorithm + evaluation modules, populating the registries."""
+    """Import all algorithm + evaluation modules, populating the registries.
+
+    A module that is absent from disk (not yet built / optional) is skipped
+    silently; a module that EXISTS but fails to import is a real bug (a broken
+    refactor would otherwise surface later as "unknown algorithm"), so it
+    warns — or raises under SHEEPRL_TPU_STRICT_IMPORTS=1.
+    """
     import importlib
+    import importlib.util
+    import warnings
 
     for mod in _ALGO_MODULES + _EVAL_MODULES:
         try:
             importlib.import_module(mod)
-        except ImportError:
+        except ImportError as e:
             if os.environ.get("SHEEPRL_TPU_STRICT_IMPORTS", "0") == "1":
                 raise
+            try:
+                on_disk = importlib.util.find_spec(mod) is not None
+            except ModuleNotFoundError:
+                on_disk = False
+            if on_disk:
+                warnings.warn(
+                    f"algorithm module '{mod}' exists but failed to import ({e!r}); "
+                    "its algorithms will be unavailable",
+                    ImportWarning,
+                )

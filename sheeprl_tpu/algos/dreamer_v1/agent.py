@@ -1,0 +1,496 @@
+"""DreamerV1 agent (flax): continuous-latent RSSM world model, actor, critic.
+
+Capability parity with the reference agent
+(sheeprl/algos/dreamer_v1/agent.py:31-547). DV1 reuses the DV2
+encoder/decoder/actor modules (the reference does the same,
+agent.py:16-19); what is specific here:
+
+- The stochastic state is a CONTINUOUS diagonal Normal of size
+  `stochastic_size` (default 30): the representation/transition MLPs emit
+  (mean, std) chunks, std = softplus(std) + min_std
+  (dreamer_v1/utils.py compute_stochastic_state).
+- The recurrent model is Dense+ELU into a STANDARD GRU cell (torch nn.GRU,
+  agent.py:42-61) — not the Hafner LayerNorm GRU — so `flax.linen.GRUCell`
+  is the exact analog.
+- `dynamic` has no is_first reset handling (agent.py:97-134); episode starts
+  are only implicit in the zero initial states.
+- The player adds exploration noise (expl_amount=0.3 with optional decay,
+  reference get_exploration_actions, agent.py:278-300).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from sheeprl_tpu.algos.dreamer_v2.agent import (
+    DV2Actor,
+    DV2ActorSpec,
+    DV2CNNDecoder,
+    DV2CNNEncoder,
+    DV2MLPDecoder,
+    DV2MLPEncoder,
+    add_exploration_noise,
+    dv2_actor_forward,
+    xavier_normal_init,
+)
+from sheeprl_tpu.models import MLP
+from sheeprl_tpu.utils.distribution import Independent, Normal
+
+
+def compute_stochastic_state_v1(
+    state_information: jax.Array, key: Optional[jax.Array], min_std: float = 0.1
+) -> Tuple[Tuple[jax.Array, jax.Array], jax.Array]:
+    """(mean, std), sample from the (mean, raw-std) chunks emitted by the
+    representation/transition models (reference: dreamer_v1/utils.py
+    compute_stochastic_state)."""
+    mean, std = jnp.split(state_information, 2, axis=-1)
+    std = jax.nn.softplus(std) + min_std
+    dist = Independent(Normal(mean, std), 1)
+    sample = dist.rsample(key) if key is not None else mean
+    return (mean, std), sample
+
+
+class DV1RecurrentModel(nn.Module):
+    """Dense+ELU into a standard GRU cell (reference: RecurrentModel,
+    agent.py:31-61)."""
+
+    recurrent_state_size: int
+    activation: str = "elu"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, recurrent_state: jax.Array) -> jax.Array:
+        feat = MLP(
+            hidden_sizes=[self.recurrent_state_size],
+            activation=self.activation,
+            kernel_init=xavier_normal_init,
+            dtype=self.dtype,
+            name="mlp",
+        )(x)
+        new_h, _ = nn.GRUCell(
+            features=self.recurrent_state_size,
+            dtype=self.dtype,
+            kernel_init=xavier_normal_init,
+            name="rnn",
+        )(recurrent_state.astype(self.dtype), feat)
+        return new_h
+
+
+class DV1WorldModel(nn.Module):
+    """Encoder + continuous-latent RSSM + decoders + reward (+ optional
+    continue) heads (reference: WorldModel container, agent.py:199-217 +
+    RSSM, agent.py:64-197)."""
+
+    cnn_keys: Sequence[str]
+    mlp_keys: Sequence[str]
+    cnn_input_channels: Sequence[int]
+    mlp_input_dims: Sequence[int]
+    image_size: Tuple[int, int]
+    actions_dim: Sequence[int]
+    stochastic_size: int = 30
+    recurrent_state_size: int = 200
+    transition_hidden_size: int = 200
+    representation_hidden_size: int = 200
+    encoder_cnn_channels_multiplier: int = 32
+    encoder_mlp_layers: int = 4
+    encoder_dense_units: int = 400
+    decoder_cnn_channels_multiplier: int = 32
+    decoder_mlp_layers: int = 4
+    decoder_dense_units: int = 400
+    reward_mlp_layers: int = 4
+    reward_dense_units: int = 400
+    continue_mlp_layers: int = 4
+    continue_dense_units: int = 400
+    use_continues: bool = False
+    min_std: float = 0.1
+    cnn_act: str = "relu"
+    dense_act: str = "elu"
+    dtype: Any = jnp.float32
+
+    @property
+    def latent_state_size(self) -> int:
+        return self.stochastic_size + self.recurrent_state_size
+
+    def setup(self) -> None:
+        self.cnn_encoder = (
+            DV2CNNEncoder(
+                keys=self.cnn_keys,
+                channels_multiplier=self.encoder_cnn_channels_multiplier,
+                activation=self.cnn_act,
+                layer_norm=False,
+                dtype=self.dtype,
+            )
+            if len(self.cnn_keys) > 0
+            else None
+        )
+        self.mlp_encoder = (
+            DV2MLPEncoder(
+                keys=self.mlp_keys,
+                mlp_layers=self.encoder_mlp_layers,
+                dense_units=self.encoder_dense_units,
+                activation=self.dense_act,
+                layer_norm=False,
+                dtype=self.dtype,
+            )
+            if len(self.mlp_keys) > 0
+            else None
+        )
+        self.recurrent_model = DV1RecurrentModel(
+            recurrent_state_size=self.recurrent_state_size,
+            activation=self.dense_act,
+            dtype=self.dtype,
+        )
+        self.representation_model = MLP(
+            hidden_sizes=[self.representation_hidden_size],
+            output_dim=2 * self.stochastic_size,
+            activation=self.dense_act,
+            kernel_init=xavier_normal_init,
+            output_kernel_init=xavier_normal_init,
+            dtype=self.dtype,
+        )
+        self.transition_model = MLP(
+            hidden_sizes=[self.transition_hidden_size],
+            output_dim=2 * self.stochastic_size,
+            activation=self.dense_act,
+            kernel_init=xavier_normal_init,
+            output_kernel_init=xavier_normal_init,
+            dtype=self.dtype,
+        )
+        from sheeprl_tpu.algos.dreamer_v2.agent import cnn_encoder_output_dim
+
+        enc_out = cnn_encoder_output_dim(self.image_size, self.encoder_cnn_channels_multiplier)
+        self.cnn_decoder = (
+            DV2CNNDecoder(
+                keys=self.cnn_keys,
+                output_channels=self.cnn_input_channels,
+                channels_multiplier=self.decoder_cnn_channels_multiplier,
+                cnn_encoder_output_dim=enc_out,
+                image_size=self.image_size,
+                activation=self.cnn_act,
+                layer_norm=False,
+                dtype=self.dtype,
+            )
+            if len(self.cnn_keys) > 0
+            else None
+        )
+        self.mlp_decoder = (
+            DV2MLPDecoder(
+                keys=self.mlp_keys,
+                output_dims=self.mlp_input_dims,
+                mlp_layers=self.decoder_mlp_layers,
+                dense_units=self.decoder_dense_units,
+                activation=self.dense_act,
+                layer_norm=False,
+                dtype=self.dtype,
+            )
+            if len(self.mlp_keys) > 0
+            else None
+        )
+        self.reward_model = MLP(
+            hidden_sizes=[self.reward_dense_units] * self.reward_mlp_layers,
+            output_dim=1,
+            activation=self.dense_act,
+            kernel_init=xavier_normal_init,
+            output_kernel_init=xavier_normal_init,
+            dtype=self.dtype,
+        )
+        self.continue_model = (
+            MLP(
+                hidden_sizes=[self.continue_dense_units] * self.continue_mlp_layers,
+                output_dim=1,
+                activation=self.dense_act,
+                kernel_init=xavier_normal_init,
+                output_kernel_init=xavier_normal_init,
+                dtype=self.dtype,
+            )
+            if self.use_continues
+            else None
+        )
+
+    # --------------------------------------------------------------- encoder
+    def embed_obs(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        outs = []
+        if self.cnn_encoder is not None:
+            outs.append(self.cnn_encoder(obs))
+        if self.mlp_encoder is not None:
+            outs.append(self.mlp_encoder(obs))
+        return jnp.concatenate(outs, axis=-1) if len(outs) > 1 else outs[0]
+
+    # ------------------------------------------------------------------ rssm
+    def _representation(
+        self, recurrent_state: jax.Array, embedded_obs: jax.Array, key: Optional[jax.Array]
+    ) -> Tuple[Tuple[jax.Array, jax.Array], jax.Array]:
+        return compute_stochastic_state_v1(
+            self.representation_model(jnp.concatenate([recurrent_state, embedded_obs], -1)),
+            key,
+            self.min_std,
+        )
+
+    def _transition(
+        self, recurrent_out: jax.Array, key: Optional[jax.Array]
+    ) -> Tuple[Tuple[jax.Array, jax.Array], jax.Array]:
+        return compute_stochastic_state_v1(
+            self.transition_model(recurrent_out), key, self.min_std
+        )
+
+    def dynamic(
+        self,
+        posterior: jax.Array,
+        recurrent_state: jax.Array,
+        action: jax.Array,
+        embedded_obs: jax.Array,
+        key: jax.Array,
+    ):
+        """One step of dynamic learning (reference: RSSM.dynamic,
+        agent.py:97-134 — no is_first handling in DV1)."""
+        k1, k2 = jax.random.split(key)
+        recurrent_state = self.recurrent_model(
+            jnp.concatenate([posterior, action], -1), recurrent_state
+        )
+        prior_mean_std, prior = self._transition(recurrent_state, k1)
+        posterior_mean_std, posterior = self._representation(recurrent_state, embedded_obs, k2)
+        return recurrent_state, posterior, prior, posterior_mean_std, prior_mean_std
+
+    def imagination(
+        self, stochastic_state: jax.Array, recurrent_state: jax.Array, actions: jax.Array, key: jax.Array
+    ) -> Tuple[jax.Array, jax.Array]:
+        """One-step latent imagination (reference: RSSM.imagination,
+        agent.py:170-197)."""
+        recurrent_state = self.recurrent_model(
+            jnp.concatenate([stochastic_state, actions], -1), recurrent_state
+        )
+        _, imagined_prior = self._transition(recurrent_state, key)
+        return imagined_prior, recurrent_state
+
+    # ----------------------------------------------------------------- heads
+    def decode(self, latent_states: jax.Array) -> Dict[str, jax.Array]:
+        out: Dict[str, jax.Array] = {}
+        if self.cnn_decoder is not None:
+            out.update(self.cnn_decoder(latent_states))
+        if self.mlp_decoder is not None:
+            out.update(self.mlp_decoder(latent_states))
+        return out
+
+    def reward(self, latent_states: jax.Array) -> jax.Array:
+        return self.reward_model(latent_states)
+
+    def continue_logits(self, latent_states: jax.Array) -> jax.Array:
+        if self.continue_model is None:
+            raise ValueError("use_continues is False: the continue model does not exist")
+        return self.continue_model(latent_states)
+
+    def __call__(self, obs: Dict[str, jax.Array], actions: jax.Array, key: jax.Array):
+        """Init-only pass touching every submodule once."""
+        embedded = self.embed_obs(obs)
+        batch = embedded.shape[:-1]
+        h0 = jnp.zeros((*batch, self.recurrent_state_size), self.dtype)
+        z0 = jnp.zeros((*batch, self.stochastic_size), self.dtype)
+        h, post, prior, _, _ = self.dynamic(z0, h0, actions, embedded, key)
+        latent = jnp.concatenate([post, h], -1)
+        out = (self.decode(latent), self.reward(latent))
+        if self.continue_model is not None:
+            out = out + (self.continue_logits(latent),)
+        return out
+
+
+@dataclass(frozen=True)
+class DV1Agent:
+    """Bundles modules + metadata; params live in the train state
+    {world_model, actor, critic}."""
+
+    world_model: DV1WorldModel
+    actor: DV2Actor
+    critic: Any  # MLP
+    actor_spec: DV2ActorSpec
+    actions_dim: Tuple[int, ...]
+    is_continuous: bool
+
+    def wm(self, params, *args, method: str):
+        return self.world_model.apply(params, *args, method=getattr(DV1WorldModel, method))
+
+    def critic_value(self, params, latent: jax.Array) -> jax.Array:
+        return self.critic.apply(params, latent)
+
+    # ---------------------------------------------------------------- player
+    def init_player_state(self, wm_params, n_envs: int) -> Dict[str, jax.Array]:
+        del wm_params
+        return {
+            "recurrent_state": jnp.zeros((n_envs, self.world_model.recurrent_state_size)),
+            "stochastic_state": jnp.zeros((n_envs, self.world_model.stochastic_size)),
+            "actions": jnp.zeros((n_envs, int(np.sum(self.actions_dim)))),
+        }
+
+    def reset_player_state(
+        self, wm_params, state: Dict[str, jax.Array], reset_mask: jax.Array
+    ) -> Dict[str, jax.Array]:
+        m = reset_mask[..., None]
+        return {k: (1 - m) * v for k, v in state.items()}
+
+    def player_step(
+        self,
+        wm_params,
+        actor_params,
+        state: Dict[str, jax.Array],
+        obs: Dict[str, jax.Array],
+        key: jax.Array,
+        greedy: bool = False,
+        expl_amount: jax.Array = None,
+    ):
+        """One acting step (reference: PlayerDV1.get_actions/
+        get_exploration_actions, agent.py:278-334). When `expl_amount` is
+        given, exploration noise is added to the sampled actions."""
+        k1, k2, k3 = jax.random.split(key, 3)
+        embedded = self.wm(wm_params, obs, method="embed_obs")
+        recurrent_state = self.world_model.apply(
+            wm_params,
+            jnp.concatenate([state["stochastic_state"], state["actions"]], -1),
+            state["recurrent_state"],
+            method=lambda wm, x, h: wm.recurrent_model(x, h),
+        )
+        _, stochastic_state = self.world_model.apply(
+            wm_params, recurrent_state, embedded, k1, method=DV1WorldModel._representation
+        )
+        latent = jnp.concatenate([stochastic_state, recurrent_state], -1)
+        pre_dist = self.actor.apply(actor_params, latent)
+        actions, _ = dv2_actor_forward(pre_dist, self.actor_spec, k2, greedy)
+        actions_cat = jnp.concatenate(actions, -1)
+        if expl_amount is not None:
+            actions_cat = add_exploration_noise(
+                actions_cat, self.actor_spec, expl_amount, k3, self.actions_dim
+            )
+        if self.is_continuous:
+            real_actions = actions_cat
+        else:
+            splits = np.cumsum(np.asarray(self.actions_dim))[:-1]
+            real_actions = jnp.stack(
+                [jnp.argmax(a, -1) for a in jnp.split(actions_cat, splits, -1)], -1
+            )
+        new_state = {
+            "recurrent_state": recurrent_state,
+            "stochastic_state": stochastic_state,
+            "actions": actions_cat,
+        }
+        return actions_cat, real_actions, new_state
+
+
+def build_agent(
+    runtime,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+    world_model_state: Optional[Any] = None,
+    actor_state: Optional[Any] = None,
+    critic_state: Optional[Any] = None,
+) -> Tuple[DV1Agent, Dict[str, Any]]:
+    """Construct modules + initial (or restored) params
+    (reference: build_agent, agent.py:337-547)."""
+    dtype = runtime.precision.compute_dtype
+    distribution = str(cfg.distribution.get("type", "auto")).lower()
+    if distribution not in ("auto", "normal", "tanh_normal", "discrete", "trunc_normal"):
+        raise ValueError(
+            "The distribution must be on of: `auto`, `discrete`, `normal`, `tanh_normal` and `trunc_normal`. "
+            f"Found: {distribution}"
+        )
+    if distribution == "discrete" and is_continuous:
+        raise ValueError("You have choose a discrete distribution but `is_continuous` is true")
+    if distribution == "auto":
+        distribution = "trunc_normal" if is_continuous else "discrete"
+
+    wm_cfg = cfg.algo.world_model
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    wm = DV1WorldModel(
+        cnn_keys=tuple(cnn_keys),
+        mlp_keys=tuple(mlp_keys),
+        cnn_input_channels=tuple(int(obs_space[k].shape[-1]) for k in cnn_keys),
+        mlp_input_dims=tuple(int(obs_space[k].shape[0]) for k in mlp_keys),
+        image_size=tuple(obs_space[cnn_keys[0]].shape[:2]) if cnn_keys else (64, 64),
+        actions_dim=tuple(actions_dim),
+        stochastic_size=wm_cfg.stochastic_size,
+        recurrent_state_size=wm_cfg.recurrent_model.recurrent_state_size,
+        transition_hidden_size=wm_cfg.transition_model.hidden_size,
+        representation_hidden_size=wm_cfg.representation_model.hidden_size,
+        encoder_cnn_channels_multiplier=wm_cfg.encoder.cnn_channels_multiplier,
+        encoder_mlp_layers=wm_cfg.encoder.mlp_layers,
+        encoder_dense_units=wm_cfg.encoder.dense_units,
+        decoder_cnn_channels_multiplier=wm_cfg.observation_model.cnn_channels_multiplier,
+        decoder_mlp_layers=wm_cfg.observation_model.mlp_layers,
+        decoder_dense_units=wm_cfg.observation_model.dense_units,
+        reward_mlp_layers=wm_cfg.reward_model.mlp_layers,
+        reward_dense_units=wm_cfg.reward_model.dense_units,
+        continue_mlp_layers=wm_cfg.discount_model.mlp_layers,
+        continue_dense_units=wm_cfg.discount_model.dense_units,
+        use_continues=bool(wm_cfg.use_continues),
+        min_std=float(wm_cfg.min_std),
+        cnn_act="relu",
+        dense_act="elu",
+        dtype=dtype,
+    )
+    actor = DV2Actor(
+        actions_dim=tuple(actions_dim),
+        is_continuous=is_continuous,
+        dense_units=cfg.algo.actor.dense_units,
+        mlp_layers=cfg.algo.actor.mlp_layers,
+        activation="elu",
+        layer_norm=False,
+        dtype=dtype,
+    )
+    critic = MLP(
+        hidden_sizes=[cfg.algo.critic.dense_units] * cfg.algo.critic.mlp_layers,
+        output_dim=1,
+        activation="elu",
+        kernel_init=xavier_normal_init,
+        output_kernel_init=xavier_normal_init,
+        dtype=dtype,
+    )
+    spec = DV2ActorSpec(
+        actions_dim=tuple(int(d) for d in actions_dim),
+        is_continuous=is_continuous,
+        distribution=distribution,
+        init_std=cfg.algo.actor.init_std,
+        min_std=cfg.algo.actor.min_std,
+        expl_amount=float(cfg.algo.actor.get("expl_amount", 0.3)),
+        expl_decay=float(cfg.algo.actor.get("expl_decay", 0.0)),
+        expl_min=float(cfg.algo.actor.get("expl_min", 0.0)),
+    )
+    agent = DV1Agent(
+        world_model=wm,
+        actor=actor,
+        critic=critic,
+        actor_spec=spec,
+        actions_dim=tuple(int(d) for d in actions_dim),
+        is_continuous=is_continuous,
+    )
+
+    k_wm, k_actor, k_critic, k_call = jax.random.split(runtime.root_key, 4)
+    n = 1
+    dummy_obs = {
+        k: jnp.zeros((n, *obs_space[k].shape), jnp.float32) for k in cnn_keys + mlp_keys
+    }
+    dummy_actions = jnp.zeros((n, int(np.sum(actions_dim))), jnp.float32)
+    latent_size = wm.latent_state_size
+
+    if world_model_state is not None:
+        wm_params = jax.tree_util.tree_map(jnp.asarray, world_model_state)
+    else:
+        wm_params = wm.init({"params": k_wm, "sample": k_call}, dummy_obs, dummy_actions, k_call)
+    actor_params = (
+        jax.tree_util.tree_map(jnp.asarray, actor_state)
+        if actor_state is not None
+        else actor.init(k_actor, jnp.zeros((n, latent_size), jnp.float32))
+    )
+    critic_params = (
+        jax.tree_util.tree_map(jnp.asarray, critic_state)
+        if critic_state is not None
+        else critic.init(k_critic, jnp.zeros((n, latent_size), jnp.float32))
+    )
+    state = {"world_model": wm_params, "actor": actor_params, "critic": critic_params}
+    return agent, state

@@ -1,18 +1,13 @@
-"""DreamerV3 training loop (reference: sheeprl/algos/dreamer_v3/dreamer_v3.py).
+"""DreamerV1 training loop (reference: sheeprl/algos/dreamer_v1/dreamer_v1.py).
 
-TPU-first structure (SURVEY §3.3 / §7.2):
-- Dynamic learning: the RSSM runs as ONE `lax.scan` over the sequence axis
-  (the reference python-loops per-step GRU cells, dreamer_v3.py:134-145) —
-  carry = (h, z), stacked outputs (h_t, z_t, logits).
-- Behaviour learning: imagination is a second `lax.scan` over the horizon
-  starting from every (t, b) posterior flattened to one batch, with per-step
-  PRNG keys for actor sampling.
-- λ-returns: reverse scan (ops.compute_lambda_values); Moments state is a
-  pytree threaded through the jitted step, its quantile a global reduction
-  under the mesh sharding.
-- The whole gradient step (world model + actor + critic, three optax
-  optimizers with clipping) is ONE jitted, donated call; the target-critic
-  EMA cadence stays on host (tau passed as a traced scalar, 0 = no-op).
+Same TPU-first shape as the V2/V3 loops in this package: RSSM dynamic
+learning as one `lax.scan`, imagination as a second scan, λ-targets as a
+reverse scan, one jitted donated gradient step. DV1 specifics: continuous
+Normal latents with free-nats KL (loss.py), no is_first reset handling
+(reference RSSM.dynamic has none), actor loss = -mean(discount · λ-values)
+(pure dynamics backprop, Eq. 7), critic without a target network, and
+exploration noise added by the player (expl_amount=0.3 schedule,
+dreamer_v1.py:582).
 """
 
 from __future__ import annotations
@@ -21,7 +16,7 @@ import copy
 import os
 import warnings
 from functools import partial
-from typing import Any, Dict, Sequence
+from typing import Any, Dict
 
 import gymnasium as gym
 import jax
@@ -29,158 +24,100 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from sheeprl_tpu.algos.dreamer_v3.agent import (
-    DV3Agent,
-    WorldModel,
-    actor_forward,
-    build_agent,
-    continuous_log_prob_and_entropy,
+from sheeprl_tpu.algos.dreamer_v1.agent import DV1Agent, DV1WorldModel, build_agent
+from sheeprl_tpu.algos.dreamer_v1.loss import actor_loss, critic_loss, reconstruction_loss
+from sheeprl_tpu.algos.dreamer_v1.utils import (
+    compute_lambda_values,
+    exploration_amount,
+    prepare_obs,
+    test,
 )
-from sheeprl_tpu.algos.dreamer_v3.loss import reconstruction_loss
-from sheeprl_tpu.algos.dreamer_v3.utils import prepare_obs, test
+from sheeprl_tpu.algos.dreamer_v2.dreamer_v2 import _make_optimizer
 from sheeprl_tpu.algos.ppo.agent import actions_metadata
-from sheeprl_tpu.config.instantiate import instantiate, locate
+from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.core.mesh import DATA_AXIS
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
-from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.registry import register_algorithm
 from sheeprl_tpu.utils.checkpoint import load_checkpoint, restore_opt_state, save_checkpoint
-from sheeprl_tpu.utils.distribution import (
-    BernoulliSafeMode,
-    Independent,
-    MSEDistribution,
-    OneHotCategorical,
-    SymlogDistribution,
-    TwoHotEncodingDistribution,
-)
+from sheeprl_tpu.utils.distribution import BernoulliSafeMode, Independent, Normal
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
-from sheeprl_tpu.utils.ops import compute_lambda_values, init_moments, update_moments
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import Ratio, save_configs
 
 
-def _make_optimizer(optim_cfg: Dict[str, Any], clip: float) -> optax.GradientTransformation:
-    optim_cfg = dict(optim_cfg)
-    target = optim_cfg.pop("_target_")
-    inner = locate(target)(**optim_cfg)
-    if clip is not None and clip > 0:
-        return optax.chain(optax.clip_by_global_norm(clip), inner)
-    return inner
-
-
-def make_train_step(agent: DV3Agent, txs: Dict[str, optax.GradientTransformation], cfg: Dict[str, Any], mesh):
+def make_train_step(agent: DV1Agent, txs: Dict[str, optax.GradientTransformation], cfg: Dict[str, Any], mesh):
     """Build the jitted single-gradient-step function over a [T, B] batch."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     wm_cfg = cfg.algo.world_model
     cnn_keys = list(cfg.algo.cnn_keys.encoder)
     mlp_keys = list(cfg.algo.mlp_keys.encoder)
-    cnn_dec_keys = list(cfg.algo.cnn_keys.decoder)
-    mlp_dec_keys = list(cfg.algo.mlp_keys.decoder)
     stochastic_size = int(wm_cfg.stochastic_size)
-    discrete_size = int(wm_cfg.discrete_size)
-    stoch_state_size = stochastic_size * discrete_size
     recurrent_state_size = int(wm_cfg.recurrent_model.recurrent_state_size)
     horizon = int(cfg.algo.horizon)
     gamma = float(cfg.algo.gamma)
     lmbda = float(cfg.algo.lmbda)
-    ent_coef = float(cfg.algo.actor.ent_coef)
-    moments_cfg = cfg.algo.actor.moments
-    decoupled = bool(wm_cfg.decoupled_rssm)
+    use_continues = bool(wm_cfg.use_continues)
     spec = agent.actor_spec
-    actions_dim = agent.actions_dim
 
     batch_sharding = NamedSharding(mesh, P(None, DATA_AXIS))
 
     def world_loss_fn(wm_params, data, batch_obs, keys):
         T, B = data["rewards"].shape[:2]
-        embedded = agent.wm(wm_params, batch_obs, method="embed_obs")  # [T, B, E]
-
-        batch_actions = jnp.concatenate(
-            [jnp.zeros_like(data["actions"][:1]), data["actions"][:-1]], axis=0
-        )
-        is_first = data["is_first"].at[0].set(1.0)
+        embedded = agent.wm(wm_params, batch_obs, method="embed_obs")
 
         h0 = jnp.zeros((B, recurrent_state_size), embedded.dtype)
-        z0 = jnp.zeros((B, stoch_state_size), embedded.dtype)
-        step_keys, post_key = keys[:T], keys[T]
+        z0 = jnp.zeros((B, stochastic_size), embedded.dtype)
 
-        if decoupled:
-            # Decoupled RSSM (reference: dreamer_v3.py:115-130): posteriors are
-            # obs-only, computed for the WHOLE sequence in one batched matmul;
-            # the scan then only threads the recurrent state, feeding each step
-            # the previous step's posterior.
-            posteriors_logits, posteriors = agent.world_model.apply(
-                wm_params, embedded, post_key, method=WorldModel.posterior_obs_only
+        def step(carry, x):
+            h, z = carry
+            action, emb, key = x
+            h, post, prior, post_ms, prior_ms = agent.world_model.apply(
+                wm_params, z, h, action, emb, key, method=DV1WorldModel.dynamic
             )
-            prev_posteriors = jnp.concatenate([jnp.zeros_like(posteriors[:1]), posteriors[:-1]], 0)
+            return (h, post), (h, post, post_ms[0], post_ms[1], prior_ms[0], prior_ms[1])
 
-            def dstep(h, x):
-                z_prev, action, first, key = x
-                h, _, prior_logits = agent.world_model.apply(
-                    wm_params, z_prev, h, action, first, key, method=WorldModel.dynamic_decoupled
-                )
-                return h, (h, prior_logits)
-
-            _, (recurrent_states, priors_logits) = jax.lax.scan(
-                dstep, h0, (prev_posteriors, batch_actions, is_first, step_keys)
-            )
-        else:
-
-            def step(carry, x):
-                h, z = carry
-                action, emb, first, key = x
-                h, post, prior, post_logits, prior_logits = agent.world_model.apply(
-                    wm_params, z, h, action, emb, first, key, method=WorldModel.dynamic
-                )
-                return (h, post), (h, post, post_logits, prior_logits)
-
-            (_, _), (recurrent_states, posteriors, posteriors_logits, priors_logits) = jax.lax.scan(
-                step, (h0, z0), (batch_actions, embedded, is_first, step_keys)
-            )
+        (_, _), (recurrent_states, posteriors, post_means, post_stds, prior_means, prior_stds) = (
+            jax.lax.scan(step, (h0, z0), (data["actions"], embedded, keys))
+        )
         latent_states = jnp.concatenate([posteriors, recurrent_states], -1)
 
         reconstructed_obs = agent.wm(wm_params, latent_states, method="decode")
-        po = {
-            k: MSEDistribution(reconstructed_obs[k], dims=len(reconstructed_obs[k].shape[2:]))
-            for k in cnn_dec_keys
+        qo = {
+            k: Independent(Normal(v, jnp.ones_like(v)), len(v.shape[2:]))
+            for k, v in reconstructed_obs.items()
         }
-        po.update(
-            {
-                k: SymlogDistribution(reconstructed_obs[k], dims=len(reconstructed_obs[k].shape[2:]))
-                for k in mlp_dec_keys
-            }
-        )
-        pr = TwoHotEncodingDistribution(agent.wm(wm_params, latent_states, method="reward_logits"), dims=1)
-        pc = Independent(
-            BernoulliSafeMode(logits=agent.wm(wm_params, latent_states, method="continue_logits")), 1
-        )
-        continues_targets = 1 - data["terminated"]
+        qr = Independent(Normal(agent.wm(wm_params, latent_states, method="reward"), 1.0), 1)
+        if use_continues:
+            qc = Independent(
+                BernoulliSafeMode(logits=agent.wm(wm_params, latent_states, method="continue_logits")), 1
+            )
+            continues_targets = (1 - data["terminated"]) * gamma
+        else:
+            qc = continues_targets = None
 
-        pl = priors_logits.reshape(*priors_logits.shape[:-1], stochastic_size, discrete_size)
-        pol = posteriors_logits.reshape(*posteriors_logits.shape[:-1], stochastic_size, discrete_size)
+        posteriors_dist = Independent(Normal(post_means, post_stds), 1)
+        priors_dist = Independent(Normal(prior_means, prior_stds), 1)
         rec_loss, kl, state_loss, reward_loss, observation_loss, continue_loss = reconstruction_loss(
-            po,
+            qo,
             batch_obs,
-            pr,
+            qr,
             data["rewards"],
-            pl,
-            pol,
-            wm_cfg.kl_dynamic,
-            wm_cfg.kl_representation,
+            posteriors_dist,
+            priors_dist,
             wm_cfg.kl_free_nats,
             wm_cfg.kl_regularizer,
-            pc,
+            qc,
             continues_targets,
             wm_cfg.continue_scale_factor,
         )
         aux = {
             "posteriors": posteriors,
             "recurrent_states": recurrent_states,
-            "posteriors_logits": pol,
-            "priors_logits": pl,
+            "post_entropy": posteriors_dist.entropy().mean(),
+            "prior_entropy": priors_dist.entropy().mean(),
             "kl": kl,
             "state_loss": state_loss,
             "reward_loss": reward_loss,
@@ -189,16 +126,15 @@ def make_train_step(agent: DV3Agent, txs: Dict[str, optax.GradientTransformation
         }
         return rec_loss, aux
 
-    @partial(jax.jit, donate_argnums=(0, 1, 2))
-    def train_step(state, opt_states, moments_state, data, key, tau):
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(state, opt_states, data, key):
         T, B = data["rewards"].shape[:2]
         data = jax.lax.with_sharding_constraint(data, {k: batch_sharding for k in data})
         batch_obs = {k: data[k] / 255.0 - 0.5 for k in cnn_keys}
         batch_obs.update({k: data[k] for k in mlp_keys})
 
-        k_dyn, k_img0, k_img, k_actor = jax.random.split(key, 4)
-        # T per-step keys + one extra for the decoupled whole-sequence posterior
-        dyn_keys = jax.random.split(k_dyn, T + 1)
+        k_dyn, k_img = jax.random.split(key)
+        dyn_keys = jax.random.split(k_dyn, T)
 
         # ---------------------------------------------- world model update
         (rec_loss, aux), wm_grads = jax.value_and_grad(world_loss_fn, has_aux=True)(
@@ -211,93 +147,58 @@ def make_train_step(agent: DV3Agent, txs: Dict[str, optax.GradientTransformation
 
         # --------------------------------------------- behaviour learning
         sg = jax.lax.stop_gradient
-        imagined_prior = sg(aux["posteriors"]).reshape(-1, stoch_state_size)
-        recurrent_state = sg(aux["recurrent_states"]).reshape(-1, recurrent_state_size)
-        latent0 = jnp.concatenate([imagined_prior, recurrent_state], -1)
+        imagined_prior0 = sg(aux["posteriors"]).reshape(-1, stochastic_size)
+        recurrent_state0 = sg(aux["recurrent_states"]).reshape(-1, recurrent_state_size)
+        latent0 = jnp.concatenate([imagined_prior0, recurrent_state0], -1)
+
+        from sheeprl_tpu.algos.dreamer_v2.agent import dv2_actor_forward
 
         def actor_sample(actor_params, latent, k):
             pre = agent.actor.apply(actor_params, sg(latent))
-            actions, _ = actor_forward(pre, spec, k, greedy=False)
+            actions, _ = dv2_actor_forward(pre, spec, k, greedy=False)
             return jnp.concatenate(actions, -1)
 
         def imagine_loss_fn(actor_params):
-            # Imagination rollout (actions re-sampled from the CURRENT actor
-            # params so the pathwise gradient flows; reference does the same
-            # through in-place module weights, dreamer_v3.py:219-241).
-            a0 = actor_sample(actor_params, latent0, k_img0)
-
+            # H imagined states, no initial latent stored
+            # (reference: dreamer_v1.py:232-251).
             def img_step(carry, k):
-                prior, h, actions = carry
-                k_wm, k_act = jax.random.split(k)
+                prior, h, latent = carry
+                k_act, k_wm = jax.random.split(k)
+                actions = actor_sample(actor_params, latent, k_act)
                 prior, h = agent.world_model.apply(
-                    state["world_model"], prior, h, actions, k_wm, method=WorldModel.imagination
+                    state["world_model"], prior, h, actions, k_wm, method=DV1WorldModel.imagination
                 )
                 latent = jnp.concatenate([prior, h], -1)
-                next_actions = actor_sample(actor_params, latent, k_act)
-                return (prior, h, next_actions), (latent, next_actions)
+                return (prior, h, latent), latent
 
             img_keys = jax.random.split(k_img, horizon)
-            _, (latents, img_actions) = jax.lax.scan(
-                img_step, (imagined_prior, recurrent_state, a0), img_keys
-            )
-            imagined_trajectories = jnp.concatenate([latent0[None], latents], 0)  # [H+1, TB, L]
-            imagined_actions = jnp.concatenate([a0[None], img_actions], 0)
+            _, imagined_trajectories = jax.lax.scan(
+                img_step, (imagined_prior0, recurrent_state0, latent0), img_keys
+            )  # [H, TB, L]
 
-            # Predict values / rewards / continues on the imagined rollout
-            predicted_values = TwoHotEncodingDistribution(
-                agent.critic_logits(state["critic"], imagined_trajectories), dims=1
-            ).mean
-            predicted_rewards = TwoHotEncodingDistribution(
-                agent.wm(state["world_model"], imagined_trajectories, method="reward_logits"), dims=1
-            ).mean
-            continues = Independent(
-                BernoulliSafeMode(
-                    logits=agent.wm(state["world_model"], imagined_trajectories, method="continue_logits")
-                ),
-                1,
-            ).mode
-            true_continue = (1 - data["terminated"]).reshape(1, -1, 1)
-            continues = jnp.concatenate([true_continue, continues[1:]], 0)
+            predicted_values = agent.critic_value(state["critic"], imagined_trajectories)
+            predicted_rewards = agent.wm(
+                state["world_model"], imagined_trajectories, method="reward"
+            )
+            if use_continues:
+                continues = jax.nn.sigmoid(
+                    agent.wm(state["world_model"], imagined_trajectories, method="continue_logits")
+                )
+            else:
+                continues = jnp.ones_like(sg(predicted_rewards)) * gamma
 
             lambda_values = compute_lambda_values(
-                predicted_rewards[1:], predicted_values[1:], continues[1:] * gamma, lmbda
-            )
-            discount = sg(jnp.cumprod(continues * gamma, 0) / gamma)
-
-            # Actor objective (reference: dreamer_v3.py:262-297)
-            new_moments, (offset, invscale) = update_moments(
-                moments_state,
-                lambda_values,
-                decay=moments_cfg.decay,
-                max_=moments_cfg.max,
-                percentile_low=moments_cfg.percentile.low,
-                percentile_high=moments_cfg.percentile.high,
-            )
-            baseline = predicted_values[:-1]
-            normed_lambda_values = (lambda_values - offset) / invscale
-            normed_baseline = (baseline - offset) / invscale
-            advantage = normed_lambda_values - normed_baseline
-
-            pre = agent.actor.apply(actor_params, sg(imagined_trajectories))
-            _, policies = actor_forward(pre, spec, k_actor, greedy=False)
-            if spec.is_continuous:
-                objective = advantage
-                _, entropy = continuous_log_prob_and_entropy(policies[0], imagined_actions, spec)
-                entropy = ent_coef * entropy if entropy is not None else jnp.zeros(advantage.shape[:-1])
-            else:
-                splits = np.cumsum(actions_dim)[:-1]
-                per_dim = jnp.split(imagined_actions, splits, -1)
-                logp = jnp.stack(
-                    [p.log_prob(sg(a))[..., None][:-1] for p, a in zip(policies, per_dim)], -1
-                ).sum(-1)
-                objective = logp * sg(advantage)
-                entropy = ent_coef * jnp.stack([p.entropy() for p in policies], -1).sum(-1)
-            policy_loss = -jnp.mean(sg(discount[:-1]) * (objective + entropy[..., None][:-1]))
+                predicted_rewards, predicted_values, continues,
+                last_values=predicted_values[-1], lmbda=lmbda,
+            )  # [H-1, TB, 1]
+            discount = sg(
+                jnp.cumprod(jnp.concatenate([jnp.ones_like(continues[:1]), continues[:-2]], 0), 0)
+            )  # [H-1, TB, 1]
+            policy_loss = actor_loss(discount * lambda_values)
             img_aux = {
                 "imagined_trajectories": sg(imagined_trajectories),
                 "lambda_values": sg(lambda_values),
                 "discount": discount,
-                "moments": new_moments,
             }
             return policy_loss, img_aux
 
@@ -308,29 +209,19 @@ def make_train_step(agent: DV3Agent, txs: Dict[str, optax.GradientTransformation
         state["actor"] = optax.apply_updates(state["actor"], actor_updates)
 
         # ------------------------------------------------- critic update
-        traj = img_aux["imagined_trajectories"][:-1]
+        traj = img_aux["imagined_trajectories"]
         lambda_values = img_aux["lambda_values"]
         discount = img_aux["discount"]
-        predicted_target_values = TwoHotEncodingDistribution(
-            agent.critic_logits(state["target_critic"], traj), dims=1
-        ).mean
 
         def critic_loss_fn(critic_params):
-            qv = TwoHotEncodingDistribution(agent.critic_logits(critic_params, traj), dims=1)
-            value_loss = -qv.log_prob(lambda_values)
-            value_loss = value_loss - qv.log_prob(sg(predicted_target_values))
-            return jnp.mean(value_loss * discount[:-1].squeeze(-1))
+            qv = Independent(Normal(agent.critic_value(critic_params, traj)[:-1], 1.0), 1)
+            return critic_loss(qv, lambda_values, discount[..., 0])
 
         value_loss, critic_grads = jax.value_and_grad(critic_loss_fn)(state["critic"])
         critic_updates, critic_opt = txs["critic"].update(
             critic_grads, opt_states["critic"], state["critic"]
         )
         state["critic"] = optax.apply_updates(state["critic"], critic_updates)
-
-        # target critic EMA (host decides tau; 0 = frozen)
-        state["target_critic"] = jax.tree_util.tree_map(
-            lambda p, tp: tau * p + (1 - tau) * tp, state["critic"], state["target_critic"]
-        )
 
         opt_states = {"world_model": wm_opt, "actor": actor_opt, "critic": critic_opt}
         metrics = {
@@ -340,26 +231,21 @@ def make_train_step(agent: DV3Agent, txs: Dict[str, optax.GradientTransformation
             "Loss/state_loss": aux["state_loss"],
             "Loss/continue_loss": aux["continue_loss"],
             "State/kl": aux["kl"],
-            "State/post_entropy": Independent(
-                OneHotCategorical(logits=aux["posteriors_logits"]), 1
-            ).entropy().mean(),
-            "State/prior_entropy": Independent(
-                OneHotCategorical(logits=aux["priors_logits"]), 1
-            ).entropy().mean(),
+            "State/post_entropy": aux["post_entropy"],
+            "State/prior_entropy": aux["prior_entropy"],
             "Loss/policy_loss": policy_loss,
             "Loss/value_loss": value_loss,
             "Grads/world_model": optax.global_norm(wm_grads),
             "Grads/actor": optax.global_norm(actor_grads),
             "Grads/critic": optax.global_norm(critic_grads),
         }
-        return state, opt_states, img_aux["moments"], metrics
+        return state, opt_states, metrics
 
     return train_step
 
 
 @register_algorithm()
 def main(runtime, cfg: Dict[str, Any]):
-    mesh = runtime.mesh
     rank = runtime.global_rank
     world_size = jax.process_count()
 
@@ -367,10 +253,9 @@ def main(runtime, cfg: Dict[str, Any]):
     if cfg.checkpoint.resume_from:
         state_ckpt = load_checkpoint(cfg.checkpoint.resume_from)
 
-    # These arguments cannot be changed
-    cfg.env.frame_stack = -1
-    if 2 ** int(np.log2(cfg.env.screen_size)) != cfg.env.screen_size:
-        raise ValueError(f"The screen size must be a power of 2, got: {cfg.env.screen_size}")
+    # These arguments cannot be changed (reference: dreamer_v1.py:398-400)
+    cfg.env.screen_size = 64
+    cfg.env.frame_stack = 1
 
     logger = get_logger(runtime, cfg)
     if logger is not None:
@@ -381,16 +266,13 @@ def main(runtime, cfg: Dict[str, Any]):
     vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
     envs = vectorized_env(
         [
-            partial(
-                RestartOnException,
-                make_env(
-                    cfg,
-                    cfg.seed + rank * cfg.env.num_envs + i,
-                    rank * cfg.env.num_envs,
-                    log_dir if rank == 0 else None,
-                    "train",
-                    vector_env_idx=i,
-                ),
+            make_env(
+                cfg,
+                cfg.seed + rank * cfg.env.num_envs + i,
+                rank * cfg.env.num_envs,
+                log_dir if rank == 0 else None,
+                "train",
+                vector_env_idx=i,
             )
             for i in range(cfg.env.num_envs)
         ],
@@ -408,21 +290,6 @@ def main(runtime, cfg: Dict[str, Any]):
         and len(set(cfg.algo.mlp_keys.encoder).intersection(set(cfg.algo.mlp_keys.decoder))) == 0
     ):
         raise RuntimeError("The CNN keys or the MLP keys of the encoder and decoder must not be disjointed")
-    if len(set(cfg.algo.cnn_keys.decoder) - set(cfg.algo.cnn_keys.encoder)) > 0:
-        raise RuntimeError(
-            "The CNN keys of the decoder must be contained in the encoder ones, "
-            f"got: decoder = {cfg.algo.cnn_keys.decoder}, encoder = {cfg.algo.cnn_keys.encoder}"
-        )
-    if len(set(cfg.algo.mlp_keys.decoder) - set(cfg.algo.mlp_keys.encoder)) > 0:
-        raise RuntimeError(
-            "The MLP keys of the decoder must be contained in the encoder ones, "
-            f"got: decoder = {cfg.algo.mlp_keys.decoder}, encoder = {cfg.algo.mlp_keys.encoder}"
-        )
-    if cfg.metric.log_level > 0:
-        runtime.print("Encoder CNN keys:", cfg.algo.cnn_keys.encoder)
-        runtime.print("Encoder MLP keys:", cfg.algo.mlp_keys.encoder)
-        runtime.print("Decoder CNN keys:", cfg.algo.cnn_keys.decoder)
-        runtime.print("Decoder MLP keys:", cfg.algo.mlp_keys.decoder)
     obs_keys = list(cfg.algo.cnn_keys.encoder) + list(cfg.algo.mlp_keys.encoder)
 
     agent, agent_state = build_agent(
@@ -434,7 +301,6 @@ def main(runtime, cfg: Dict[str, Any]):
         state_ckpt["world_model"] if state_ckpt is not None else None,
         state_ckpt["actor"] if state_ckpt is not None else None,
         state_ckpt["critic"] if state_ckpt is not None else None,
-        state_ckpt["target_critic"] if state_ckpt is not None else None,
     )
 
     txs = {
@@ -455,14 +321,8 @@ def main(runtime, cfg: Dict[str, Any]):
         ):
             opt_states[name] = restore_opt_state(opt_states[name], state_ckpt[ckpt_key])
 
-    # Explicit mesh placement: replicated, or tensor-parallel over the model
-    # axis for the wide dense stacks when fabric.model_axis > 1.
     agent_state = runtime.shard_params(agent_state)
     opt_states = runtime.shard_params(opt_states)
-
-    moments_state = init_moments()
-    if state_ckpt is not None and "moments" in state_ckpt:
-        moments_state = jax.tree_util.tree_map(jnp.asarray, state_ckpt["moments"])
 
     if runtime.is_global_zero:
         save_configs(cfg, log_dir)
@@ -475,6 +335,7 @@ def main(runtime, cfg: Dict[str, Any]):
     rb = EnvIndependentReplayBuffer(
         buffer_size,
         n_envs=cfg.env.num_envs,
+        obs_keys=obs_keys,
         memmap=cfg.buffer.memmap,
         memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
         buffer_cls=SequentialReplayBuffer,
@@ -514,9 +375,11 @@ def main(runtime, cfg: Dict[str, Any]):
             "the checkpoint will be saved at the nearest greater multiple of the policy_steps_per_iter value."
         )
 
-    train_fn = make_train_step(agent, txs, cfg, mesh)
+    train_fn = make_train_step(agent, txs, cfg, runtime.mesh)
     player_step_fn = jax.jit(
-        lambda wm, a, s, o, k: agent.player_step(wm, a, s, o, k, greedy=False)
+        lambda wm, a, s, o, k, amount: agent.player_step(
+            wm, a, s, o, k, greedy=False, expl_amount=amount
+        )
     )
     init_player_fn = jax.jit(agent.init_player_state, static_argnums=(1,))
     reset_player_fn = jax.jit(agent.reset_player_state)
@@ -527,10 +390,11 @@ def main(runtime, cfg: Dict[str, Any]):
     obs = envs.reset(seed=cfg.seed)[0]
     for k in obs_keys:
         step_data[k] = obs[k][np.newaxis]
-    step_data["rewards"] = np.zeros((1, cfg.env.num_envs, 1), np.float32)
-    step_data["truncated"] = np.zeros((1, cfg.env.num_envs, 1), np.float32)
     step_data["terminated"] = np.zeros((1, cfg.env.num_envs, 1), np.float32)
-    step_data["is_first"] = np.ones_like(step_data["terminated"])
+    step_data["truncated"] = np.zeros((1, cfg.env.num_envs, 1), np.float32)
+    step_data["actions"] = np.zeros((1, cfg.env.num_envs, int(np.sum(actions_dim))), np.float32)
+    step_data["rewards"] = np.zeros((1, cfg.env.num_envs, 1), np.float32)
+    rb.add(step_data, validate_args=cfg.buffer.validate_args)
     player_state = init_player_fn(agent_state["world_model"], cfg.env.num_envs)
 
     cumulative_per_rank_gradient_steps = 0
@@ -551,38 +415,24 @@ def main(runtime, cfg: Dict[str, Any]):
             else:
                 jnp_obs = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
                 rollout_key, sub = jax.random.split(rollout_key)
+                amount = exploration_amount(agent.actor_spec, policy_step)
                 actions_cat, real_actions_j, player_state = player_step_fn(
-                    agent_state["world_model"], agent_state["actor"], player_state, jnp_obs, sub
+                    agent_state["world_model"],
+                    agent_state["actor"],
+                    player_state,
+                    jnp_obs,
+                    sub,
+                    jnp.asarray(amount, jnp.float32),
                 )
                 actions = np.asarray(actions_cat)
                 real_actions = np.asarray(real_actions_j)
-
-            step_data["actions"] = actions.reshape((1, cfg.env.num_envs, -1))
-            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+                if aggregator and not aggregator.disabled:
+                    aggregator.update("Params/exploration_amount", amount)
 
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 real_actions.reshape(envs.action_space.shape)
             )
             dones = np.logical_or(terminated, truncated).astype(np.uint8)
-
-        step_data["is_first"] = np.zeros_like(step_data["terminated"])
-        if "restart_on_exception" in infos:
-            for i, agent_roe in enumerate(infos["restart_on_exception"]):
-                if agent_roe and not dones[i]:
-                    # Patch the broken episode's tail in the buffer: mark it
-                    # truncated, restart a fresh episode
-                    # (reference: dreamer_v3.py:595-608).
-                    last_inserted_idx = (rb.buffer[i]._pos - 1) % rb.buffer[i].buffer_size
-                    rb.buffer[i]["terminated"][last_inserted_idx] = np.zeros_like(
-                        rb.buffer[i]["terminated"][last_inserted_idx]
-                    )
-                    rb.buffer[i]["truncated"][last_inserted_idx] = np.ones_like(
-                        rb.buffer[i]["truncated"][last_inserted_idx]
-                    )
-                    rb.buffer[i]["is_first"][last_inserted_idx] = np.zeros_like(
-                        rb.buffer[i]["is_first"][last_inserted_idx]
-                    )
-                    step_data["is_first"][:, i] = np.ones_like(step_data["is_first"][:, i])
 
         if cfg.metric.log_level > 0 and "final_info" in infos:
             fi = infos["final_info"]
@@ -603,31 +453,29 @@ def main(runtime, cfg: Dict[str, Any]):
                         real_next_obs[k][idx] = v
 
         for k in obs_keys:
-            step_data[k] = next_obs[k][np.newaxis]
+            step_data[k] = real_next_obs[k][np.newaxis]
         obs = next_obs
 
-        rewards = rewards.reshape((1, cfg.env.num_envs, -1))
         step_data["terminated"] = terminated.reshape((1, cfg.env.num_envs, -1)).astype(np.float32)
         step_data["truncated"] = truncated.reshape((1, cfg.env.num_envs, -1)).astype(np.float32)
-        step_data["rewards"] = clip_rewards_fn(rewards).astype(np.float32)
+        step_data["actions"] = actions.reshape((1, cfg.env.num_envs, -1)).astype(np.float32)
+        step_data["rewards"] = clip_rewards_fn(rewards).reshape((1, cfg.env.num_envs, -1)).astype(np.float32)
+        rb.add(step_data, validate_args=cfg.buffer.validate_args)
 
         dones_idxes = dones.nonzero()[0].tolist()
         reset_envs = len(dones_idxes)
         if reset_envs > 0:
             reset_data = {}
             for k in obs_keys:
-                reset_data[k] = (real_next_obs[k][dones_idxes])[np.newaxis]
-            reset_data["terminated"] = step_data["terminated"][:, dones_idxes]
-            reset_data["truncated"] = step_data["truncated"][:, dones_idxes]
+                reset_data[k] = (next_obs[k][dones_idxes])[np.newaxis]
+            reset_data["terminated"] = np.zeros((1, reset_envs, 1), np.float32)
+            reset_data["truncated"] = np.zeros((1, reset_envs, 1), np.float32)
             reset_data["actions"] = np.zeros((1, reset_envs, int(np.sum(actions_dim))), np.float32)
-            reset_data["rewards"] = step_data["rewards"][:, dones_idxes]
-            reset_data["is_first"] = np.zeros_like(reset_data["terminated"])
+            reset_data["rewards"] = np.zeros((1, reset_envs, 1), np.float32)
             rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
-
-            step_data["rewards"][:, dones_idxes] = np.zeros_like(reset_data["rewards"])
-            step_data["terminated"][:, dones_idxes] = np.zeros_like(step_data["terminated"][:, dones_idxes])
-            step_data["truncated"][:, dones_idxes] = np.zeros_like(step_data["truncated"][:, dones_idxes])
-            step_data["is_first"][:, dones_idxes] = np.ones_like(step_data["is_first"][:, dones_idxes])
+            for d in dones_idxes:
+                step_data["terminated"][0, d] = np.zeros_like(step_data["terminated"][0, d])
+                step_data["truncated"][0, d] = np.zeros_like(step_data["truncated"][0, d])
             reset_mask = np.zeros((cfg.env.num_envs,), np.float32)
             reset_mask[dones_idxes] = 1.0
             player_state = reset_player_fn(agent_state["world_model"], player_state, jnp.asarray(reset_mask))
@@ -645,31 +493,20 @@ def main(runtime, cfg: Dict[str, Any]):
                 per_step_metrics = []
                 with timer("Time/train_time"):
                     for i in range(per_rank_gradient_steps):
-                        if (
-                            cumulative_per_rank_gradient_steps
-                            % cfg.algo.critic.per_rank_target_network_update_freq
-                            == 0
-                        ):
-                            tau = 1.0 if cumulative_per_rank_gradient_steps == 0 else cfg.algo.critic.tau
-                        else:
-                            tau = 0.0
                         batch = {
                             k: jnp.asarray(np.asarray(v[i]), jnp.float32) if k not in cfg.algo.cnn_keys.encoder
                             else jnp.asarray(np.asarray(v[i]))
                             for k, v in local_data.items()
                         }
                         train_key, sub = jax.random.split(train_key)
-                        agent_state, opt_states, moments_state, train_metrics = train_fn(
-                            agent_state, opt_states, moments_state, batch, sub, jnp.asarray(tau, jnp.float32)
+                        agent_state, opt_states, train_metrics = train_fn(
+                            agent_state, opt_states, batch, sub
                         )
                         per_step_metrics.append(train_metrics)
                         cumulative_per_rank_gradient_steps += 1
                     jax.block_until_ready(agent_state["world_model"])
                     train_step_count += world_size
 
-                # Feed EVERY gradient step's losses to the aggregator (the
-                # reference updates per step; only sampling the last one
-                # under-reports the training signal).
                 if aggregator and not aggregator.disabled:
                     for m in per_step_metrics:
                         for k, v in m.items():
@@ -717,11 +554,9 @@ def main(runtime, cfg: Dict[str, Any]):
                 "world_model": agent_state["world_model"],
                 "actor": agent_state["actor"],
                 "critic": agent_state["critic"],
-                "target_critic": agent_state["target_critic"],
                 "world_optimizer": opt_states["world_model"],
                 "actor_optimizer": opt_states["actor"],
                 "critic_optimizer": opt_states["critic"],
-                "moments": moments_state,
                 "ratio": ratio.state_dict(),
                 "iter_num": iter_num * world_size,
                 "batch_size": cfg.algo.per_rank_batch_size * world_size,

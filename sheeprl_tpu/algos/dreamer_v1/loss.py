@@ -1,0 +1,58 @@
+"""DreamerV1 losses (reference: sheeprl/algos/dreamer_v1/loss.py:9-100).
+
+Eq. 7/8/10 of the Dreamer paper: actor loss is the negated mean of the
+discounted λ-values, critic is a Normal log-likelihood of the λ-targets, the
+world-model loss combines decoder/reward likelihoods with a free-nats-floored
+Normal KL.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.utils.distribution import kl_divergence
+
+
+def actor_loss(discounted_lambda_values: jax.Array) -> jax.Array:
+    return -jnp.mean(discounted_lambda_values)
+
+
+def critic_loss(qv, lambda_values: jax.Array, discount: jax.Array) -> jax.Array:
+    return -jnp.mean(discount * qv.log_prob(lambda_values))
+
+
+def reconstruction_loss(
+    qo: Dict[str, object],
+    observations: Dict[str, jax.Array],
+    qr,
+    rewards: jax.Array,
+    posteriors_dist,
+    priors_dist,
+    kl_free_nats: float = 3.0,
+    kl_regularizer: float = 1.0,
+    qc=None,
+    continue_targets: Optional[jax.Array] = None,
+    continue_scale_factor: float = 10.0,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Returns (reconstruction_loss, kl, state_loss, reward_loss,
+    observation_loss, continue_loss).
+
+    Divergence from the reference (documented): the reference adds
+    `+ qc.log_prob(targets)` un-negated and un-reduced (loss.py:92-95), which
+    cannot be a scalar loss term — the continue head is off by default there
+    and that path is untested. Here the continue loss is the usual negated
+    mean log-likelihood.
+    """
+    observation_loss = -sum(qo[k].log_prob(observations[k]).mean() for k in qo)
+    reward_loss = -qr.log_prob(rewards).mean()
+    kl = kl_divergence(posteriors_dist, priors_dist).mean()
+    state_loss = jnp.maximum(kl, jnp.asarray(kl_free_nats, kl.dtype))
+    if qc is not None and continue_targets is not None:
+        continue_loss = continue_scale_factor * -qc.log_prob(continue_targets).mean()
+    else:
+        continue_loss = jnp.zeros_like(reward_loss)
+    total = kl_regularizer * state_loss + observation_loss + reward_loss + continue_loss
+    return total, kl, state_loss, reward_loss, observation_loss, continue_loss

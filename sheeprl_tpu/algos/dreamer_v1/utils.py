@@ -1,0 +1,69 @@
+"""DreamerV1 auxiliary contract (reference: sheeprl/algos/dreamer_v1/utils.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.algos.dreamer_v3.utils import prepare_obs, test  # noqa: F401 (re-export)
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "State/kl",
+    "Params/exploration_amount",
+    "Grads/world_model",
+    "Grads/actor",
+    "Grads/critic",
+}
+MODELS_TO_REGISTER = {"world_model", "actor", "critic"}
+
+
+def compute_lambda_values(
+    rewards: jax.Array,
+    values: jax.Array,
+    continues: jax.Array,
+    last_values: jax.Array,
+    lmbda: float = 0.95,
+) -> jax.Array:
+    """DV1-style λ-targets over [H, ...] arrays → [H-1, ...]
+    (reference reverse loop: dreamer_v1/utils.py compute_lambda_values):
+    delta[t] = r[t] + c[t] * next_v[t], where next_v is (1-λ)V[t+1] except at
+    the last step where it is the full bootstrap value;
+    L[t] = delta[t] + λ c[t] L[t+1], seeded with 0. fp32 accumulation.
+    """
+    rewards = rewards.astype(jnp.float32)
+    values = values.astype(jnp.float32)
+    continues = continues.astype(jnp.float32)
+    last_values = last_values.astype(jnp.float32)
+    H = rewards.shape[0]
+    next_values = jnp.concatenate([values[1 : H - 1] * (1 - lmbda), last_values[None]], axis=0)
+    deltas = rewards[: H - 1] + next_values * continues[: H - 1]
+
+    def step(nxt, x):
+        d, c = x
+        v = d + lmbda * c * nxt
+        return v, v
+
+    _, out = jax.lax.scan(
+        step, jnp.zeros_like(deltas[0]), (deltas, continues[: H - 1]), reverse=True
+    )
+    return out
+
+
+def exploration_amount(spec, step: int) -> float:
+    """Host-side exploration schedule (reference: Actor._get_expl_amount,
+    dreamer_v2/agent.py:499-503)."""
+    amount = spec.expl_amount
+    if spec.expl_decay:
+        amount *= 0.5 ** (float(step) / spec.expl_decay)
+    return max(amount, spec.expl_min)

@@ -1,0 +1,770 @@
+"""DreamerV2 agent (flax): world model (discrete-latent RSSM), actor, critic.
+
+Capability parity with the reference agent
+(sheeprl/algos/dreamer_v2/agent.py:40-1104), re-designed for XLA like the
+DreamerV3 agent in this package: single-step pure RSSM methods scanned by the
+training step, NHWC pixels, functional player state.
+
+DV2-specific facts (vs the V3 agent next door):
+- No unimix on categorical logits; posterior/prior sampled straight from the
+  representation/transition outputs (agent.py:389-414).
+- Reset mixing zeroes the states — there is no learned initial recurrent
+  state (RSSM.dynamic, agent.py:364-370).
+- ELU activations, LayerNorm OFF by default (configs/algo/dreamer_v2.yaml),
+  xavier-normal initialization everywhere (utils.py:64-82).
+- Encoder convs are k4/s2 with NO padding (agent.py:63-75: 64→31→14→6→2);
+  the decoder projects the latent to a 1×1 feature map and deconvs with
+  kernels [5,5,6,6]/s2 back to 64×64 (agent.py:169-188).
+- Reward/critic heads are scalar Normal(·, 1) — no two-hot bins.
+- The continue head is optional (`use_continues`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from sheeprl_tpu.algos.dreamer_v3.agent import compute_stochastic_state
+from sheeprl_tpu.models import MLP, CNN, DeCNN, LayerNormGRUCell
+from sheeprl_tpu.utils.distribution import (
+    Independent,
+    Normal,
+    OneHotCategoricalStraightThrough,
+    TruncatedNormal,
+)
+
+xavier_normal_init = jax.nn.initializers.glorot_normal()
+
+
+def conv_out_size(size: int, kernel: int, stride: int, padding: int = 0) -> int:
+    return (size + 2 * padding - kernel) // stride + 1
+
+
+def cnn_encoder_output_dim(image_size: Tuple[int, int], channels_multiplier: int, stages: int = 4) -> int:
+    h, w = image_size
+    for _ in range(stages):
+        h = conv_out_size(h, 4, 2)
+        w = conv_out_size(w, 4, 2)
+    return h * w * (2 ** (stages - 1)) * channels_multiplier
+
+
+class DV2CNNEncoder(nn.Module):
+    """4-stage conv encoder, k4/s2/p0, channels [1,2,4,8]*multiplier, NHWC
+    (reference: CNNEncoder, agent.py:40-81)."""
+
+    keys: Sequence[str]
+    channels_multiplier: int
+    activation: str = "elu"
+    layer_norm: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        x = jnp.concatenate([obs[k] for k in self.keys], axis=-1)
+        x = CNN(
+            hidden_channels=[(2**i) * self.channels_multiplier for i in range(4)],
+            layer_args={"kernel_size": 4, "stride": 2, "padding": 0},
+            activation=self.activation,
+            norm_layer="layer_norm" if self.layer_norm else None,
+            norm_args={} if self.layer_norm else None,
+            kernel_init=xavier_normal_init,
+            dtype=self.dtype,
+            name="model",
+        )(x)
+        return x.reshape(*x.shape[:-3], -1)
+
+
+class DV2MLPEncoder(nn.Module):
+    """Plain vector encoder, no symlog (reference: MLPEncoder, agent.py:84-128)."""
+
+    keys: Sequence[str]
+    mlp_layers: int = 4
+    dense_units: int = 400
+    activation: str = "elu"
+    layer_norm: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        x = jnp.concatenate([obs[k] for k in self.keys], axis=-1)
+        return MLP(
+            hidden_sizes=[self.dense_units] * self.mlp_layers,
+            activation=self.activation,
+            norm_layer="layer_norm" if self.layer_norm else None,
+            norm_args={} if self.layer_norm else None,
+            kernel_init=xavier_normal_init,
+            dtype=self.dtype,
+            name="model",
+        )(x)
+
+
+class DV2CNNDecoder(nn.Module):
+    """Latent → Dense → 1×1 feature map → 4 deconv stages k[5,5,6,6]/s2 →
+    per-key HWC reconstructions (reference: CNNDecoder, agent.py:131-196)."""
+
+    keys: Sequence[str]
+    output_channels: Sequence[int]
+    channels_multiplier: int
+    cnn_encoder_output_dim: int
+    image_size: Tuple[int, int]
+    activation: str = "elu"
+    layer_norm: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, latent_states: jax.Array) -> Dict[str, jax.Array]:
+        batch_shape = latent_states.shape[:-1]
+        x = nn.Dense(
+            self.cnn_encoder_output_dim, kernel_init=xavier_normal_init, dtype=self.dtype, name="fc"
+        )(latent_states)
+        x = x.reshape(-1, 1, 1, self.cnn_encoder_output_dim)
+        out_ch = int(sum(self.output_channels))
+        norm = "layer_norm" if self.layer_norm else None
+        x = DeCNN(
+            hidden_channels=[4 * self.channels_multiplier, 2 * self.channels_multiplier,
+                             self.channels_multiplier, out_ch],
+            layer_args=[
+                {"kernel_size": 5, "stride": 2},
+                {"kernel_size": 5, "stride": 2},
+                {"kernel_size": 6, "stride": 2},
+                {"kernel_size": 6, "stride": 2},
+            ],
+            activation=[self.activation] * 3 + [None],
+            norm_layer=[norm] * 3 + [None],
+            norm_args=[{} if self.layer_norm else None] * 3 + [None],
+            kernel_init=xavier_normal_init,
+            dtype=self.dtype,
+            name="model",
+        )(x)
+        x = x.reshape(*batch_shape, *self.image_size, out_ch)
+        splits = np.cumsum(self.output_channels)[:-1]
+        return {k: v for k, v in zip(self.keys, jnp.split(x, splits, axis=-1))}
+
+
+class DV2MLPDecoder(nn.Module):
+    """Shared trunk + one linear head per key (reference: MLPDecoder,
+    agent.py:199-246)."""
+
+    keys: Sequence[str]
+    output_dims: Sequence[int]
+    mlp_layers: int = 4
+    dense_units: int = 400
+    activation: str = "elu"
+    layer_norm: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, latent_states: jax.Array) -> Dict[str, jax.Array]:
+        x = MLP(
+            hidden_sizes=[self.dense_units] * self.mlp_layers,
+            activation=self.activation,
+            norm_layer="layer_norm" if self.layer_norm else None,
+            norm_args={} if self.layer_norm else None,
+            kernel_init=xavier_normal_init,
+            dtype=self.dtype,
+            name="model",
+        )(latent_states)
+        return {
+            k: nn.Dense(dim, kernel_init=xavier_normal_init, dtype=self.dtype, name=f"head_{i}")(x)
+            for i, (k, dim) in enumerate(zip(self.keys, self.output_dims))
+        }
+
+
+class DV2RecurrentModel(nn.Module):
+    """Dense+ELU projection into a LayerNormGRUCell (reference:
+    RecurrentModel, agent.py:248-298; GRU layer-norm ON by default in DV2)."""
+
+    recurrent_state_size: int
+    dense_units: int
+    activation: str = "elu"
+    layer_norm: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, recurrent_state: jax.Array) -> jax.Array:
+        feat = MLP(
+            hidden_sizes=[self.dense_units],
+            activation=self.activation,
+            kernel_init=xavier_normal_init,
+            dtype=self.dtype,
+            name="mlp",
+        )(x)
+        return LayerNormGRUCell(
+            hidden_size=self.recurrent_state_size,
+            bias=True,
+            layer_norm=self.layer_norm,
+            dtype=self.dtype,
+            name="rnn",
+        )(recurrent_state, feat)
+
+
+class DV2WorldModel(nn.Module):
+    """Encoder + RSSM + decoders + reward (+ optional continue) heads as one
+    module with method-based apply (reference: WorldModel container at
+    agent.py:707-733 + RSSM at agent.py:301-414)."""
+
+    cnn_keys: Sequence[str]
+    mlp_keys: Sequence[str]
+    cnn_input_channels: Sequence[int]
+    mlp_input_dims: Sequence[int]
+    image_size: Tuple[int, int]
+    actions_dim: Sequence[int]
+    stochastic_size: int = 32
+    discrete_size: int = 32
+    recurrent_state_size: int = 600
+    recurrent_dense_units: int = 400
+    recurrent_layer_norm: bool = True
+    transition_hidden_size: int = 600
+    representation_hidden_size: int = 600
+    encoder_cnn_channels_multiplier: int = 48
+    encoder_mlp_layers: int = 4
+    encoder_dense_units: int = 400
+    decoder_cnn_channels_multiplier: int = 48
+    decoder_mlp_layers: int = 4
+    decoder_dense_units: int = 400
+    reward_mlp_layers: int = 4
+    reward_dense_units: int = 400
+    continue_mlp_layers: int = 4
+    continue_dense_units: int = 400
+    use_continues: bool = False
+    cnn_act: str = "elu"
+    dense_act: str = "elu"
+    layer_norm: bool = False
+    dtype: Any = jnp.float32
+
+    @property
+    def stoch_state_size(self) -> int:
+        return self.stochastic_size * self.discrete_size
+
+    @property
+    def latent_state_size(self) -> int:
+        return self.stoch_state_size + self.recurrent_state_size
+
+    def setup(self) -> None:
+        norm = "layer_norm" if self.layer_norm else None
+        self.cnn_encoder = (
+            DV2CNNEncoder(
+                keys=self.cnn_keys,
+                channels_multiplier=self.encoder_cnn_channels_multiplier,
+                activation=self.cnn_act,
+                layer_norm=self.layer_norm,
+                dtype=self.dtype,
+            )
+            if len(self.cnn_keys) > 0
+            else None
+        )
+        self.mlp_encoder = (
+            DV2MLPEncoder(
+                keys=self.mlp_keys,
+                mlp_layers=self.encoder_mlp_layers,
+                dense_units=self.encoder_dense_units,
+                activation=self.dense_act,
+                layer_norm=self.layer_norm,
+                dtype=self.dtype,
+            )
+            if len(self.mlp_keys) > 0
+            else None
+        )
+        self.recurrent_model = DV2RecurrentModel(
+            recurrent_state_size=self.recurrent_state_size,
+            dense_units=self.recurrent_dense_units,
+            activation=self.dense_act,
+            layer_norm=self.recurrent_layer_norm,
+            dtype=self.dtype,
+        )
+        self.representation_model = MLP(
+            hidden_sizes=[self.representation_hidden_size],
+            output_dim=self.stoch_state_size,
+            activation=self.dense_act,
+            norm_layer=norm,
+            norm_args={} if self.layer_norm else None,
+            kernel_init=xavier_normal_init,
+            output_kernel_init=xavier_normal_init,
+            dtype=self.dtype,
+        )
+        self.transition_model = MLP(
+            hidden_sizes=[self.transition_hidden_size],
+            output_dim=self.stoch_state_size,
+            activation=self.dense_act,
+            norm_layer=norm,
+            norm_args={} if self.layer_norm else None,
+            kernel_init=xavier_normal_init,
+            output_kernel_init=xavier_normal_init,
+            dtype=self.dtype,
+        )
+        enc_out = cnn_encoder_output_dim(self.image_size, self.encoder_cnn_channels_multiplier)
+        self.cnn_decoder = (
+            DV2CNNDecoder(
+                keys=self.cnn_keys,
+                output_channels=self.cnn_input_channels,
+                channels_multiplier=self.decoder_cnn_channels_multiplier,
+                cnn_encoder_output_dim=enc_out,
+                image_size=self.image_size,
+                activation=self.cnn_act,
+                layer_norm=self.layer_norm,
+                dtype=self.dtype,
+            )
+            if len(self.cnn_keys) > 0
+            else None
+        )
+        self.mlp_decoder = (
+            DV2MLPDecoder(
+                keys=self.mlp_keys,
+                output_dims=self.mlp_input_dims,
+                mlp_layers=self.decoder_mlp_layers,
+                dense_units=self.decoder_dense_units,
+                activation=self.dense_act,
+                layer_norm=self.layer_norm,
+                dtype=self.dtype,
+            )
+            if len(self.mlp_keys) > 0
+            else None
+        )
+        self.reward_model = MLP(
+            hidden_sizes=[self.reward_dense_units] * self.reward_mlp_layers,
+            output_dim=1,
+            activation=self.dense_act,
+            norm_layer=norm,
+            norm_args={} if self.layer_norm else None,
+            kernel_init=xavier_normal_init,
+            output_kernel_init=xavier_normal_init,
+            dtype=self.dtype,
+        )
+        self.continue_model = (
+            MLP(
+                hidden_sizes=[self.continue_dense_units] * self.continue_mlp_layers,
+                output_dim=1,
+                activation=self.dense_act,
+                norm_layer=norm,
+                norm_args={} if self.layer_norm else None,
+                kernel_init=xavier_normal_init,
+                output_kernel_init=xavier_normal_init,
+                dtype=self.dtype,
+            )
+            if self.use_continues
+            else None
+        )
+
+    # --------------------------------------------------------------- encoder
+    def embed_obs(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        outs = []
+        if self.cnn_encoder is not None:
+            outs.append(self.cnn_encoder(obs))
+        if self.mlp_encoder is not None:
+            outs.append(self.mlp_encoder(obs))
+        return jnp.concatenate(outs, axis=-1) if len(outs) > 1 else outs[0]
+
+    # ------------------------------------------------------------------ rssm
+    def _representation(
+        self, recurrent_state: jax.Array, embedded_obs: jax.Array, key: Optional[jax.Array]
+    ) -> Tuple[jax.Array, jax.Array]:
+        logits = self.representation_model(
+            jnp.concatenate([recurrent_state, embedded_obs], axis=-1)
+        )
+        post = compute_stochastic_state(logits, self.discrete_size, key)
+        return logits, post.reshape(*post.shape[:-2], -1)
+
+    def _transition(
+        self, recurrent_out: jax.Array, key: Optional[jax.Array], sample_state: bool = True
+    ) -> Tuple[jax.Array, jax.Array]:
+        logits = self.transition_model(recurrent_out)
+        prior = compute_stochastic_state(logits, self.discrete_size, key, sample=sample_state)
+        return logits, prior.reshape(*prior.shape[:-2], -1)
+
+    def dynamic(
+        self,
+        posterior: jax.Array,
+        recurrent_state: jax.Array,
+        action: jax.Array,
+        embedded_obs: jax.Array,
+        is_first: jax.Array,
+        key: jax.Array,
+    ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+        """One step of dynamic learning (reference: RSSM.dynamic,
+        agent.py:332-371): is_first zeroes state and action (no learned
+        initial state in DV2), GRU step, prior + posterior."""
+        k1, k2 = jax.random.split(key)
+        action = (1 - is_first) * action
+        posterior = (1 - is_first) * posterior
+        recurrent_state = (1 - is_first) * recurrent_state
+        recurrent_state = self.recurrent_model(
+            jnp.concatenate([posterior, action], -1), recurrent_state
+        )
+        prior_logits, prior = self._transition(recurrent_state, k1)
+        posterior_logits, posterior = self._representation(recurrent_state, embedded_obs, k2)
+        return recurrent_state, posterior, prior, posterior_logits, prior_logits
+
+    def imagination(
+        self, prior: jax.Array, recurrent_state: jax.Array, actions: jax.Array, key: jax.Array
+    ) -> Tuple[jax.Array, jax.Array]:
+        """One-step latent imagination (reference: RSSM.imagination,
+        agent.py:396-414)."""
+        recurrent_state = self.recurrent_model(
+            jnp.concatenate([prior, actions], -1), recurrent_state
+        )
+        _, imagined_prior = self._transition(recurrent_state, key)
+        return imagined_prior, recurrent_state
+
+    # ----------------------------------------------------------------- heads
+    def decode(self, latent_states: jax.Array) -> Dict[str, jax.Array]:
+        out: Dict[str, jax.Array] = {}
+        if self.cnn_decoder is not None:
+            out.update(self.cnn_decoder(latent_states))
+        if self.mlp_decoder is not None:
+            out.update(self.mlp_decoder(latent_states))
+        return out
+
+    def reward(self, latent_states: jax.Array) -> jax.Array:
+        return self.reward_model(latent_states)
+
+    def continue_logits(self, latent_states: jax.Array) -> jax.Array:
+        if self.continue_model is None:
+            raise ValueError("use_continues is False: the continue model does not exist")
+        return self.continue_model(latent_states)
+
+    def __call__(self, obs: Dict[str, jax.Array], actions: jax.Array, key: jax.Array):
+        """Init-only pass touching every submodule once."""
+        embedded = self.embed_obs(obs)
+        batch = embedded.shape[:-1]
+        h0 = jnp.zeros((*batch, self.recurrent_state_size), self.dtype)
+        z0 = jnp.zeros((*batch, self.stoch_state_size), self.dtype)
+        h, post, prior, post_logits, prior_logits = self.dynamic(
+            z0, h0, actions, embedded, jnp.zeros((*batch, 1), self.dtype), key
+        )
+        latent = jnp.concatenate([post, h], -1)
+        out = (self.decode(latent), self.reward(latent))
+        if self.continue_model is not None:
+            out = out + (self.continue_logits(latent),)
+        return out
+
+
+class DV2Actor(nn.Module):
+    """DV2 actor: ELU MLP trunk + one head per action dim (reference: Actor,
+    agent.py:416-529). Raw head outputs; distributions in
+    `dv2_actor_forward`."""
+
+    actions_dim: Sequence[int]
+    is_continuous: bool
+    dense_units: int = 400
+    mlp_layers: int = 4
+    activation: str = "elu"
+    layer_norm: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, state: jax.Array) -> List[jax.Array]:
+        x = MLP(
+            hidden_sizes=[self.dense_units] * self.mlp_layers,
+            activation=self.activation,
+            norm_layer="layer_norm" if self.layer_norm else None,
+            norm_args={} if self.layer_norm else None,
+            kernel_init=xavier_normal_init,
+            dtype=self.dtype,
+            name="model",
+        )(state)
+        if self.is_continuous:
+            return [
+                nn.Dense(
+                    int(np.sum(self.actions_dim)) * 2,
+                    kernel_init=xavier_normal_init,
+                    dtype=self.dtype,
+                    name="head_0",
+                )(x)
+            ]
+        return [
+            nn.Dense(dim, kernel_init=xavier_normal_init, dtype=self.dtype, name=f"head_{i}")(x)
+            for i, dim in enumerate(self.actions_dim)
+        ]
+
+
+@dataclass(frozen=True)
+class DV2ActorSpec:
+    """Distribution metadata (reference Actor attributes, agent.py:458-501):
+    continuous default is trunc_normal on [-1, 1]."""
+
+    actions_dim: Tuple[int, ...]
+    is_continuous: bool
+    distribution: str  # discrete | trunc_normal | tanh_normal | normal
+    init_std: float = 0.0
+    min_std: float = 0.1
+    expl_amount: float = 0.0
+    expl_decay: float = 0.0
+    expl_min: float = 0.0
+
+
+def _dv2_continuous_dist(pre_dist: jax.Array, spec: DV2ActorSpec):
+    mean, std = jnp.split(pre_dist, 2, axis=-1)
+    if spec.distribution == "tanh_normal":
+        mean = 5 * jnp.tanh(mean / 5)
+        std = jax.nn.softplus(std + spec.init_std) + spec.min_std
+        return Independent(Normal(mean, std), 1), True
+    if spec.distribution == "normal":
+        return Independent(Normal(mean, std), 1), False
+    # trunc_normal (continuous default, agent.py:536-539)
+    std = 2 * jax.nn.sigmoid((std + spec.init_std) / 2) + spec.min_std
+    return Independent(TruncatedNormal(jnp.tanh(mean), std, -1.0, 1.0), 1), False
+
+
+def dv2_actor_forward(
+    pre_dist: List[jax.Array],
+    spec: DV2ActorSpec,
+    key: Optional[jax.Array] = None,
+    greedy: bool = False,
+) -> Tuple[List[jax.Array], List[Any]]:
+    """Head outputs → (sampled actions, distributions)
+    (reference: Actor.forward, agent.py:506-556)."""
+    if spec.is_continuous:
+        dist, tanh_transformed = _dv2_continuous_dist(pre_dist[0], spec)
+        if not greedy:
+            actions = dist.rsample(key)
+        else:
+            sample = dist.sample(key, (100,))
+            log_prob = dist.log_prob(sample)
+            idx = jnp.argmax(log_prob, axis=0)
+            actions = jnp.take_along_axis(sample, idx[None, ..., None], axis=0)[0]
+        if tanh_transformed:
+            actions = jnp.tanh(actions)
+        return [actions], [dist]
+    dists = []
+    actions = []
+    keys = jax.random.split(key, len(pre_dist)) if key is not None else [None] * len(pre_dist)
+    for logits, k in zip(pre_dist, keys):
+        d = OneHotCategoricalStraightThrough(logits=logits)
+        dists.append(d)
+        actions.append(d.mode if greedy else d.rsample(k))
+    return actions, dists
+
+
+def add_exploration_noise(
+    actions: jax.Array, spec: DV2ActorSpec, amount: jax.Array, key: jax.Array, actions_dim: Sequence[int]
+) -> jax.Array:
+    """Exploration noise on concatenated actions (reference:
+    Actor.add_exploration_noise, agent.py:558-574): Normal jitter clipped to
+    [-1, 1] for continuous, epsilon-resampling per head for discrete."""
+    if spec.is_continuous:
+        noisy = jnp.clip(actions + amount * jax.random.normal(key, actions.shape, actions.dtype), -1, 1)
+        return jnp.where(amount > 0, noisy, actions)
+    splits = np.cumsum(np.asarray(actions_dim))[:-1]
+    out = []
+    for act, k in zip(jnp.split(actions, splits, -1), jax.random.split(key, len(actions_dim))):
+        k_cat, k_mask = jax.random.split(k)
+        rand = OneHotCategoricalStraightThrough(logits=jnp.zeros_like(act)).sample(k_cat)
+        take_rand = jax.random.uniform(k_mask, act.shape[:1]) < amount
+        out.append(jnp.where(take_rand[..., None], rand, act))
+    return jnp.concatenate(out, -1)
+
+
+@dataclass(frozen=True)
+class DV2Agent:
+    """Bundles the modules + metadata; params live in the train state
+    {world_model, actor, critic, target_critic}."""
+
+    world_model: DV2WorldModel
+    actor: DV2Actor
+    critic: Any  # MLP
+    actor_spec: DV2ActorSpec
+    actions_dim: Tuple[int, ...]
+    is_continuous: bool
+
+    def wm(self, params, *args, method: str):
+        return self.world_model.apply(params, *args, method=getattr(DV2WorldModel, method))
+
+    def critic_value(self, params, latent: jax.Array) -> jax.Array:
+        return self.critic.apply(params, latent)
+
+    # ---------------------------------------------------------------- player
+    def init_player_state(self, wm_params, n_envs: int) -> Dict[str, jax.Array]:
+        """Zero player state (reference: PlayerDV2.init_states,
+        agent.py:778-800 — DV2 has no learned initial state)."""
+        del wm_params  # kept for API parity with the DV3 player
+        return {
+            "recurrent_state": jnp.zeros((n_envs, self.world_model.recurrent_state_size)),
+            "stochastic_state": jnp.zeros((n_envs, self.world_model.stoch_state_size)),
+            "actions": jnp.zeros((n_envs, int(np.sum(self.actions_dim)))),
+        }
+
+    def reset_player_state(
+        self, wm_params, state: Dict[str, jax.Array], reset_mask: jax.Array
+    ) -> Dict[str, jax.Array]:
+        m = reset_mask[..., None]
+        return {k: (1 - m) * v for k, v in state.items()}
+
+    def player_step(
+        self,
+        wm_params,
+        actor_params,
+        state: Dict[str, jax.Array],
+        obs: Dict[str, jax.Array],
+        key: jax.Array,
+        greedy: bool = False,
+    ):
+        """One acting step (reference: PlayerDV2.get_actions, agent.py:802-832).
+        Returns (actions_cat, real_actions, new_state)."""
+        k1, k2 = jax.random.split(key)
+        embedded = self.wm(wm_params, obs, method="embed_obs")
+        recurrent_state = self.world_model.apply(
+            wm_params,
+            jnp.concatenate([state["stochastic_state"], state["actions"]], -1),
+            state["recurrent_state"],
+            method=lambda wm, x, h: wm.recurrent_model(x, h),
+        )
+        _, stochastic_state = self.world_model.apply(
+            wm_params, recurrent_state, embedded, k1, method=DV2WorldModel._representation
+        )
+        latent = jnp.concatenate([stochastic_state, recurrent_state], -1)
+        pre_dist = self.actor.apply(actor_params, latent)
+        actions, _ = dv2_actor_forward(pre_dist, self.actor_spec, k2, greedy)
+        actions_cat = jnp.concatenate(actions, -1)
+        if self.is_continuous:
+            real_actions = actions_cat
+        else:
+            real_actions = jnp.stack([jnp.argmax(a, -1) for a in actions], -1)
+        new_state = {
+            "recurrent_state": recurrent_state,
+            "stochastic_state": stochastic_state,
+            "actions": actions_cat,
+        }
+        return actions_cat, real_actions, new_state
+
+
+def build_world_model_module(cfg: Dict[str, Any], obs_space, actions_dim, dtype) -> DV2WorldModel:
+    wm_cfg = cfg.algo.world_model
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    return DV2WorldModel(
+        cnn_keys=tuple(cnn_keys),
+        mlp_keys=tuple(mlp_keys),
+        cnn_input_channels=tuple(int(obs_space[k].shape[-1]) for k in cnn_keys),
+        mlp_input_dims=tuple(int(obs_space[k].shape[0]) for k in mlp_keys),
+        image_size=tuple(obs_space[cnn_keys[0]].shape[:2]) if cnn_keys else (64, 64),
+        actions_dim=tuple(actions_dim),
+        stochastic_size=wm_cfg.stochastic_size,
+        discrete_size=wm_cfg.discrete_size,
+        recurrent_state_size=wm_cfg.recurrent_model.recurrent_state_size,
+        recurrent_dense_units=wm_cfg.recurrent_model.dense_units,
+        recurrent_layer_norm=bool(wm_cfg.recurrent_model.layer_norm),
+        transition_hidden_size=wm_cfg.transition_model.hidden_size,
+        representation_hidden_size=wm_cfg.representation_model.hidden_size,
+        encoder_cnn_channels_multiplier=wm_cfg.encoder.cnn_channels_multiplier,
+        encoder_mlp_layers=wm_cfg.encoder.mlp_layers,
+        encoder_dense_units=wm_cfg.encoder.dense_units,
+        decoder_cnn_channels_multiplier=wm_cfg.observation_model.cnn_channels_multiplier,
+        decoder_mlp_layers=wm_cfg.observation_model.mlp_layers,
+        decoder_dense_units=wm_cfg.observation_model.dense_units,
+        reward_mlp_layers=wm_cfg.reward_model.mlp_layers,
+        reward_dense_units=wm_cfg.reward_model.dense_units,
+        continue_mlp_layers=wm_cfg.discount_model.mlp_layers,
+        continue_dense_units=wm_cfg.discount_model.dense_units,
+        use_continues=bool(wm_cfg.use_continues),
+        cnn_act="elu",
+        dense_act="elu",
+        layer_norm=bool(cfg.algo.layer_norm),
+        dtype=dtype,
+    )
+
+
+def build_agent(
+    runtime,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+    world_model_state: Optional[Any] = None,
+    actor_state: Optional[Any] = None,
+    critic_state: Optional[Any] = None,
+    target_critic_state: Optional[Any] = None,
+) -> Tuple[DV2Agent, Dict[str, Any]]:
+    """Construct modules + initial (or restored) params
+    (reference: build_agent, agent.py:835-1104)."""
+    dtype = runtime.precision.compute_dtype
+    distribution = str(cfg.distribution.get("type", "auto")).lower()
+    if distribution not in ("auto", "normal", "tanh_normal", "discrete", "trunc_normal"):
+        raise ValueError(
+            "The distribution must be on of: `auto`, `discrete`, `normal`, `tanh_normal` and `trunc_normal`. "
+            f"Found: {distribution}"
+        )
+    if distribution == "discrete" and is_continuous:
+        raise ValueError("You have choose a discrete distribution but `is_continuous` is true")
+    if distribution == "auto":
+        distribution = "trunc_normal" if is_continuous else "discrete"
+
+    wm = build_world_model_module(cfg, obs_space, actions_dim, dtype)
+    actor = DV2Actor(
+        actions_dim=tuple(actions_dim),
+        is_continuous=is_continuous,
+        dense_units=cfg.algo.actor.dense_units,
+        mlp_layers=cfg.algo.actor.mlp_layers,
+        activation="elu",
+        layer_norm=bool(cfg.algo.layer_norm),
+        dtype=dtype,
+    )
+    critic = MLP(
+        hidden_sizes=[cfg.algo.critic.dense_units] * cfg.algo.critic.mlp_layers,
+        output_dim=1,
+        activation="elu",
+        norm_layer="layer_norm" if cfg.algo.layer_norm else None,
+        norm_args={} if cfg.algo.layer_norm else None,
+        kernel_init=xavier_normal_init,
+        output_kernel_init=xavier_normal_init,
+        dtype=dtype,
+    )
+    spec = DV2ActorSpec(
+        actions_dim=tuple(int(d) for d in actions_dim),
+        is_continuous=is_continuous,
+        distribution=distribution,
+        init_std=cfg.algo.actor.init_std,
+        min_std=cfg.algo.actor.min_std,
+        expl_amount=float(cfg.algo.actor.get("expl_amount", 0.0)),
+        expl_decay=float(cfg.algo.actor.get("expl_decay", 0.0)),
+        expl_min=float(cfg.algo.actor.get("expl_min", 0.0)),
+    )
+    agent = DV2Agent(
+        world_model=wm,
+        actor=actor,
+        critic=critic,
+        actor_spec=spec,
+        actions_dim=tuple(int(d) for d in actions_dim),
+        is_continuous=is_continuous,
+    )
+
+    k_wm, k_actor, k_critic, k_call = jax.random.split(runtime.root_key, 4)
+    n = 1
+    dummy_obs = {
+        k: jnp.zeros((n, *obs_space[k].shape), jnp.float32)
+        for k in list(cfg.algo.cnn_keys.encoder) + list(cfg.algo.mlp_keys.encoder)
+    }
+    dummy_actions = jnp.zeros((n, int(np.sum(actions_dim))), jnp.float32)
+    latent_size = wm.latent_state_size
+
+    if world_model_state is not None:
+        wm_params = jax.tree_util.tree_map(jnp.asarray, world_model_state)
+    else:
+        wm_params = wm.init({"params": k_wm, "sample": k_call}, dummy_obs, dummy_actions, k_call)
+    actor_params = (
+        jax.tree_util.tree_map(jnp.asarray, actor_state)
+        if actor_state is not None
+        else actor.init(k_actor, jnp.zeros((n, latent_size), jnp.float32))
+    )
+    critic_params = (
+        jax.tree_util.tree_map(jnp.asarray, critic_state)
+        if critic_state is not None
+        else critic.init(k_critic, jnp.zeros((n, latent_size), jnp.float32))
+    )
+    target_critic_params = (
+        jax.tree_util.tree_map(jnp.asarray, target_critic_state)
+        if target_critic_state is not None
+        else jax.tree_util.tree_map(jnp.copy, critic_params)
+    )
+    state = {
+        "world_model": wm_params,
+        "actor": actor_params,
+        "critic": critic_params,
+        "target_critic": target_critic_params,
+    }
+    return agent, state

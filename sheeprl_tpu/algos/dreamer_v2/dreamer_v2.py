@@ -1,18 +1,15 @@
-"""DreamerV3 training loop (reference: sheeprl/algos/dreamer_v3/dreamer_v3.py).
+"""DreamerV2 training loop (reference: sheeprl/algos/dreamer_v2/dreamer_v2.py).
 
-TPU-first structure (SURVEY §3.3 / §7.2):
-- Dynamic learning: the RSSM runs as ONE `lax.scan` over the sequence axis
-  (the reference python-loops per-step GRU cells, dreamer_v3.py:134-145) —
-  carry = (h, z), stacked outputs (h_t, z_t, logits).
-- Behaviour learning: imagination is a second `lax.scan` over the horizon
-  starting from every (t, b) posterior flattened to one batch, with per-step
-  PRNG keys for actor sampling.
-- λ-returns: reverse scan (ops.compute_lambda_values); Moments state is a
-  pytree threaded through the jitted step, its quantile a global reduction
-  under the mesh sharding.
-- The whole gradient step (world model + actor + critic, three optax
-  optimizers with clipping) is ONE jitted, donated call; the target-critic
-  EMA cadence stays on host (tau passed as a traced scalar, 0 = no-op).
+TPU-first structure, same shape as the DreamerV3 loop in this package
+(SURVEY §3.3 / §7.2): the RSSM runs as ONE `lax.scan` over the sequence axis,
+imagination is a second scan over the horizon, λ-targets are a reverse scan,
+and the whole gradient step (world model + actor + critic) is one jitted,
+donated call. DV2-specific pieces: KL-balanced world-model loss
+(loss.py), Normal(·,1) reward/critic/decoder heads, REINFORCE/dynamics-mixed
+actor objective (reference dreamer_v2.py:307-331), a hard-copied target
+critic every `per_rank_target_network_update_freq` gradient steps
+(dreamer_v2.py:697-703, done host-side here), and an optional EpisodeBuffer
+(`buffer.type=episode`, dreamer_v2.py:498-521).
 """
 
 from __future__ import annotations
@@ -21,7 +18,7 @@ import copy
 import os
 import warnings
 from functools import partial
-from typing import Any, Dict, Sequence
+from typing import Any, Dict
 
 import gymnasium as gym
 import jax
@@ -29,34 +26,24 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from sheeprl_tpu.algos.dreamer_v3.agent import (
-    DV3Agent,
-    WorldModel,
-    actor_forward,
+from sheeprl_tpu.algos.dreamer_v2.agent import (
+    DV2Agent,
+    DV2WorldModel,
     build_agent,
-    continuous_log_prob_and_entropy,
+    dv2_actor_forward,
 )
-from sheeprl_tpu.algos.dreamer_v3.loss import reconstruction_loss
-from sheeprl_tpu.algos.dreamer_v3.utils import prepare_obs, test
+from sheeprl_tpu.algos.dreamer_v2.loss import reconstruction_loss
+from sheeprl_tpu.algos.dreamer_v2.utils import compute_lambda_values, prepare_obs, test
 from sheeprl_tpu.algos.ppo.agent import actions_metadata
 from sheeprl_tpu.config.instantiate import instantiate, locate
 from sheeprl_tpu.core.mesh import DATA_AXIS
-from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
-from sheeprl_tpu.envs.wrappers import RestartOnException
+from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, EpisodeBuffer, SequentialReplayBuffer
 from sheeprl_tpu.registry import register_algorithm
 from sheeprl_tpu.utils.checkpoint import load_checkpoint, restore_opt_state, save_checkpoint
-from sheeprl_tpu.utils.distribution import (
-    BernoulliSafeMode,
-    Independent,
-    MSEDistribution,
-    OneHotCategorical,
-    SymlogDistribution,
-    TwoHotEncodingDistribution,
-)
+from sheeprl_tpu.utils.distribution import BernoulliSafeMode, Independent, Normal, OneHotCategorical
 from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
-from sheeprl_tpu.utils.ops import compute_lambda_values, init_moments, update_moments
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import Ratio, save_configs
 
@@ -70,15 +57,13 @@ def _make_optimizer(optim_cfg: Dict[str, Any], clip: float) -> optax.GradientTra
     return inner
 
 
-def make_train_step(agent: DV3Agent, txs: Dict[str, optax.GradientTransformation], cfg: Dict[str, Any], mesh):
+def make_train_step(agent: DV2Agent, txs: Dict[str, optax.GradientTransformation], cfg: Dict[str, Any], mesh):
     """Build the jitted single-gradient-step function over a [T, B] batch."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     wm_cfg = cfg.algo.world_model
     cnn_keys = list(cfg.algo.cnn_keys.encoder)
     mlp_keys = list(cfg.algo.mlp_keys.encoder)
-    cnn_dec_keys = list(cfg.algo.cnn_keys.decoder)
-    mlp_dec_keys = list(cfg.algo.mlp_keys.decoder)
     stochastic_size = int(wm_cfg.stochastic_size)
     discrete_size = int(wm_cfg.discrete_size)
     stoch_state_size = stochastic_size * discrete_size
@@ -87,8 +72,8 @@ def make_train_step(agent: DV3Agent, txs: Dict[str, optax.GradientTransformation
     gamma = float(cfg.algo.gamma)
     lmbda = float(cfg.algo.lmbda)
     ent_coef = float(cfg.algo.actor.ent_coef)
-    moments_cfg = cfg.algo.actor.moments
-    decoupled = bool(wm_cfg.decoupled_rssm)
+    objective_mix = float(cfg.algo.actor.objective_mix)
+    use_continues = bool(wm_cfg.use_continues)
     spec = agent.actor_spec
     actions_dim = agent.actions_dim
 
@@ -96,68 +81,38 @@ def make_train_step(agent: DV3Agent, txs: Dict[str, optax.GradientTransformation
 
     def world_loss_fn(wm_params, data, batch_obs, keys):
         T, B = data["rewards"].shape[:2]
-        embedded = agent.wm(wm_params, batch_obs, method="embed_obs")  # [T, B, E]
+        embedded = agent.wm(wm_params, batch_obs, method="embed_obs")
 
-        batch_actions = jnp.concatenate(
-            [jnp.zeros_like(data["actions"][:1]), data["actions"][:-1]], axis=0
-        )
         is_first = data["is_first"].at[0].set(1.0)
-
         h0 = jnp.zeros((B, recurrent_state_size), embedded.dtype)
         z0 = jnp.zeros((B, stoch_state_size), embedded.dtype)
-        step_keys, post_key = keys[:T], keys[T]
 
-        if decoupled:
-            # Decoupled RSSM (reference: dreamer_v3.py:115-130): posteriors are
-            # obs-only, computed for the WHOLE sequence in one batched matmul;
-            # the scan then only threads the recurrent state, feeding each step
-            # the previous step's posterior.
-            posteriors_logits, posteriors = agent.world_model.apply(
-                wm_params, embedded, post_key, method=WorldModel.posterior_obs_only
+        def step(carry, x):
+            h, z = carry
+            action, emb, first, key = x
+            h, post, prior, post_logits, prior_logits = agent.world_model.apply(
+                wm_params, z, h, action, emb, first, key, method=DV2WorldModel.dynamic
             )
-            prev_posteriors = jnp.concatenate([jnp.zeros_like(posteriors[:1]), posteriors[:-1]], 0)
+            return (h, post), (h, post, post_logits, prior_logits)
 
-            def dstep(h, x):
-                z_prev, action, first, key = x
-                h, _, prior_logits = agent.world_model.apply(
-                    wm_params, z_prev, h, action, first, key, method=WorldModel.dynamic_decoupled
-                )
-                return h, (h, prior_logits)
-
-            _, (recurrent_states, priors_logits) = jax.lax.scan(
-                dstep, h0, (prev_posteriors, batch_actions, is_first, step_keys)
-            )
-        else:
-
-            def step(carry, x):
-                h, z = carry
-                action, emb, first, key = x
-                h, post, prior, post_logits, prior_logits = agent.world_model.apply(
-                    wm_params, z, h, action, emb, first, key, method=WorldModel.dynamic
-                )
-                return (h, post), (h, post, post_logits, prior_logits)
-
-            (_, _), (recurrent_states, posteriors, posteriors_logits, priors_logits) = jax.lax.scan(
-                step, (h0, z0), (batch_actions, embedded, is_first, step_keys)
-            )
+        (_, _), (recurrent_states, posteriors, posteriors_logits, priors_logits) = jax.lax.scan(
+            step, (h0, z0), (data["actions"], embedded, is_first, keys)
+        )
         latent_states = jnp.concatenate([posteriors, recurrent_states], -1)
 
         reconstructed_obs = agent.wm(wm_params, latent_states, method="decode")
         po = {
-            k: MSEDistribution(reconstructed_obs[k], dims=len(reconstructed_obs[k].shape[2:]))
-            for k in cnn_dec_keys
+            k: Independent(Normal(v, jnp.ones_like(v)), len(v.shape[2:]))
+            for k, v in reconstructed_obs.items()
         }
-        po.update(
-            {
-                k: SymlogDistribution(reconstructed_obs[k], dims=len(reconstructed_obs[k].shape[2:]))
-                for k in mlp_dec_keys
-            }
-        )
-        pr = TwoHotEncodingDistribution(agent.wm(wm_params, latent_states, method="reward_logits"), dims=1)
-        pc = Independent(
-            BernoulliSafeMode(logits=agent.wm(wm_params, latent_states, method="continue_logits")), 1
-        )
-        continues_targets = 1 - data["terminated"]
+        pr = Independent(Normal(agent.wm(wm_params, latent_states, method="reward"), 1.0), 1)
+        if use_continues:
+            pc = Independent(
+                BernoulliSafeMode(logits=agent.wm(wm_params, latent_states, method="continue_logits")), 1
+            )
+            continues_targets = (1 - data["terminated"]) * gamma
+        else:
+            pc = continues_targets = None
 
         pl = priors_logits.reshape(*priors_logits.shape[:-1], stochastic_size, discrete_size)
         pol = posteriors_logits.reshape(*posteriors_logits.shape[:-1], stochastic_size, discrete_size)
@@ -168,20 +123,20 @@ def make_train_step(agent: DV3Agent, txs: Dict[str, optax.GradientTransformation
             data["rewards"],
             pl,
             pol,
-            wm_cfg.kl_dynamic,
-            wm_cfg.kl_representation,
+            wm_cfg.kl_balancing_alpha,
             wm_cfg.kl_free_nats,
+            wm_cfg.kl_free_avg,
             wm_cfg.kl_regularizer,
             pc,
             continues_targets,
-            wm_cfg.continue_scale_factor,
+            wm_cfg.discount_scale_factor,
         )
         aux = {
             "posteriors": posteriors,
             "recurrent_states": recurrent_states,
             "posteriors_logits": pol,
             "priors_logits": pl,
-            "kl": kl,
+            "kl": kl.mean(),
             "state_loss": state_loss,
             "reward_loss": reward_loss,
             "observation_loss": observation_loss,
@@ -189,16 +144,15 @@ def make_train_step(agent: DV3Agent, txs: Dict[str, optax.GradientTransformation
         }
         return rec_loss, aux
 
-    @partial(jax.jit, donate_argnums=(0, 1, 2))
-    def train_step(state, opt_states, moments_state, data, key, tau):
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(state, opt_states, data, key):
         T, B = data["rewards"].shape[:2]
         data = jax.lax.with_sharding_constraint(data, {k: batch_sharding for k in data})
         batch_obs = {k: data[k] / 255.0 - 0.5 for k in cnn_keys}
         batch_obs.update({k: data[k] for k in mlp_keys})
 
-        k_dyn, k_img0, k_img, k_actor = jax.random.split(key, 4)
-        # T per-step keys + one extra for the decoupled whole-sequence posterior
-        dyn_keys = jax.random.split(k_dyn, T + 1)
+        k_dyn, k_img, k_actor = jax.random.split(key, 3)
+        dyn_keys = jax.random.split(k_dyn, T)
 
         # ---------------------------------------------- world model update
         (rec_loss, aux), wm_grads = jax.value_and_grad(world_loss_fn, has_aux=True)(
@@ -211,93 +165,88 @@ def make_train_step(agent: DV3Agent, txs: Dict[str, optax.GradientTransformation
 
         # --------------------------------------------- behaviour learning
         sg = jax.lax.stop_gradient
-        imagined_prior = sg(aux["posteriors"]).reshape(-1, stoch_state_size)
-        recurrent_state = sg(aux["recurrent_states"]).reshape(-1, recurrent_state_size)
-        latent0 = jnp.concatenate([imagined_prior, recurrent_state], -1)
+        imagined_prior0 = sg(aux["posteriors"]).reshape(-1, stoch_state_size)
+        recurrent_state0 = sg(aux["recurrent_states"]).reshape(-1, recurrent_state_size)
+        latent0 = jnp.concatenate([imagined_prior0, recurrent_state0], -1)
 
         def actor_sample(actor_params, latent, k):
             pre = agent.actor.apply(actor_params, sg(latent))
-            actions, _ = actor_forward(pre, spec, k, greedy=False)
+            actions, _ = dv2_actor_forward(pre, spec, k, greedy=False)
             return jnp.concatenate(actions, -1)
 
         def imagine_loss_fn(actor_params):
-            # Imagination rollout (actions re-sampled from the CURRENT actor
-            # params so the pathwise gradient flows; reference does the same
-            # through in-place module weights, dreamer_v3.py:219-241).
-            a0 = actor_sample(actor_params, latent0, k_img0)
-
+            # Rollout: imagined_actions[0] is the zero action; action i is
+            # taken FROM state i-1 (reference: dreamer_v2.py:239-259).
             def img_step(carry, k):
-                prior, h, actions = carry
-                k_wm, k_act = jax.random.split(k)
+                prior, h, latent = carry
+                k_act, k_wm = jax.random.split(k)
+                actions = actor_sample(actor_params, latent, k_act)
                 prior, h = agent.world_model.apply(
-                    state["world_model"], prior, h, actions, k_wm, method=WorldModel.imagination
+                    state["world_model"], prior, h, actions, k_wm, method=DV2WorldModel.imagination
                 )
                 latent = jnp.concatenate([prior, h], -1)
-                next_actions = actor_sample(actor_params, latent, k_act)
-                return (prior, h, next_actions), (latent, next_actions)
+                return (prior, h, latent), (latent, actions)
 
             img_keys = jax.random.split(k_img, horizon)
             _, (latents, img_actions) = jax.lax.scan(
-                img_step, (imagined_prior, recurrent_state, a0), img_keys
+                img_step, (imagined_prior0, recurrent_state0, latent0), img_keys
             )
             imagined_trajectories = jnp.concatenate([latent0[None], latents], 0)  # [H+1, TB, L]
-            imagined_actions = jnp.concatenate([a0[None], img_actions], 0)
+            zero_action = jnp.zeros_like(img_actions[:1])
+            imagined_actions = jnp.concatenate([zero_action, img_actions], 0)  # [H+1, TB, A]
 
-            # Predict values / rewards / continues on the imagined rollout
-            predicted_values = TwoHotEncodingDistribution(
-                agent.critic_logits(state["critic"], imagined_trajectories), dims=1
-            ).mean
-            predicted_rewards = TwoHotEncodingDistribution(
-                agent.wm(state["world_model"], imagined_trajectories, method="reward_logits"), dims=1
-            ).mean
-            continues = Independent(
-                BernoulliSafeMode(
-                    logits=agent.wm(state["world_model"], imagined_trajectories, method="continue_logits")
-                ),
-                1,
-            ).mode
-            true_continue = (1 - data["terminated"]).reshape(1, -1, 1)
-            continues = jnp.concatenate([true_continue, continues[1:]], 0)
+            # Predictions along the imagined rollout (target critic values)
+            predicted_target_values = agent.critic_value(
+                state["target_critic"], imagined_trajectories
+            )
+            predicted_rewards = agent.wm(
+                state["world_model"], imagined_trajectories, method="reward"
+            )
+            if use_continues:
+                continues = jax.nn.sigmoid(
+                    agent.wm(state["world_model"], imagined_trajectories, method="continue_logits")
+                )
+                true_continue = (1 - data["terminated"]).reshape(1, -1, 1) * gamma
+                continues = jnp.concatenate([true_continue, continues[1:]], 0)
+            else:
+                continues = jnp.ones_like(sg(predicted_rewards)) * gamma
 
             lambda_values = compute_lambda_values(
-                predicted_rewards[1:], predicted_values[1:], continues[1:] * gamma, lmbda
+                predicted_rewards[:-1],
+                predicted_target_values[:-1],
+                continues[:-1],
+                bootstrap=predicted_target_values[-1:],
+                lmbda=lmbda,
             )
-            discount = sg(jnp.cumprod(continues * gamma, 0) / gamma)
-
-            # Actor objective (reference: dreamer_v3.py:262-297)
-            new_moments, (offset, invscale) = update_moments(
-                moments_state,
-                lambda_values,
-                decay=moments_cfg.decay,
-                max_=moments_cfg.max,
-                percentile_low=moments_cfg.percentile.low,
-                percentile_high=moments_cfg.percentile.high,
+            discount = sg(
+                jnp.cumprod(jnp.concatenate([jnp.ones_like(continues[:1]), continues[:-1]], 0), 0)
             )
-            baseline = predicted_values[:-1]
-            normed_lambda_values = (lambda_values - offset) / invscale
-            normed_baseline = (baseline - offset) / invscale
-            advantage = normed_lambda_values - normed_baseline
 
-            pre = agent.actor.apply(actor_params, sg(imagined_trajectories))
-            _, policies = actor_forward(pre, spec, k_actor, greedy=False)
+            # Actor objective: REINFORCE / dynamics mix (dreamer_v2.py:307-331)
+            pre = agent.actor.apply(actor_params, sg(imagined_trajectories[:-2]))
+            _, policies = dv2_actor_forward(pre, spec, k_actor, greedy=False)
+            dynamics = lambda_values[1:]
+            advantage = sg(lambda_values[1:] - predicted_target_values[:-2])
             if spec.is_continuous:
-                objective = advantage
-                _, entropy = continuous_log_prob_and_entropy(policies[0], imagined_actions, spec)
-                entropy = ent_coef * entropy if entropy is not None else jnp.zeros(advantage.shape[:-1])
+                logp = policies[0].log_prob(sg(imagined_actions[1:-1]))[..., None]
             else:
                 splits = np.cumsum(actions_dim)[:-1]
                 per_dim = jnp.split(imagined_actions, splits, -1)
                 logp = jnp.stack(
-                    [p.log_prob(sg(a))[..., None][:-1] for p, a in zip(policies, per_dim)], -1
+                    [p.log_prob(sg(a[1:-1]))[..., None] for p, a in zip(policies, per_dim)], -1
                 ).sum(-1)
-                objective = logp * sg(advantage)
+            reinforce = logp * advantage
+            objective = objective_mix * reinforce + (1 - objective_mix) * dynamics
+            try:
                 entropy = ent_coef * jnp.stack([p.entropy() for p in policies], -1).sum(-1)
-            policy_loss = -jnp.mean(sg(discount[:-1]) * (objective + entropy[..., None][:-1]))
+                entropy = entropy[..., None] if entropy.ndim < objective.ndim else entropy
+            except NotImplementedError:
+                entropy = jnp.zeros_like(objective)
+            policy_loss = -jnp.mean(sg(discount[:-2]) * (objective + entropy))
             img_aux = {
                 "imagined_trajectories": sg(imagined_trajectories),
                 "lambda_values": sg(lambda_values),
                 "discount": discount,
-                "moments": new_moments,
             }
             return policy_loss, img_aux
 
@@ -311,26 +260,16 @@ def make_train_step(agent: DV3Agent, txs: Dict[str, optax.GradientTransformation
         traj = img_aux["imagined_trajectories"][:-1]
         lambda_values = img_aux["lambda_values"]
         discount = img_aux["discount"]
-        predicted_target_values = TwoHotEncodingDistribution(
-            agent.critic_logits(state["target_critic"], traj), dims=1
-        ).mean
 
         def critic_loss_fn(critic_params):
-            qv = TwoHotEncodingDistribution(agent.critic_logits(critic_params, traj), dims=1)
-            value_loss = -qv.log_prob(lambda_values)
-            value_loss = value_loss - qv.log_prob(sg(predicted_target_values))
-            return jnp.mean(value_loss * discount[:-1].squeeze(-1))
+            qv = Independent(Normal(agent.critic_value(critic_params, traj), 1.0), 1)
+            return -jnp.mean(discount[:-1, ..., 0] * qv.log_prob(lambda_values))
 
         value_loss, critic_grads = jax.value_and_grad(critic_loss_fn)(state["critic"])
         critic_updates, critic_opt = txs["critic"].update(
             critic_grads, opt_states["critic"], state["critic"]
         )
         state["critic"] = optax.apply_updates(state["critic"], critic_updates)
-
-        # target critic EMA (host decides tau; 0 = frozen)
-        state["target_critic"] = jax.tree_util.tree_map(
-            lambda p, tp: tau * p + (1 - tau) * tp, state["critic"], state["target_critic"]
-        )
 
         opt_states = {"world_model": wm_opt, "actor": actor_opt, "critic": critic_opt}
         metrics = {
@@ -352,14 +291,13 @@ def make_train_step(agent: DV3Agent, txs: Dict[str, optax.GradientTransformation
             "Grads/actor": optax.global_norm(actor_grads),
             "Grads/critic": optax.global_norm(critic_grads),
         }
-        return state, opt_states, img_aux["moments"], metrics
+        return state, opt_states, metrics
 
     return train_step
 
 
 @register_algorithm()
 def main(runtime, cfg: Dict[str, Any]):
-    mesh = runtime.mesh
     rank = runtime.global_rank
     world_size = jax.process_count()
 
@@ -367,10 +305,9 @@ def main(runtime, cfg: Dict[str, Any]):
     if cfg.checkpoint.resume_from:
         state_ckpt = load_checkpoint(cfg.checkpoint.resume_from)
 
-    # These arguments cannot be changed
-    cfg.env.frame_stack = -1
-    if 2 ** int(np.log2(cfg.env.screen_size)) != cfg.env.screen_size:
-        raise ValueError(f"The screen size must be a power of 2, got: {cfg.env.screen_size}")
+    # These arguments cannot be changed (reference: dreamer_v2.py:398-400)
+    cfg.env.screen_size = 64
+    cfg.env.frame_stack = 1
 
     logger = get_logger(runtime, cfg)
     if logger is not None:
@@ -381,16 +318,13 @@ def main(runtime, cfg: Dict[str, Any]):
     vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
     envs = vectorized_env(
         [
-            partial(
-                RestartOnException,
-                make_env(
-                    cfg,
-                    cfg.seed + rank * cfg.env.num_envs + i,
-                    rank * cfg.env.num_envs,
-                    log_dir if rank == 0 else None,
-                    "train",
-                    vector_env_idx=i,
-                ),
+            make_env(
+                cfg,
+                cfg.seed + rank * cfg.env.num_envs + i,
+                rank * cfg.env.num_envs,
+                log_dir if rank == 0 else None,
+                "train",
+                vector_env_idx=i,
             )
             for i in range(cfg.env.num_envs)
         ],
@@ -408,21 +342,6 @@ def main(runtime, cfg: Dict[str, Any]):
         and len(set(cfg.algo.mlp_keys.encoder).intersection(set(cfg.algo.mlp_keys.decoder))) == 0
     ):
         raise RuntimeError("The CNN keys or the MLP keys of the encoder and decoder must not be disjointed")
-    if len(set(cfg.algo.cnn_keys.decoder) - set(cfg.algo.cnn_keys.encoder)) > 0:
-        raise RuntimeError(
-            "The CNN keys of the decoder must be contained in the encoder ones, "
-            f"got: decoder = {cfg.algo.cnn_keys.decoder}, encoder = {cfg.algo.cnn_keys.encoder}"
-        )
-    if len(set(cfg.algo.mlp_keys.decoder) - set(cfg.algo.mlp_keys.encoder)) > 0:
-        raise RuntimeError(
-            "The MLP keys of the decoder must be contained in the encoder ones, "
-            f"got: decoder = {cfg.algo.mlp_keys.decoder}, encoder = {cfg.algo.mlp_keys.encoder}"
-        )
-    if cfg.metric.log_level > 0:
-        runtime.print("Encoder CNN keys:", cfg.algo.cnn_keys.encoder)
-        runtime.print("Encoder MLP keys:", cfg.algo.mlp_keys.encoder)
-        runtime.print("Decoder CNN keys:", cfg.algo.cnn_keys.decoder)
-        runtime.print("Decoder MLP keys:", cfg.algo.mlp_keys.decoder)
     obs_keys = list(cfg.algo.cnn_keys.encoder) + list(cfg.algo.mlp_keys.encoder)
 
     agent, agent_state = build_agent(
@@ -455,14 +374,8 @@ def main(runtime, cfg: Dict[str, Any]):
         ):
             opt_states[name] = restore_opt_state(opt_states[name], state_ckpt[ckpt_key])
 
-    # Explicit mesh placement: replicated, or tensor-parallel over the model
-    # axis for the wide dense stacks when fabric.model_axis > 1.
     agent_state = runtime.shard_params(agent_state)
     opt_states = runtime.shard_params(opt_states)
-
-    moments_state = init_moments()
-    if state_ckpt is not None and "moments" in state_ckpt:
-        moments_state = jax.tree_util.tree_map(jnp.asarray, state_ckpt["moments"])
 
     if runtime.is_global_zero:
         save_configs(cfg, log_dir)
@@ -471,14 +384,29 @@ def main(runtime, cfg: Dict[str, Any]):
     if not MetricAggregator.disabled:
         aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
 
-    buffer_size = cfg.buffer.size // int(cfg.env.num_envs * world_size) if not cfg.dry_run else 2
-    rb = EnvIndependentReplayBuffer(
-        buffer_size,
-        n_envs=cfg.env.num_envs,
-        memmap=cfg.buffer.memmap,
-        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
-        buffer_cls=SequentialReplayBuffer,
-    )
+    buffer_size = cfg.buffer.size // int(cfg.env.num_envs * world_size) if not cfg.dry_run else 4
+    buffer_type = str(cfg.buffer.get("type", "sequential")).lower()
+    if buffer_type == "sequential":
+        rb = EnvIndependentReplayBuffer(
+            buffer_size,
+            n_envs=cfg.env.num_envs,
+            obs_keys=obs_keys,
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+            buffer_cls=SequentialReplayBuffer,
+        )
+    elif buffer_type == "episode":
+        rb = EpisodeBuffer(
+            buffer_size,
+            minimum_episode_length=1 if cfg.dry_run else cfg.algo.per_rank_sequence_length,
+            n_envs=cfg.env.num_envs,
+            obs_keys=obs_keys,
+            prioritize_ends=cfg.buffer.get("prioritize_ends", False),
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+        )
+    else:
+        raise ValueError(f"Unrecognized buffer type: must be one of `sequential` or `episode`, received: {buffer_type}")
     if state_ckpt is not None and cfg.buffer.checkpoint and state_ckpt.get("rb") is not None:
         rb = state_ckpt["rb"]
 
@@ -514,7 +442,7 @@ def main(runtime, cfg: Dict[str, Any]):
             "the checkpoint will be saved at the nearest greater multiple of the policy_steps_per_iter value."
         )
 
-    train_fn = make_train_step(agent, txs, cfg, mesh)
+    train_fn = make_train_step(agent, txs, cfg, runtime.mesh)
     player_step_fn = jax.jit(
         lambda wm, a, s, o, k: agent.player_step(wm, a, s, o, k, greedy=False)
     )
@@ -527,10 +455,15 @@ def main(runtime, cfg: Dict[str, Any]):
     obs = envs.reset(seed=cfg.seed)[0]
     for k in obs_keys:
         step_data[k] = obs[k][np.newaxis]
-    step_data["rewards"] = np.zeros((1, cfg.env.num_envs, 1), np.float32)
-    step_data["truncated"] = np.zeros((1, cfg.env.num_envs, 1), np.float32)
     step_data["terminated"] = np.zeros((1, cfg.env.num_envs, 1), np.float32)
+    step_data["truncated"] = np.zeros((1, cfg.env.num_envs, 1), np.float32)
+    if cfg.dry_run:
+        step_data["terminated"] = step_data["terminated"] + 1
+        step_data["truncated"] = step_data["truncated"] + 1
+    step_data["actions"] = np.zeros((1, cfg.env.num_envs, int(np.sum(actions_dim))), np.float32)
+    step_data["rewards"] = np.zeros((1, cfg.env.num_envs, 1), np.float32)
     step_data["is_first"] = np.ones_like(step_data["terminated"])
+    rb.add(step_data, validate_args=cfg.buffer.validate_args)
     player_state = init_player_fn(agent_state["world_model"], cfg.env.num_envs)
 
     cumulative_per_rank_gradient_steps = 0
@@ -557,32 +490,15 @@ def main(runtime, cfg: Dict[str, Any]):
                 actions = np.asarray(actions_cat)
                 real_actions = np.asarray(real_actions_j)
 
-            step_data["actions"] = actions.reshape((1, cfg.env.num_envs, -1))
-            rb.add(step_data, validate_args=cfg.buffer.validate_args)
-
+            step_data["is_first"] = copy.deepcopy(
+                np.logical_or(step_data["terminated"], step_data["truncated"]).astype(np.float32)
+            )
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 real_actions.reshape(envs.action_space.shape)
             )
             dones = np.logical_or(terminated, truncated).astype(np.uint8)
-
-        step_data["is_first"] = np.zeros_like(step_data["terminated"])
-        if "restart_on_exception" in infos:
-            for i, agent_roe in enumerate(infos["restart_on_exception"]):
-                if agent_roe and not dones[i]:
-                    # Patch the broken episode's tail in the buffer: mark it
-                    # truncated, restart a fresh episode
-                    # (reference: dreamer_v3.py:595-608).
-                    last_inserted_idx = (rb.buffer[i]._pos - 1) % rb.buffer[i].buffer_size
-                    rb.buffer[i]["terminated"][last_inserted_idx] = np.zeros_like(
-                        rb.buffer[i]["terminated"][last_inserted_idx]
-                    )
-                    rb.buffer[i]["truncated"][last_inserted_idx] = np.ones_like(
-                        rb.buffer[i]["truncated"][last_inserted_idx]
-                    )
-                    rb.buffer[i]["is_first"][last_inserted_idx] = np.zeros_like(
-                        rb.buffer[i]["is_first"][last_inserted_idx]
-                    )
-                    step_data["is_first"][:, i] = np.ones_like(step_data["is_first"][:, i])
+            if cfg.dry_run and buffer_type == "episode":
+                dones = np.ones_like(dones)
 
         if cfg.metric.log_level > 0 and "final_info" in infos:
             fi = infos["final_info"]
@@ -603,31 +519,33 @@ def main(runtime, cfg: Dict[str, Any]):
                         real_next_obs[k][idx] = v
 
         for k in obs_keys:
-            step_data[k] = next_obs[k][np.newaxis]
+            step_data[k] = real_next_obs[k][np.newaxis]
         obs = next_obs
 
-        rewards = rewards.reshape((1, cfg.env.num_envs, -1))
         step_data["terminated"] = terminated.reshape((1, cfg.env.num_envs, -1)).astype(np.float32)
         step_data["truncated"] = truncated.reshape((1, cfg.env.num_envs, -1)).astype(np.float32)
-        step_data["rewards"] = clip_rewards_fn(rewards).astype(np.float32)
+        if cfg.dry_run and buffer_type == "episode":
+            step_data["terminated"] = np.ones_like(step_data["terminated"])
+            step_data["truncated"] = np.ones_like(step_data["truncated"])
+        step_data["actions"] = actions.reshape((1, cfg.env.num_envs, -1)).astype(np.float32)
+        step_data["rewards"] = clip_rewards_fn(rewards).reshape((1, cfg.env.num_envs, -1)).astype(np.float32)
+        rb.add(step_data, validate_args=cfg.buffer.validate_args)
 
         dones_idxes = dones.nonzero()[0].tolist()
         reset_envs = len(dones_idxes)
         if reset_envs > 0:
             reset_data = {}
             for k in obs_keys:
-                reset_data[k] = (real_next_obs[k][dones_idxes])[np.newaxis]
-            reset_data["terminated"] = step_data["terminated"][:, dones_idxes]
-            reset_data["truncated"] = step_data["truncated"][:, dones_idxes]
+                reset_data[k] = (next_obs[k][dones_idxes])[np.newaxis]
+            reset_data["terminated"] = np.zeros((1, reset_envs, 1), np.float32)
+            reset_data["truncated"] = np.zeros((1, reset_envs, 1), np.float32)
             reset_data["actions"] = np.zeros((1, reset_envs, int(np.sum(actions_dim))), np.float32)
-            reset_data["rewards"] = step_data["rewards"][:, dones_idxes]
-            reset_data["is_first"] = np.zeros_like(reset_data["terminated"])
+            reset_data["rewards"] = np.zeros((1, reset_envs, 1), np.float32)
+            reset_data["is_first"] = np.ones_like(reset_data["terminated"])
             rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
-
-            step_data["rewards"][:, dones_idxes] = np.zeros_like(reset_data["rewards"])
-            step_data["terminated"][:, dones_idxes] = np.zeros_like(step_data["terminated"][:, dones_idxes])
-            step_data["truncated"][:, dones_idxes] = np.zeros_like(step_data["truncated"][:, dones_idxes])
-            step_data["is_first"][:, dones_idxes] = np.ones_like(step_data["is_first"][:, dones_idxes])
+            for d in dones_idxes:
+                step_data["terminated"][0, d] = np.zeros_like(step_data["terminated"][0, d])
+                step_data["truncated"][0, d] = np.zeros_like(step_data["truncated"][0, d])
             reset_mask = np.zeros((cfg.env.num_envs,), np.float32)
             reset_mask[dones_idxes] = 1.0
             player_state = reset_player_fn(agent_state["world_model"], player_state, jnp.asarray(reset_mask))
@@ -650,26 +568,25 @@ def main(runtime, cfg: Dict[str, Any]):
                             % cfg.algo.critic.per_rank_target_network_update_freq
                             == 0
                         ):
-                            tau = 1.0 if cumulative_per_rank_gradient_steps == 0 else cfg.algo.critic.tau
-                        else:
-                            tau = 0.0
+                            # Hard copy critic → target critic (reference:
+                            # dreamer_v2.py:697-703), host-side.
+                            agent_state["target_critic"] = jax.tree_util.tree_map(
+                                jnp.copy, agent_state["critic"]
+                            )
                         batch = {
                             k: jnp.asarray(np.asarray(v[i]), jnp.float32) if k not in cfg.algo.cnn_keys.encoder
                             else jnp.asarray(np.asarray(v[i]))
                             for k, v in local_data.items()
                         }
                         train_key, sub = jax.random.split(train_key)
-                        agent_state, opt_states, moments_state, train_metrics = train_fn(
-                            agent_state, opt_states, moments_state, batch, sub, jnp.asarray(tau, jnp.float32)
+                        agent_state, opt_states, train_metrics = train_fn(
+                            agent_state, opt_states, batch, sub
                         )
                         per_step_metrics.append(train_metrics)
                         cumulative_per_rank_gradient_steps += 1
                     jax.block_until_ready(agent_state["world_model"])
                     train_step_count += world_size
 
-                # Feed EVERY gradient step's losses to the aggregator (the
-                # reference updates per step; only sampling the last one
-                # under-reports the training signal).
                 if aggregator and not aggregator.disabled:
                     for m in per_step_metrics:
                         for k, v in m.items():
@@ -721,7 +638,6 @@ def main(runtime, cfg: Dict[str, Any]):
                 "world_optimizer": opt_states["world_model"],
                 "actor_optimizer": opt_states["actor"],
                 "critic_optimizer": opt_states["critic"],
-                "moments": moments_state,
                 "ratio": ratio.state_dict(),
                 "iter_num": iter_num * world_size,
                 "batch_size": cfg.algo.per_rank_batch_size * world_size,

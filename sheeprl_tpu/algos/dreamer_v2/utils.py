@@ -1,0 +1,59 @@
+"""DreamerV2 auxiliary contract (reference: sheeprl/algos/dreamer_v2/utils.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# prepare_obs and the greedy test episode are identical to DreamerV3's (both
+# players expose the same functional player_step API).
+from sheeprl_tpu.algos.dreamer_v3.utils import prepare_obs, test  # noqa: F401 (re-export)
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "State/kl",
+    "Grads/world_model",
+    "Grads/actor",
+    "Grads/critic",
+}
+MODELS_TO_REGISTER = {"world_model", "actor", "critic", "target_critic"}
+
+
+def compute_lambda_values(
+    rewards: jax.Array,
+    values: jax.Array,
+    continues: jax.Array,
+    bootstrap: jax.Array = None,
+    lmbda: float = 0.95,
+) -> jax.Array:
+    """DV2-style TD(λ) over [H, ...] arrays with an explicit bootstrap
+    (reference reverse loop: dreamer_v2/utils.py:85-101):
+    L[t] = r[t] + c[t] * ((1 - λ) * V[t+1] + λ * L[t+1]), seeded with the
+    bootstrap value. One reverse `lax.scan`, fp32 accumulation.
+    """
+    if bootstrap is None:
+        bootstrap = jnp.zeros_like(values[-1:])
+    rewards = rewards.astype(jnp.float32)
+    values = values.astype(jnp.float32)
+    continues = continues.astype(jnp.float32)
+    bootstrap = bootstrap.astype(jnp.float32)
+    next_values = jnp.concatenate([values[1:], bootstrap], axis=0)
+    inputs = rewards + continues * next_values * (1 - lmbda)
+
+    def step(agg, x):
+        i, c = x
+        agg = i + c * lmbda * agg
+        return agg, agg
+
+    _, out = jax.lax.scan(step, bootstrap[0], (inputs, continues), reverse=True)
+    return out

@@ -476,6 +476,37 @@ class WorldModel(nn.Module):
         posterior_logits, posterior = self._representation(recurrent_state, embedded_obs, k2)
         return recurrent_state, posterior, prior, posterior_logits, prior_logits
 
+    def posterior_obs_only(
+        self, embedded_obs: jax.Array, key: jax.Array
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Decoupled-RSSM posterior: obs-only, so it vectorizes over the whole
+        [T, B] sequence as one batched matmul instead of T scan steps
+        (reference: DecoupledRSSM._representation, agent.py:583-593)."""
+        logits = self._uniform_mix(self.representation_model(embedded_obs))
+        post = compute_stochastic_state(logits, self.discrete_size, key)
+        return logits, post.reshape(*post.shape[:-2], -1)
+
+    def dynamic_decoupled(
+        self,
+        posterior: jax.Array,
+        recurrent_state: jax.Array,
+        action: jax.Array,
+        is_first: jax.Array,
+        key: jax.Array,
+    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """One decoupled dynamic step (reference: DecoupledRSSM.dynamic,
+        agent.py:542-581): the posterior arrives precomputed (obs-only), so
+        only the recurrent state and the prior are produced here."""
+        action = (1 - is_first) * action
+        h0, z0 = self.get_initial_states(recurrent_state.shape[:-1])
+        recurrent_state = (1 - is_first) * recurrent_state + is_first * h0
+        posterior = (1 - is_first) * posterior + is_first * z0
+        recurrent_state = self.recurrent_model(
+            jnp.concatenate([posterior, action], -1), recurrent_state
+        )
+        prior_logits, prior = self._transition(recurrent_state, key)
+        return recurrent_state, prior, prior_logits
+
     def imagination(
         self, prior: jax.Array, recurrent_state: jax.Array, actions: jax.Array, key: jax.Array
     ) -> Tuple[jax.Array, jax.Array]:

@@ -220,6 +220,14 @@ def main(runtime, cfg: Dict[str, Any]):
     if state is not None:
         cfg.algo.per_rank_batch_size = state["batch_size"] // world_size
 
+    rollout_size = int(cfg.algo.rollout_steps * cfg.env.num_envs)
+    if rollout_size % int(cfg.algo.per_rank_batch_size) != 0:
+        warnings.warn(
+            f"rollout size ({rollout_size}) is not divisible by per_rank_batch_size "
+            f"({cfg.algo.per_rank_batch_size}): static minibatch shapes require wrapping the "
+            "index permutation, so a few samples will be used twice per epoch."
+        )
+
     if cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_iter != 0:
         warnings.warn(
             f"The metric.log_every parameter ({cfg.metric.log_every}) is not a multiple of the "

@@ -150,6 +150,11 @@ class Runtime:
     def replicate(self, tree: Any) -> Any:
         return mesh_lib.replicate(tree, self.mesh)
 
+    def shard_params(self, tree: Any, min_dim: int = 1024) -> Any:
+        """Place params/opt-state on the mesh: wide leaves tensor-parallel over
+        the `model` axis (when model_axis > 1), the rest replicated."""
+        return mesh_lib.shard_wide_params(tree, self.mesh, min_dim=min_dim)
+
     def to_host(self, tree: Any) -> Any:
         return jax.tree_util.tree_map(np.asarray, tree)
 

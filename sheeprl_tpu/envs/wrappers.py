@@ -51,29 +51,29 @@ class MaskVelocityWrapper(gym.ObservationWrapper):
 
 
 class ActionRepeat(gym.Wrapper):
-    """Repeat an action `amount` times, summing rewards, stopping early on
-    termination (reference: sheeprl/envs/wrappers.py:48-71)."""
+    """Apply the same action for `amount` consecutive env steps, accumulating
+    the reward and returning the last transition; an episode end (terminated
+    or truncated) cuts the repeat short (same behavior as the reference
+    wrapper, sheeprl/envs/wrappers.py:48-71)."""
 
     def __init__(self, env: gym.Env, amount: int = 1):
         super().__init__(env)
         if amount <= 0:
-            raise ValueError("`amount` should be a positive integer")
-        self._amount = amount
+            raise ValueError(f"action repeat must be >= 1, got {amount}")
+        self._amount = int(amount)
 
     @property
     def action_repeat(self) -> int:
         return self._amount
 
     def step(self, action):
-        done = False
-        truncated = False
-        current_step = 0
-        total_reward = 0.0
-        while current_step < self._amount and not (done or truncated):
-            obs, reward, done, truncated, info = self.env.step(action)
-            total_reward += reward
-            current_step += 1
-        return obs, total_reward, done, truncated, info
+        accumulated = 0.0
+        for _ in range(self._amount):
+            obs, reward, terminated, truncated, info = self.env.step(action)
+            accumulated += reward
+            if terminated or truncated:
+                break
+        return obs, accumulated, terminated, truncated, info
 
 
 class RestartOnException(gym.Wrapper):
@@ -91,37 +91,44 @@ class RestartOnException(gym.Wrapper):
         maxfails: int = 2,
         wait: float = 20,
     ):
-        if not isinstance(exceptions, (tuple, list)):
-            exceptions = [exceptions]
+        exc = tuple(exceptions) if isinstance(exceptions, (tuple, list)) else (exceptions,)
         self._env_fn = env_fn
-        self._exceptions = tuple(exceptions)
-        self._window = window
-        self._maxfails = maxfails
-        self._wait = wait
-        self._last = time.time()
-        self._fails = 0
+        self._exceptions = exc
+        self._window = float(window)
+        self._maxfails = int(maxfails)
+        self._wait = float(wait)
+        self._window_start = time.monotonic()
+        self._fail_count = 0
         super().__init__(self._env_fn())
 
-    def _register_failure(self, where: str, e: Exception) -> None:
-        if time.time() > self._last + self._window:
-            self._last = time.time()
-            self._fails = 1
-        else:
-            self._fails += 1
-        if self._fails > self._maxfails:
-            raise RuntimeError(f"The env crashed too many times: {self._fails}")
-        gym.logger.warn(f"{where} - Restarting env after crash with {type(e).__name__}: {e}")
+    def _rebuild_env(self, phase: str, exc: Exception) -> None:
+        """Count the failure against the sliding window, give the sim `wait`
+        seconds to settle, then construct a fresh env instance."""
+        now = time.monotonic()
+        if now - self._window_start > self._window:
+            self._window_start = now
+            self._fail_count = 0
+        self._fail_count += 1
+        if self._fail_count > self._maxfails:
+            raise RuntimeError(
+                f"giving up on this env: {self._fail_count} failures within "
+                f"{self._window:.0f}s (limit {self._maxfails})"
+            ) from exc
+        gym.logger.warn(
+            f"env raised {type(exc).__name__} during {phase} ({exc}); "
+            f"rebuilding it in {self._wait:.0f}s"
+        )
         time.sleep(self._wait)
+        self.env = self._env_fn()
 
     def step(self, action) -> Tuple[Any, SupportsFloat, bool, bool, Dict[str, Any]]:
         try:
             return self.env.step(action)
         except self._exceptions as e:
-            self._register_failure("STEP", e)
-            self.env = self._env_fn()
-            new_obs, info = self.env.reset()
-            info.update({"restart_on_exception": True})
-            return new_obs, 0.0, False, False, info
+            self._rebuild_env("step", e)
+            obs, info = self.env.reset()
+            info["restart_on_exception"] = True
+            return obs, 0.0, False, False, info
 
     def reset(
         self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None
@@ -129,11 +136,10 @@ class RestartOnException(gym.Wrapper):
         try:
             return self.env.reset(seed=seed, options=options)
         except self._exceptions as e:
-            self._register_failure("RESET", e)
-            self.env = self._env_fn()
-            new_obs, info = self.env.reset(seed=seed, options=options)
-            info.update({"restart_on_exception": True})
-            return new_obs, info
+            self._rebuild_env("reset", e)
+            obs, info = self.env.reset(seed=seed, options=options)
+            info["restart_on_exception"] = True
+            return obs, info
 
 
 class FrameStack(gym.Wrapper):

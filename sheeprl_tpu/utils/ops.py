@@ -79,7 +79,12 @@ def gae(
     next_value, and adv[t] = delta[t] + gamma * lambda * not_done[t] *
     adv[t+1] — here as one reverse `lax.scan`. Returns (returns, advantages).
     """
-    not_dones = (1.0 - dones).astype(values.dtype)
+    # fp32 island: return/advantage accumulation is never done in bf16
+    # (parity with the reference keeping these ops in fp32; SURVEY §7.2).
+    rewards = rewards.astype(jnp.float32)
+    values = values.astype(jnp.float32)
+    next_value = next_value.astype(jnp.float32)
+    not_dones = (1.0 - dones).astype(jnp.float32)
     next_values = jnp.concatenate([values[1:], next_value[None]], axis=0)
     deltas = rewards + gamma * not_dones * next_values - values
 
@@ -104,6 +109,10 @@ def compute_lambda_values(
     Reference reverse loop: sheeprl/algos/dreamer_v3/utils.py:66-77 —
     L[t] = r[t] + c[t] * ((1 - λ) * V[t] + λ * L[t+1]), seeded L[T] = V[T-1].
     """
+    # fp32 island: TD(λ) accumulation stays out of bf16 whatever the policy.
+    rewards = rewards.astype(jnp.float32)
+    values = values.astype(jnp.float32)
+    continues = continues.astype(jnp.float32)
     interm = rewards + continues * values * (1 - lmbda)
 
     def step(nxt, x):

@@ -41,6 +41,155 @@ def _chdir_tmp(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
 
 
+def dv3_overrides(**extra):
+    """Tiny DreamerV3 dry-run config (mirrors the reference smoke-test sizes,
+    tests/test_algos/test_algos.py:453-480: seq_len=1, micro model)."""
+    args = [
+        "exp=dreamer_v3",
+        "env=dummy",
+        "dry_run=True",
+        "metric.log_level=0",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "env.screen_size=64",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.horizon=2",
+        "algo.per_rank_batch_size=2",
+        "algo.per_rank_sequence_length=1",
+        "algo.world_model.encoder.cnn_channels_multiplier=2",
+        "algo.world_model.recurrent_model.recurrent_state_size=8",
+        "algo.world_model.representation_model.hidden_size=8",
+        "algo.world_model.transition_model.hidden_size=8",
+        "algo.world_model.discrete_size=4",
+        "algo.world_model.stochastic_size=4",
+        "algo.learning_starts=0",
+        "algo.run_test=False",
+        "buffer.memmap=False",
+        "checkpoint.every=0",
+        "fabric.accelerator=cpu",
+    ]
+    for k, v in extra.items():
+        args.append(f"{k}={v}")
+    return args
+
+
+def find_checkpoints(root):
+    ckpts = []
+    for r, dirs, files in os.walk(root):
+        for d in dirs:
+            if d.startswith("ckpt_") and d.endswith(".ckpt"):
+                ckpts.append(os.path.join(r, d))
+    return sorted(ckpts)
+
+
+def dv2_overrides(**extra):
+    """Tiny DreamerV2 dry-run config (reference smoke-test sizes)."""
+    args = [
+        "exp=dreamer_v2",
+        "env=dummy",
+        "dry_run=True",
+        "metric.log_level=0",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.horizon=3",
+        "algo.per_rank_batch_size=2",
+        "algo.per_rank_sequence_length=2",
+        "algo.per_rank_pretrain_steps=1",
+        "algo.world_model.encoder.cnn_channels_multiplier=2",
+        "algo.world_model.recurrent_model.recurrent_state_size=8",
+        "algo.world_model.representation_model.hidden_size=8",
+        "algo.world_model.transition_model.hidden_size=8",
+        "algo.world_model.discrete_size=4",
+        "algo.world_model.stochastic_size=4",
+        "algo.learning_starts=0",
+        "algo.run_test=False",
+        "buffer.memmap=False",
+        "checkpoint.every=0",
+        "fabric.accelerator=cpu",
+    ]
+    for k, v in extra.items():
+        args.append(f"{k}={v}")
+    return args
+
+
+class TestDreamerV2:
+    @pytest.mark.parametrize("devices", [1, 2])
+    def test_dry_run_mlp(self, tmp_path, devices):
+        run(dv2_overrides(**{"fabric.devices": devices}))
+
+    def test_dry_run_pixel_and_mlp(self, tmp_path):
+        args = dv2_overrides()
+        args += [
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[state]",
+        ]
+        run(args)
+
+    def test_dry_run_continuous_with_continues(self, tmp_path):
+        run(
+            dv2_overrides(
+                **{
+                    "env.id": "continuous_dummy",
+                    "env.wrapper.id": "continuous_dummy",
+                    "algo.world_model.use_continues": True,
+                }
+            )
+        )
+
+    def test_dry_run_episode_buffer(self, tmp_path):
+        run(dv2_overrides(**{"buffer.type": "episode", "buffer.prioritize_ends": True}))
+
+    def test_checkpoint_eval_resume_roundtrip(self, tmp_path):
+        args = dv2_overrides(**{"checkpoint.save_last": True})
+        args = [a for a in args if not a.startswith("checkpoint.every")]
+        run(args)
+        ckpts = find_checkpoints(tmp_path / "logs")
+        assert ckpts, "no checkpoint written"
+        evaluation([f"checkpoint_path={ckpts[-1]}", "fabric.accelerator=cpu"])
+        resume_args = dv2_overrides()
+        resume_args.append(f"checkpoint.resume_from={ckpts[-1]}")
+        run(resume_args)
+
+
+class TestDreamerV3:
+    @pytest.mark.parametrize("devices", [1, 2])
+    def test_dry_run_mlp(self, tmp_path, devices):
+        run(dv3_overrides(**{"fabric.devices": devices}))
+
+    def test_dry_run_pixel_and_mlp(self, tmp_path):
+        args = dv3_overrides()
+        args += [
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[state]",
+        ]
+        run(args)
+
+    def test_dry_run_continuous(self, tmp_path):
+        run(dv3_overrides(**{"env.id": "continuous_dummy", "env.wrapper.id": "continuous_dummy"}))
+
+    def test_dry_run_decoupled_rssm(self, tmp_path):
+        run(dv3_overrides(**{"algo.world_model.decoupled_rssm": True}))
+
+    def test_dry_run_bf16(self, tmp_path):
+        run(dv3_overrides(**{"fabric.precision": "bf16-mixed"}))
+
+    def test_checkpoint_eval_resume_roundtrip(self, tmp_path):
+        args = dv3_overrides(**{"checkpoint.save_last": True})
+        args = [a for a in args if not a.startswith("checkpoint.every")]
+        run(args)
+        ckpts = find_checkpoints(tmp_path / "logs")
+        assert ckpts, "no checkpoint written"
+        evaluation([f"checkpoint_path={ckpts[-1]}", "fabric.accelerator=cpu"])
+        resume_args = dv3_overrides()
+        resume_args.append(f"checkpoint.resume_from={ckpts[-1]}")
+        run(resume_args)
+
+
 class TestPPO:
     @pytest.mark.parametrize("devices", [1, 2])
     def test_dry_run_mlp(self, tmp_path, devices):
@@ -61,6 +210,10 @@ class TestPPO:
         args = ppo_overrides(tmp_path, **{"env.id": "continuous_dummy", "fabric.accelerator": "cpu"})
         args.append("env.wrapper.id=continuous_dummy")
         run(args)
+
+    @pytest.mark.parametrize("precision", ["bf16-mixed", "bf16-true"])
+    def test_dry_run_bf16(self, tmp_path, precision):
+        run(ppo_overrides(tmp_path, **{"fabric.accelerator": "cpu", "fabric.precision": precision}))
 
     def test_dry_run_multidiscrete(self, tmp_path):
         args = ppo_overrides(tmp_path, **{"env.id": "multidiscrete_dummy", "fabric.accelerator": "cpu"})
@@ -98,7 +251,8 @@ class TestPPO:
 
 
 class TestA2C:
-    def test_a2c_dry_run(self, tmp_path):
+    @pytest.mark.parametrize("devices", [1, 2])
+    def test_a2c_dry_run(self, tmp_path, devices):
         run([
             "exp=a2c",
             "env=dummy",
@@ -115,6 +269,7 @@ class TestA2C:
             "buffer.memmap=False",
             "checkpoint.every=0",
             "fabric.accelerator=cpu",
+            f"fabric.devices={devices}",
         ])
 
 class TestSAC:

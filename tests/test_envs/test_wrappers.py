@@ -191,5 +191,5 @@ class TestRestartOnException:
         env = RestartOnException(lambda: AlwaysBroken(), window=300, maxfails=1, wait=0)
         env.reset()
         env.step(0)  # first failure triggers restart
-        with pytest.raises(RuntimeError, match="crashed too many times"):
+        with pytest.raises(RuntimeError, match="giving up on this env"):
             env.step(0)
