@@ -41,40 +41,6 @@ def _chdir_tmp(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
 
 
-def dv3_overrides(**extra):
-    """Tiny DreamerV3 dry-run config (mirrors the reference smoke-test sizes,
-    tests/test_algos/test_algos.py:453-480: seq_len=1, micro model)."""
-    args = [
-        "exp=dreamer_v3",
-        "env=dummy",
-        "dry_run=True",
-        "metric.log_level=0",
-        "env.num_envs=2",
-        "env.sync_env=True",
-        "env.capture_video=False",
-        "env.screen_size=64",
-        "algo.dense_units=8",
-        "algo.mlp_layers=1",
-        "algo.horizon=2",
-        "algo.per_rank_batch_size=2",
-        "algo.per_rank_sequence_length=1",
-        "algo.world_model.encoder.cnn_channels_multiplier=2",
-        "algo.world_model.recurrent_model.recurrent_state_size=8",
-        "algo.world_model.representation_model.hidden_size=8",
-        "algo.world_model.transition_model.hidden_size=8",
-        "algo.world_model.discrete_size=4",
-        "algo.world_model.stochastic_size=4",
-        "algo.learning_starts=0",
-        "algo.run_test=False",
-        "buffer.memmap=False",
-        "checkpoint.every=0",
-        "fabric.accelerator=cpu",
-    ]
-    for k, v in extra.items():
-        args.append(f"{k}={v}")
-    return args
-
-
 def find_checkpoints(root):
     ckpts = []
     for r, dirs, files in os.walk(root):
@@ -84,10 +50,12 @@ def find_checkpoints(root):
     return sorted(ckpts)
 
 
-def dv2_overrides(**extra):
-    """Tiny DreamerV2 dry-run config (reference smoke-test sizes)."""
+def dreamer_overrides(exp, **extra):
+    """Tiny Dreamer dry-run config shared by the V1/V2/V3 smoke tests
+    (mirrors the reference smoke-test sizes, tests/test_algos/test_algos.py:
+    453-480: micro model, 1-2 step sequences)."""
     args = [
-        "exp=dreamer_v2",
+        f"exp={exp}",
         "env=dummy",
         "dry_run=True",
         "metric.log_level=0",
@@ -96,15 +64,11 @@ def dv2_overrides(**extra):
         "env.capture_video=False",
         "algo.dense_units=8",
         "algo.mlp_layers=1",
-        "algo.horizon=3",
         "algo.per_rank_batch_size=2",
-        "algo.per_rank_sequence_length=2",
-        "algo.per_rank_pretrain_steps=1",
         "algo.world_model.encoder.cnn_channels_multiplier=2",
         "algo.world_model.recurrent_model.recurrent_state_size=8",
         "algo.world_model.representation_model.hidden_size=8",
         "algo.world_model.transition_model.hidden_size=8",
-        "algo.world_model.discrete_size=4",
         "algo.world_model.stochastic_size=4",
         "algo.learning_starts=0",
         "algo.run_test=False",
@@ -112,9 +76,77 @@ def dv2_overrides(**extra):
         "checkpoint.every=0",
         "fabric.accelerator=cpu",
     ]
+    args += {
+        "dreamer_v1": ["algo.horizon=3", "algo.per_rank_sequence_length=2"],
+        "dreamer_v2": [
+            "algo.horizon=3",
+            "algo.per_rank_sequence_length=2",
+            "algo.per_rank_pretrain_steps=1",
+            "algo.world_model.discrete_size=4",
+        ],
+        "dreamer_v3": [
+            "env.screen_size=64",
+            "algo.horizon=2",
+            "algo.per_rank_sequence_length=1",
+            "algo.world_model.discrete_size=4",
+        ],
+    }[exp]
     for k, v in extra.items():
         args.append(f"{k}={v}")
     return args
+
+
+def dv1_overrides(**extra):
+    return dreamer_overrides("dreamer_v1", **extra)
+
+
+def dv2_overrides(**extra):
+    return dreamer_overrides("dreamer_v2", **extra)
+
+
+def dv3_overrides(**extra):
+    return dreamer_overrides("dreamer_v3", **extra)
+
+
+def checkpoint_eval_resume_roundtrip(overrides_fn, tmp_path):
+    """Shared train -> checkpoint -> evaluate -> resume flow."""
+    args = overrides_fn(**{"checkpoint.save_last": True})
+    args = [a for a in args if not a.startswith("checkpoint.every")]
+    run(args)
+    ckpts = find_checkpoints(tmp_path / "logs")
+    assert ckpts, "no checkpoint written"
+    evaluation([f"checkpoint_path={ckpts[-1]}", "fabric.accelerator=cpu"])
+    resume_args = overrides_fn()
+    resume_args.append(f"checkpoint.resume_from={ckpts[-1]}")
+    run(resume_args)
+
+
+class TestDreamerV1:
+    @pytest.mark.parametrize("devices", [1, 2])
+    def test_dry_run_mlp(self, tmp_path, devices):
+        run(dv1_overrides(**{"fabric.devices": devices}))
+
+    def test_dry_run_pixel_and_mlp(self, tmp_path):
+        args = dv1_overrides()
+        args += [
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[state]",
+        ]
+        run(args)
+
+    def test_dry_run_continuous_with_continues(self, tmp_path):
+        run(
+            dv1_overrides(
+                **{
+                    "env.id": "continuous_dummy",
+                    "env.wrapper.id": "continuous_dummy",
+                    "algo.world_model.use_continues": True,
+                }
+            )
+        )
+
+    def test_checkpoint_eval_resume_roundtrip(self, tmp_path):
+        checkpoint_eval_resume_roundtrip(dv1_overrides, tmp_path)
 
 
 class TestDreamerV2:
@@ -145,15 +177,7 @@ class TestDreamerV2:
         run(dv2_overrides(**{"buffer.type": "episode", "buffer.prioritize_ends": True}))
 
     def test_checkpoint_eval_resume_roundtrip(self, tmp_path):
-        args = dv2_overrides(**{"checkpoint.save_last": True})
-        args = [a for a in args if not a.startswith("checkpoint.every")]
-        run(args)
-        ckpts = find_checkpoints(tmp_path / "logs")
-        assert ckpts, "no checkpoint written"
-        evaluation([f"checkpoint_path={ckpts[-1]}", "fabric.accelerator=cpu"])
-        resume_args = dv2_overrides()
-        resume_args.append(f"checkpoint.resume_from={ckpts[-1]}")
-        run(resume_args)
+        checkpoint_eval_resume_roundtrip(dv2_overrides, tmp_path)
 
 
 class TestDreamerV3:
@@ -179,15 +203,7 @@ class TestDreamerV3:
         run(dv3_overrides(**{"fabric.precision": "bf16-mixed"}))
 
     def test_checkpoint_eval_resume_roundtrip(self, tmp_path):
-        args = dv3_overrides(**{"checkpoint.save_last": True})
-        args = [a for a in args if not a.startswith("checkpoint.every")]
-        run(args)
-        ckpts = find_checkpoints(tmp_path / "logs")
-        assert ckpts, "no checkpoint written"
-        evaluation([f"checkpoint_path={ckpts[-1]}", "fabric.accelerator=cpu"])
-        resume_args = dv3_overrides()
-        resume_args.append(f"checkpoint.resume_from={ckpts[-1]}")
-        run(resume_args)
+        checkpoint_eval_resume_roundtrip(dv3_overrides, tmp_path)
 
 
 class TestPPO:
