@@ -13,7 +13,7 @@ from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 
 
-@register_evaluation(algorithms="ppo")
+@register_evaluation(algorithms=["ppo", "ppo_decoupled"])
 def evaluate_ppo(runtime, cfg: Dict[str, Any], state: Dict[str, Any]):
     logger = get_logger(runtime, cfg)
     if logger is not None:

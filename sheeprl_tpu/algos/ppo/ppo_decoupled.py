@@ -1,0 +1,373 @@
+"""PPO, decoupled player/trainer loop (reference: sheeprl/algos/ppo/ppo_decoupled.py:33-670).
+
+TPU-native redesign on the same plan as `sac_decoupled`: the reference's
+rank-0 player + DDP trainer group, `scatter_object_list` batch shipping, and
+flat-parameter broadcast become a device partition inside one controller
+process — device 0 plays (policy inference, GAE bootstrap), devices 1..N-1
+form the trainer mesh that runs the epochs x minibatches update scan.
+
+Unlike off-policy SAC, PPO is inherently lockstep: the next rollout must use
+the just-updated policy, so the player's first inference of iteration k+1
+waits on the weight copy enqueued after iteration k's update — exactly the
+synchronization the reference implements with a blocking broadcast, here a
+device-to-device copy XLA overlaps with the host's env bookkeeping.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sheeprl_tpu.algos.ppo.agent import actions_metadata, build_agent
+from sheeprl_tpu.algos.ppo.ppo import _current_lr, make_train_step
+from sheeprl_tpu.algos.ppo.utils import prepare_obs, test
+from sheeprl_tpu.config.instantiate import instantiate
+from sheeprl_tpu.core import mesh as mesh_lib
+from sheeprl_tpu.core.mesh import DATA_AXIS, split_player_trainer
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.registry import register_algorithm
+from sheeprl_tpu.utils.checkpoint import load_checkpoint, restore_opt_state, save_checkpoint
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.ops import gae
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
+
+
+@register_algorithm(decoupled=True)
+def main(runtime, cfg: Dict[str, Any]):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    player_device, trainer_mesh = split_player_trainer(runtime.mesh)
+    n_trainers = int(trainer_mesh.shape[DATA_AXIS])
+    rank = runtime.global_rank
+
+    initial_ent_coef = float(cfg.algo.ent_coef)
+    initial_clip_coef = float(cfg.algo.clip_coef)
+
+    state = None
+    if cfg.checkpoint.resume_from:
+        state = load_checkpoint(cfg.checkpoint.resume_from)
+
+    logger = get_logger(runtime, cfg)
+    if logger is not None:
+        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
+    runtime.print(f"Log dir: {log_dir}")
+    runtime.print(f"Decoupled PPO: player on {player_device}, {n_trainers} trainer device(s)")
+
+    # ----------------------------------------------------------------- envs
+    vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
+    envs = vectorized_env(
+        [
+            make_env(cfg, cfg.seed + i, 0, log_dir, "train", vector_env_idx=i)
+            for i in range(cfg.env.num_envs)
+        ],
+        autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
+    )
+    observation_space = envs.single_observation_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    if cfg.algo.cnn_keys.encoder + cfg.algo.mlp_keys.encoder == []:
+        raise RuntimeError(
+            "You should specify at least one CNN keys or MLP keys from the cli: "
+            "`algo.cnn_keys.encoder=[rgb]` or `algo.mlp_keys.encoder=[state]`"
+        )
+    if cfg.metric.log_level > 0:
+        runtime.print("Encoder CNN keys:", cfg.algo.cnn_keys.encoder)
+        runtime.print("Encoder MLP keys:", cfg.algo.mlp_keys.encoder)
+    obs_keys = cfg.algo.cnn_keys.encoder + cfg.algo.mlp_keys.encoder
+    cnn_keys = cfg.algo.cnn_keys.encoder
+
+    actions_dim, is_continuous = actions_metadata(envs.single_action_space)
+    clip_rewards_fn = (lambda r: np.tanh(r)) if cfg.env.clip_rewards else (lambda r: r)
+
+    # ---------------------------------------------------------------- agent
+    agent, params = build_agent(
+        runtime, actions_dim, is_continuous, cfg, observation_space,
+        state["agent"] if state is not None else None,
+    )
+
+    optim_cfg = dict(cfg.algo.optimizer)
+    optim_target = optim_cfg.pop("_target_")
+    base_lr = float(optim_cfg.pop("lr"))
+
+    def make_tx(lr):
+        from sheeprl_tpu.config.instantiate import locate
+
+        inner = locate(optim_target)(lr=lr, **optim_cfg)
+        if cfg.algo.max_grad_norm > 0.0:
+            return optax.chain(optax.clip_by_global_norm(cfg.algo.max_grad_norm), inner)
+        return inner
+
+    tx = optax.inject_hyperparams(make_tx)(lr=base_lr)
+    opt_state = tx.init(params)
+    if state is not None:
+        opt_state = restore_opt_state(opt_state, state["optimizer"])
+
+    # Trainer copy on the trainer mesh, player copy on the player device
+    # (the reference's "first weights" broadcast, ppo_decoupled.py:124-127).
+    params = mesh_lib.replicate(params, trainer_mesh)
+    opt_state = mesh_lib.replicate(opt_state, trainer_mesh)
+    params_player = jax.device_put(params, player_device)
+
+    if runtime.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
+
+    # --------------------------------------------------------------- buffer
+    if cfg.buffer.size < cfg.algo.rollout_steps:
+        raise ValueError(
+            f"The size of the buffer ({cfg.buffer.size}) cannot be lower "
+            f"than the rollout steps ({cfg.algo.rollout_steps})"
+        )
+    rb = ReplayBuffer(
+        cfg.buffer.size,
+        cfg.env.num_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+        obs_keys=obs_keys,
+    )
+
+    # ------------------------------------------------------------- counters
+    last_train = 0
+    train_step_count = 0
+    start_iter = state["iter_num"] + 1 if state is not None else 1
+    policy_step = state["iter_num"] * cfg.env.num_envs * cfg.algo.rollout_steps if state is not None else 0
+    last_log = state["last_log"] if state is not None else 0
+    last_checkpoint = state["last_checkpoint"] if state is not None else 0
+    policy_steps_per_iter = int(cfg.env.num_envs * cfg.algo.rollout_steps)
+    total_iters = cfg.algo.total_steps // policy_steps_per_iter if not cfg.dry_run else 1
+    if state is not None:
+        cfg.algo.per_rank_batch_size = state["batch_size"]
+
+    rollout_size = int(cfg.algo.rollout_steps * cfg.env.num_envs)
+    if rollout_size % int(cfg.algo.per_rank_batch_size) != 0:
+        warnings.warn(
+            f"rollout size ({rollout_size}) is not divisible by per_rank_batch_size "
+            f"({cfg.algo.per_rank_batch_size}): static minibatch shapes require wrapping the "
+            "index permutation, so a few samples will be used twice per epoch."
+        )
+    if rollout_size % n_trainers != 0:
+        # Sharded device_put needs the batch dim evenly split over the trainer
+        # mesh; fail upfront instead of after the first rollout.
+        raise RuntimeError(
+            f"The rollout size (rollout_steps*num_envs = {rollout_size}) must be divisible "
+            f"by the number of trainer devices ({n_trainers}) so the batch can be sharded "
+            "over the trainer mesh. Adjust env.num_envs / algo.rollout_steps / fabric.devices."
+        )
+
+    if cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"The metric.log_every parameter ({cfg.metric.log_every}) is not a multiple of the "
+            f"policy_steps_per_iter value ({policy_steps_per_iter}), so "
+            "the metrics will be logged at the nearest greater multiple of the policy_steps_per_iter value."
+        )
+    if cfg.checkpoint.every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"The checkpoint.every parameter ({cfg.checkpoint.every}) is not a multiple of the "
+            f"policy_steps_per_iter value ({policy_steps_per_iter}), so "
+            "the checkpoint will be saved at the nearest greater multiple of the policy_steps_per_iter value."
+        )
+
+    # ---------------------------------------------------------- jitted fns
+    player_step_fn = jax.jit(agent.player_step)
+    get_values_fn = jax.jit(agent.get_values)
+    gae_fn = jax.jit(
+        lambda rewards, values, dones, next_values: gae(
+            rewards, values, dones, next_values, cfg.algo.gamma, cfg.algo.gae_lambda
+        )
+    )
+    train_fn = make_train_step(agent, tx, cfg, trainer_mesh)
+    batch_sharding = NamedSharding(trainer_mesh, P(DATA_AXIS))
+
+    rollout_key, train_key = jax.random.split(jax.random.fold_in(runtime.root_key, rank))
+
+    # --------------------------------------------------------------- loop
+    step_data = {}
+    next_obs = envs.reset(seed=cfg.seed)[0]
+    for k in obs_keys:
+        step_data[k] = next_obs[k][np.newaxis]
+
+    for iter_num in range(start_iter, total_iters + 1):
+        for _ in range(0, cfg.algo.rollout_steps):
+            policy_step += cfg.env.num_envs
+
+            with timer("Time/env_interaction_time"):
+                jnp_obs = jax.device_put(
+                    prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs), player_device
+                )
+                rollout_key, sub = jax.random.split(rollout_key)
+                actions, real_actions, logprobs, values = player_step_fn(params_player, jnp_obs, sub)
+                real_actions_np = np.asarray(real_actions)
+
+                obs, rewards, terminated, truncated, info = envs.step(
+                    real_actions_np.reshape(envs.action_space.shape)
+                )
+                truncated_envs = np.nonzero(truncated)[0]
+                if len(truncated_envs) > 0:
+                    final_obs = info["final_obs"]
+                    real_next_obs = {
+                        k: np.stack([np.asarray(final_obs[e][k], np.float32) for e in truncated_envs])
+                        for k in obs_keys
+                    }
+                    jnp_next = jax.device_put(
+                        prepare_obs(real_next_obs, cnn_keys=cnn_keys, num_envs=len(truncated_envs)),
+                        player_device,
+                    )
+                    vals = np.asarray(get_values_fn(params_player, jnp_next))
+                    rewards[truncated_envs] += cfg.algo.gamma * vals.reshape(rewards[truncated_envs].shape)
+                dones = np.logical_or(terminated, truncated).reshape(cfg.env.num_envs, -1).astype(np.uint8)
+                rewards = clip_rewards_fn(rewards).reshape(cfg.env.num_envs, -1).astype(np.float32)
+
+            step_data["dones"] = dones[np.newaxis]
+            step_data["values"] = np.asarray(values)[np.newaxis]
+            step_data["actions"] = np.asarray(actions)[np.newaxis]
+            step_data["logprobs"] = np.asarray(logprobs)[np.newaxis]
+            step_data["rewards"] = rewards[np.newaxis]
+            if cfg.buffer.memmap:
+                step_data["returns"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
+                step_data["advantages"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
+
+            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+            next_obs = {}
+            for k in obs_keys:
+                step_data[k] = obs[k][np.newaxis]
+                next_obs[k] = obs[k]
+
+            if cfg.metric.log_level > 0 and "final_info" in info:
+                fi = info["final_info"]
+                for i in np.nonzero(fi.get("_episode", []))[0]:
+                    ep_rew = float(fi["episode"]["r"][i])
+                    ep_len = float(fi["episode"]["l"][i])
+                    if aggregator and "Rewards/rew_avg" in aggregator:
+                        aggregator.update("Rewards/rew_avg", ep_rew)
+                    if aggregator and "Game/ep_len_avg" in aggregator:
+                        aggregator.update("Game/ep_len_avg", ep_len)
+                    runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+
+        # --------------------------------------- GAE (player device) + ship
+        local_data = rb.to_tensor()
+        jnp_obs = jax.device_put(
+            prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs), player_device
+        )
+        next_values = get_values_fn(params_player, jnp_obs)
+        returns, advantages = gae_fn(
+            jax.device_put(np.asarray(local_data["rewards"], np.float32), player_device),
+            jax.device_put(np.asarray(local_data["values"], np.float32), player_device),
+            jax.device_put(np.asarray(local_data["dones"], np.float32), player_device),
+            next_values,
+        )
+        local_data["returns"] = np.asarray(returns)
+        local_data["advantages"] = np.asarray(advantages)
+
+        # The scatter: flatten [T, N_envs] -> [T*N_envs] and place directly
+        # sharded over the trainer mesh (the reference permutes + splits +
+        # scatter_object_list, ppo_decoupled.py:295-300; the in-jit epoch
+        # permutation already randomizes minibatch membership).
+        flat = {
+            k: jax.device_put(
+                np.asarray(v).reshape(-1, *np.asarray(v).shape[2:]), batch_sharding
+            )
+            for k, v in local_data.items()
+        }
+
+        with timer("Time/train_time"):
+            train_key, sub = jax.random.split(train_key)
+            params, opt_state, train_metrics = train_fn(
+                params,
+                opt_state,
+                flat,
+                sub,
+                jnp.asarray(cfg.algo.clip_coef, jnp.float32),
+                jnp.asarray(cfg.algo.ent_coef, jnp.float32),
+            )
+            # The broadcast back: the player's next rollout waits on this copy.
+            params_player = jax.device_put(params, player_device)
+            # PPO is lockstep anyway (the next rollout needs these weights), so
+            # block here to keep Time/train_time meaningful.
+            jax.block_until_ready(params_player)
+        train_step_count += n_trainers
+
+        if aggregator and not aggregator.disabled:
+            aggregator.update("Loss/policy_loss", np.asarray(train_metrics["policy_loss"]))
+            aggregator.update("Loss/value_loss", np.asarray(train_metrics["value_loss"]))
+            aggregator.update("Loss/entropy_loss", np.asarray(train_metrics["entropy_loss"]))
+
+        # ------------------------------------------------------- logging
+        if cfg.metric.log_level > 0 and logger is not None:
+            logger.log("Info/learning_rate", _current_lr(opt_state, base_lr), policy_step)
+            logger.log("Info/clip_coef", cfg.algo.clip_coef, policy_step)
+            logger.log("Info/ent_coef", cfg.algo.ent_coef, policy_step)
+
+            if policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters:
+                if aggregator and not aggregator.disabled:
+                    logger.log_dict(aggregator.compute(), policy_step)
+                    aggregator.reset()
+                if not timer.disabled:
+                    timer_metrics = timer.compute()
+                    if timer_metrics.get("Time/train_time", 0) > 0:
+                        logger.log(
+                            "Time/sps_train",
+                            (train_step_count - last_train) / timer_metrics["Time/train_time"],
+                            policy_step,
+                        )
+                    if timer_metrics.get("Time/env_interaction_time", 0) > 0:
+                        logger.log(
+                            "Time/sps_env_interaction",
+                            ((policy_step - last_log) * cfg.env.action_repeat)
+                            / timer_metrics["Time/env_interaction_time"],
+                            policy_step,
+                        )
+                    timer.reset()
+                last_log = policy_step
+                last_train = train_step_count
+
+        # ----------------------------------------------------- annealing
+        if cfg.algo.anneal_lr:
+            new_lr = polynomial_decay(iter_num, initial=base_lr, final=0.0, max_decay_steps=total_iters, power=1.0)
+            opt_state.hyperparams["lr"] = jnp.asarray(new_lr, jnp.float32)
+        if cfg.algo.anneal_clip_coef:
+            cfg.algo.clip_coef = polynomial_decay(
+                iter_num, initial=initial_clip_coef, final=0.0, max_decay_steps=total_iters, power=1.0
+            )
+        if cfg.algo.anneal_ent_coef:
+            cfg.algo.ent_coef = polynomial_decay(
+                iter_num, initial=initial_ent_coef, final=0.0, max_decay_steps=total_iters, power=1.0
+            )
+
+        # ---------------------------------------------------- checkpoint
+        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+            iter_num == total_iters and cfg.checkpoint.save_last
+        ):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "agent": params,
+                "optimizer": opt_state,
+                "iter_num": iter_num,
+                "batch_size": cfg.algo.per_rank_batch_size,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{rank}.ckpt")
+            if runtime.is_global_zero:
+                save_checkpoint(ckpt_path, ckpt_state, keep_last=cfg.checkpoint.keep_last)
+
+    envs.close()
+    if runtime.is_global_zero and cfg.algo.run_test:
+        test(agent, params_player, runtime, cfg, log_dir, logger)
+
+    if logger is not None:
+        logger.close()

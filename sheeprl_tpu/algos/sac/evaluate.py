@@ -13,7 +13,7 @@ from sheeprl_tpu.utils.env import make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 
 
-@register_evaluation(algorithms="sac")
+@register_evaluation(algorithms=["sac", "sac_decoupled"])
 def evaluate_sac(runtime, cfg: Dict[str, Any], state: Dict[str, Any]):
     logger = get_logger(runtime, cfg)
     if logger is not None:

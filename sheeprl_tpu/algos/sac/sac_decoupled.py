@@ -1,0 +1,367 @@
+"""SAC, decoupled player/trainer loop (reference: sheeprl/algos/sac/sac_decoupled.py:33-588).
+
+TPU-native redesign, not a port. The reference splits player and trainers
+across *processes*: rank 0 steps the envs and owns the replay buffer, ranks
+1..N-1 form a DDP optimization group; `scatter_object_list` ships sampled
+batches player->trainers and a flat-parameter broadcast ships actor weights
+trainers->player every iteration.
+
+Here both partitions live in ONE controller process over a partitioned device
+set: device 0 is the *player device*, devices 1..N-1 form the *trainer mesh*.
+The object-list collectives become device-to-device transfers:
+
+- batches: host sample -> `device_put` sharded over the trainer mesh's data
+  axis (the scatter),
+- weights: `device_put(actor_params, player_device)` after each train call
+  (the broadcast).
+
+Dispatch is async: the controller enqueues the G-step train scan on the
+trainer devices and immediately enqueues the actor-weight copy; the player's
+next inference waits only on that copy, and host env stepping overlaps trainer
+compute. The pipelining the reference builds out of processes and blocking
+collectives falls out of XLA's asynchronous dispatch.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import warnings
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from sheeprl_tpu.algos.sac.agent import build_agent
+from sheeprl_tpu.algos.sac.sac import _make_optimizer, make_train_step
+from sheeprl_tpu.algos.sac.utils import prepare_obs, test
+from sheeprl_tpu.config.instantiate import instantiate
+from sheeprl_tpu.core import mesh as mesh_lib
+from sheeprl_tpu.core.mesh import DATA_AXIS, split_player_trainer
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.registry import register_algorithm
+from sheeprl_tpu.utils.checkpoint import load_checkpoint, restore_opt_state, save_checkpoint
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import Ratio, save_configs
+
+
+@register_algorithm(decoupled=True)
+def main(runtime, cfg: Dict[str, Any]):
+    player_device, trainer_mesh = split_player_trainer(runtime.mesh)
+    n_trainers = int(trainer_mesh.shape[DATA_AXIS])
+    rank = runtime.global_rank
+
+    state_ckpt = None
+    if cfg.checkpoint.resume_from:
+        state_ckpt = load_checkpoint(cfg.checkpoint.resume_from)
+
+    if len(cfg.algo.cnn_keys.encoder) > 0:
+        warnings.warn("SAC algorithm cannot allow to use images as observations, the CNN keys will be ignored")
+        cfg.algo.cnn_keys.encoder = []
+
+    logger = get_logger(runtime, cfg)
+    if logger is not None:
+        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
+    runtime.print(f"Log dir: {log_dir}")
+    runtime.print(f"Decoupled SAC: player on {player_device}, {n_trainers} trainer device(s)")
+
+    # ------------------------------------------------------------ environment
+    vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
+    envs = vectorized_env(
+        [
+            make_env(
+                cfg,
+                cfg.seed + rank * cfg.env.num_envs + i,
+                rank * cfg.env.num_envs,
+                log_dir if rank == 0 else None,
+                "train",
+                vector_env_idx=i,
+            )
+            for i in range(cfg.env.num_envs)
+        ],
+        autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
+    )
+    action_space = envs.single_action_space
+    observation_space = envs.single_observation_space
+    if not isinstance(action_space, gym.spaces.Box):
+        raise ValueError("Only continuous action space is supported for the SAC agent")
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    if len(cfg.algo.mlp_keys.encoder) == 0:
+        raise RuntimeError("You should specify at least one MLP key for the encoder: `mlp_keys.encoder=[state]`")
+    for k in cfg.algo.mlp_keys.encoder:
+        if len(observation_space[k].shape) > 1:
+            raise ValueError(
+                "Only environments with vector-only observations are supported by the SAC agent. "
+                f"The observation with key '{k}' has shape {observation_space[k].shape}. "
+                f"Provided environment: {cfg.env.id}"
+            )
+    if cfg.metric.log_level > 0:
+        runtime.print("Encoder MLP keys:", cfg.algo.mlp_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+
+    # ------------------------------------------------------- agent + optimizers
+    agent, agent_state = build_agent(
+        runtime, cfg, observation_space, action_space,
+        state_ckpt["agent"] if state_ckpt is not None else None,
+    )
+
+    txs = {
+        "qf": _make_optimizer(cfg.algo.critic.optimizer),
+        "actor": _make_optimizer(cfg.algo.actor.optimizer),
+        "alpha": _make_optimizer(cfg.algo.alpha.optimizer),
+    }
+    opt_states = {
+        "qf": txs["qf"].init(agent_state["qfs"]),
+        "actor": txs["actor"].init(agent_state["actor"]),
+        "alpha": txs["alpha"].init(agent_state["log_alpha"]),
+    }
+    if state_ckpt is not None:
+        for name, ckpt_key in (("qf", "qf_optimizer"), ("actor", "actor_optimizer"), ("alpha", "alpha_optimizer")):
+            opt_states[name] = restore_opt_state(opt_states[name], state_ckpt[ckpt_key])
+
+    # Trainer state lives replicated on the trainer mesh; the player keeps its
+    # own committed copy of the actor params on the player device (the
+    # "first weights" broadcast of the reference, sac_decoupled.py:227-230).
+    agent_state = mesh_lib.replicate(agent_state, trainer_mesh)
+    opt_states = mesh_lib.replicate(opt_states, trainer_mesh)
+    actor_player = jax.device_put(agent_state["actor"], player_device)
+
+    if runtime.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
+
+    # ------------------------------------------------------------ replay buffer
+    buffer_size = cfg.buffer.size // int(cfg.env.num_envs) if not cfg.dry_run else 1
+    rb = ReplayBuffer(
+        buffer_size,
+        cfg.env.num_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+    )
+    if state_ckpt is not None and cfg.buffer.checkpoint and state_ckpt.get("rb") is not None:
+        rb = state_ckpt["rb"]
+
+    # ------------------------------------------------------------ counters
+    last_train = 0
+    train_step_count = 0
+    start_iter = state_ckpt["iter_num"] + 1 if state_ckpt is not None else 1
+    policy_step = state_ckpt["iter_num"] * cfg.env.num_envs if state_ckpt is not None else 0
+    last_log = state_ckpt["last_log"] if state_ckpt is not None else 0
+    last_checkpoint = state_ckpt["last_checkpoint"] if state_ckpt is not None else 0
+    policy_steps_per_iter = int(cfg.env.num_envs)
+    total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
+    prefill_steps = learning_starts - int(learning_starts > 0)
+    if state_ckpt is not None:
+        cfg.algo.per_rank_batch_size = state_ckpt["batch_size"] // n_trainers
+        if not cfg.buffer.checkpoint:
+            learning_starts += start_iter
+            prefill_steps += start_iter
+
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    if state_ckpt is not None:
+        ratio.load_state_dict(state_ckpt["ratio"])
+
+    if cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"The metric.log_every parameter ({cfg.metric.log_every}) is not a multiple of the "
+            f"policy_steps_per_iter value ({policy_steps_per_iter}), so "
+            "the metrics will be logged at the nearest greater multiple of the policy_steps_per_iter value."
+        )
+    if cfg.checkpoint.every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"The checkpoint.every parameter ({cfg.checkpoint.every}) is not a multiple of the "
+            f"policy_steps_per_iter value ({policy_steps_per_iter}), so "
+            "the checkpoint will be saved at the nearest greater multiple of the policy_steps_per_iter value."
+        )
+
+    # The same jitted G-step scan as coupled SAC, compiled over the trainer
+    # mesh only (its `data` axis is the trainer partition).
+    train_fn = make_train_step(agent, txs, cfg, trainer_mesh)
+    player_fn = jax.jit(lambda p, o, k: agent.get_actions(p, o, k, greedy=False))
+    batch_sharding = NamedSharding(trainer_mesh, P(None, DATA_AXIS))
+    target_freq_iters = cfg.algo.critic.target_network_frequency // policy_steps_per_iter + 1
+
+    rollout_key, train_key = jax.random.split(jax.random.fold_in(runtime.root_key, rank))
+
+    step_data = {}
+    obs = envs.reset(seed=cfg.seed)[0]
+
+    cumulative_per_rank_gradient_steps = 0
+    for iter_num in range(start_iter, total_iters + 1):
+        policy_step += policy_steps_per_iter
+
+        with timer("Time/env_interaction_time"):
+            if iter_num <= learning_starts:
+                actions = envs.action_space.sample()
+            else:
+                jnp_obs = jax.device_put(
+                    prepare_obs(obs, mlp_keys=mlp_keys, num_envs=cfg.env.num_envs), player_device
+                )
+                rollout_key, sub = jax.random.split(rollout_key)
+                actions = np.asarray(player_fn(actor_player, jnp_obs, sub))
+            next_obs, rewards, terminated, truncated, infos = envs.step(
+                actions.reshape(envs.action_space.shape)
+            )
+            rewards = rewards.reshape(cfg.env.num_envs, -1)
+
+        if cfg.metric.log_level > 0 and "final_info" in infos:
+            fi = infos["final_info"]
+            for i in np.nonzero(fi.get("_episode", []))[0]:
+                ep_rew = float(fi["episode"]["r"][i])
+                ep_len = float(fi["episode"]["l"][i])
+                if aggregator and not aggregator.disabled:
+                    aggregator.update("Rewards/rew_avg", ep_rew)
+                    aggregator.update("Game/ep_len_avg", ep_len)
+                runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+
+        real_next_obs = copy.deepcopy(next_obs)
+        if "final_obs" in infos:
+            done_mask = np.logical_or(terminated, truncated)
+            for idx in np.nonzero(done_mask)[0]:
+                final = infos["final_obs"][idx]
+                if final is not None:
+                    for k, v in final.items():
+                        real_next_obs[k][idx] = v
+        real_next_obs_cat = np.concatenate([real_next_obs[k] for k in mlp_keys], axis=-1).astype(np.float32)
+
+        step_data["terminated"] = terminated.reshape(1, cfg.env.num_envs, -1).astype(np.uint8)
+        step_data["truncated"] = truncated.reshape(1, cfg.env.num_envs, -1).astype(np.uint8)
+        step_data["actions"] = actions.reshape(1, cfg.env.num_envs, -1)
+        step_data["observations"] = np.concatenate([obs[k] for k in mlp_keys], axis=-1).astype(np.float32)[np.newaxis]
+        if not cfg.buffer.sample_next_obs:
+            step_data["next_observations"] = real_next_obs_cat[np.newaxis]
+        step_data["rewards"] = rewards[np.newaxis].astype(np.float32)
+        rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+        obs = next_obs
+
+        # ------------------------------------------------- trainer partition
+        if iter_num >= learning_starts:
+            ratio_steps = policy_step - prefill_steps * policy_steps_per_iter
+            per_rank_gradient_steps = ratio(ratio_steps / n_trainers)
+            if per_rank_gradient_steps > 0:
+                # The scatter: one host sample covering every trainer's share,
+                # placed directly sharded over the trainer mesh (the reference
+                # chunks + scatter_object_list, sac_decoupled.py:243-257).
+                global_batch = cfg.algo.per_rank_batch_size * n_trainers
+                sample = rb.sample_tensors(
+                    batch_size=per_rank_gradient_steps * global_batch,
+                    sample_next_obs=cfg.buffer.sample_next_obs,
+                )
+                data = {
+                    k: jax.device_put(
+                        np.asarray(v)
+                        .astype(np.float32)
+                        .reshape(per_rank_gradient_steps, global_batch, *np.asarray(v).shape[2:]),
+                        batch_sharding,
+                    )
+                    for k, v in sample.items()
+                }
+                with timer("Time/train_time"):
+                    train_key, sub = jax.random.split(train_key)
+                    do_ema = iter_num % target_freq_iters == 0
+                    agent_state, opt_states, train_metrics = train_fn(
+                        agent_state,
+                        opt_states,
+                        data,
+                        sub,
+                        jnp.asarray(agent.tau if do_ema else 0.0, jnp.float32),
+                    )
+                    # The broadcast back: enqueue the weight copy and return to
+                    # env stepping without blocking — the player's next
+                    # inference syncs on this copy alone.
+                    actor_player = jax.device_put(agent_state["actor"], player_device)
+                    cumulative_per_rank_gradient_steps += per_rank_gradient_steps
+                    if aggregator and not aggregator.disabled:
+                        # np.asarray blocks on the train step, making
+                        # Time/train_time (and sps_train) meaningful whenever
+                        # they are actually reported; with metrics off the
+                        # dispatch stays fully async.
+                        aggregator.update("Loss/value_loss", np.asarray(train_metrics["value_loss"]))
+                        aggregator.update("Loss/policy_loss", np.asarray(train_metrics["policy_loss"]))
+                        aggregator.update("Loss/alpha_loss", np.asarray(train_metrics["alpha_loss"]))
+                train_step_count += n_trainers
+
+        # ------------------------------------------------------------ logging
+        if cfg.metric.log_level > 0 and logger is not None and (
+            policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
+        ):
+            if aggregator and not aggregator.disabled:
+                logger.log_dict(aggregator.compute(), policy_step)
+                aggregator.reset()
+            if policy_step > 0:
+                logger.log(
+                    "Params/replay_ratio",
+                    cumulative_per_rank_gradient_steps * n_trainers / policy_step,
+                    policy_step,
+                )
+            if not timer.disabled:
+                timer_metrics = timer.compute()
+                if timer_metrics.get("Time/train_time", 0) > 0:
+                    logger.log(
+                        "Time/sps_train",
+                        (train_step_count - last_train) / timer_metrics["Time/train_time"],
+                        policy_step,
+                    )
+                if timer_metrics.get("Time/env_interaction_time", 0) > 0:
+                    logger.log(
+                        "Time/sps_env_interaction",
+                        ((policy_step - last_log) * cfg.env.action_repeat)
+                        / timer_metrics["Time/env_interaction_time"],
+                        policy_step,
+                    )
+                timer.reset()
+            last_log = policy_step
+            last_train = train_step_count
+
+        # --------------------------------------------------------- checkpoint
+        if (
+            iter_num >= learning_starts
+            and cfg.checkpoint.every > 0
+            and policy_step - last_checkpoint >= cfg.checkpoint.every
+        ) or (iter_num == total_iters and cfg.checkpoint.save_last):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "agent": agent_state,
+                "qf_optimizer": opt_states["qf"],
+                "actor_optimizer": opt_states["actor"],
+                "alpha_optimizer": opt_states["alpha"],
+                "ratio": ratio.state_dict(),
+                "iter_num": iter_num,
+                "batch_size": cfg.algo.per_rank_batch_size * n_trainers,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            saved_tail = None
+            tail = (rb._pos - 1) % rb.buffer_size
+            if cfg.buffer.checkpoint:
+                # Buffer-tail consistency trick, as in coupled SAC
+                # (reference: callback.py:87-142).
+                if rb["truncated"] is not None:
+                    saved_tail = np.asarray(rb["truncated"][tail, :]).copy()
+                    rb["truncated"][tail, :] = 1
+                ckpt_state["rb"] = rb
+            ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{rank}.ckpt")
+            if runtime.is_global_zero:
+                save_checkpoint(ckpt_path, ckpt_state, keep_last=cfg.checkpoint.keep_last)
+            if saved_tail is not None:
+                rb["truncated"][tail, :] = saved_tail
+
+    envs.close()
+    if runtime.is_global_zero and cfg.algo.run_test:
+        test(agent, {"actor": actor_player}, runtime, cfg, log_dir, logger)
+
+    if logger is not None:
+        logger.close()

@@ -50,6 +50,24 @@ def build_mesh(
     return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
 
 
+def split_player_trainer(mesh: Mesh) -> tuple:
+    """Partition a mesh's devices into (player device, trainer mesh).
+
+    The substrate for decoupled player/trainer algorithms — the analog of the
+    reference's rank-0 / optimization process-group split
+    (sac_decoupled.py:563-584): device 0 plays, the rest train. Requires at
+    least 2 devices.
+    """
+    devices = list(mesh.devices.flat)
+    if len(devices) < 2:
+        raise RuntimeError(
+            "Decoupled training needs at least 2 devices (one player + at least "
+            "one trainer); run with fabric.devices>=2."
+        )
+    trainer_mesh = build_mesh(devices=devices[1:], model_axis_size=1)
+    return devices[0], trainer_mesh
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for a batch-leading array: leading dim split over `data`."""
     return NamedSharding(mesh, P(DATA_AXIS))

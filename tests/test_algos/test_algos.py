@@ -359,3 +359,85 @@ class TestSAC:
             "fabric.accelerator=cpu",
             f"checkpoint.resume_from={sorted(ckpts)[-1]}",
         ])
+
+
+def sac_decoupled_overrides(**extra):
+    args = [
+        "exp=sac_decoupled",
+        "env=dummy",
+        "env.id=continuous_dummy",
+        "env.wrapper.id=continuous_dummy",
+        "dry_run=True",
+        "metric.log_level=0",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "algo.per_rank_batch_size=4",
+        "algo.learning_starts=0",
+        "algo.hidden_size=8",
+        "buffer.memmap=False",
+        "buffer.size=64",
+        "checkpoint.every=0",
+        "fabric.accelerator=cpu",
+    ]
+    for k, v in extra.items():
+        args.append(f"{k}={v}")
+    return args
+
+
+class TestSACDecoupled:
+    @pytest.mark.parametrize("devices", [2, 3])
+    def test_dry_run(self, tmp_path, devices):
+        run(sac_decoupled_overrides(**{"fabric.devices": devices}))
+
+    def test_one_device_fails(self, tmp_path):
+        # Parity with the reference contract (tests/test_algos.py:126-144):
+        # a decoupled run on a single device must error out.
+        with pytest.raises(RuntimeError, match="decoupled"):
+            run(sac_decoupled_overrides(**{"fabric.devices": 1}))
+
+    def test_checkpoint_eval_resume_roundtrip(self, tmp_path):
+        checkpoint_eval_resume_roundtrip(
+            lambda **e: sac_decoupled_overrides(**{"fabric.devices": 2, **e}), tmp_path
+        )
+
+
+def ppo_decoupled_overrides(**extra):
+    args = [
+        "exp=ppo_decoupled",
+        "env=dummy",
+        "dry_run=True",
+        "metric.log_level=0",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "algo.rollout_steps=8",
+        "algo.per_rank_batch_size=4",
+        "algo.update_epochs=2",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.encoder.cnn_features_dim=16",
+        "algo.encoder.mlp_features_dim=8",
+        "algo.mlp_keys.encoder=[state]",
+        "buffer.memmap=False",
+        "checkpoint.every=0",
+        "fabric.accelerator=cpu",
+    ]
+    for k, v in extra.items():
+        args.append(f"{k}={v}")
+    return args
+
+
+class TestPPODecoupled:
+    @pytest.mark.parametrize("devices", [2, 3])
+    def test_dry_run(self, tmp_path, devices):
+        run(ppo_decoupled_overrides(**{"fabric.devices": devices}))
+
+    def test_one_device_fails(self, tmp_path):
+        with pytest.raises(RuntimeError, match="decoupled"):
+            run(ppo_decoupled_overrides(**{"fabric.devices": 1}))
+
+    def test_checkpoint_eval_resume_roundtrip(self, tmp_path):
+        checkpoint_eval_resume_roundtrip(
+            lambda **e: ppo_decoupled_overrides(**{"fabric.devices": 2, **e}), tmp_path
+        )
