@@ -44,8 +44,6 @@ from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
 
 @register_algorithm(decoupled=True)
 def main(runtime, cfg: Dict[str, Any]):
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
     player_device, trainer_mesh = split_player_trainer(runtime.mesh)
     n_trainers = int(trainer_mesh.shape[DATA_AXIS])
     rank = runtime.global_rank
@@ -68,7 +66,14 @@ def main(runtime, cfg: Dict[str, Any]):
     vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
     envs = vectorized_env(
         [
-            make_env(cfg, cfg.seed + i, 0, log_dir, "train", vector_env_idx=i)
+            make_env(
+                cfg,
+                cfg.seed + rank * cfg.env.num_envs + i,
+                rank * cfg.env.num_envs,
+                log_dir if rank == 0 else None,
+                "train",
+                vector_env_idx=i,
+            )
             for i in range(cfg.env.num_envs)
         ],
         autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
@@ -190,7 +195,7 @@ def main(runtime, cfg: Dict[str, Any]):
         )
     )
     train_fn = make_train_step(agent, tx, cfg, trainer_mesh)
-    batch_sharding = NamedSharding(trainer_mesh, P(DATA_AXIS))
+    batch_sharding = mesh_lib.batch_sharding(trainer_mesh)
 
     rollout_key, train_key = jax.random.split(jax.random.fold_in(runtime.root_key, rank))
 

@@ -58,6 +58,11 @@ def split_player_trainer(mesh: Mesh) -> tuple:
     (sac_decoupled.py:563-584): device 0 plays, the rest train. Requires at
     least 2 devices.
     """
+    if int(mesh.shape[MODEL_AXIS]) > 1:
+        raise RuntimeError(
+            "Decoupled training does not compose with fabric.model_axis > 1 yet: "
+            "the trainer partition is pure data-parallel. Set fabric.model_axis=1."
+        )
     devices = list(mesh.devices.flat)
     if len(devices) < 2:
         raise RuntimeError(

@@ -1,0 +1,61 @@
+"""Crafter bridge (reference: sheeprl/envs/crafter.py:17-66).
+
+Wraps a `crafter.Env` into the dict-observation gymnasium contract: the frame
+is exposed under the "rgb" key, the legacy done flag splits into
+terminated/truncated by the episode discount (0 -> terminated, else the
+time-limit truncation).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple, Union
+
+from sheeprl_tpu.utils.imports import _IS_CRAFTER_AVAILABLE, require
+
+require(_IS_CRAFTER_AVAILABLE, "crafter", "crafter")
+
+import crafter
+import gymnasium as gym
+import numpy as np
+from gymnasium import spaces
+
+
+class CrafterWrapper(gym.Env):
+    metadata = {"render_modes": ["rgb_array"], "render_fps": 30}
+
+    def __init__(self, id: str, screen_size: Union[int, Tuple[int, int]], seed: Optional[int] = None) -> None:
+        if id not in ("crafter_reward", "crafter_nonreward"):
+            raise ValueError(f"Unknown crafter id '{id}', expected crafter_reward | crafter_nonreward")
+        if isinstance(screen_size, int):
+            screen_size = (screen_size, screen_size)
+
+        self._env = crafter.Env(size=screen_size, seed=seed, reward=(id == "crafter_reward"))
+        inner = self._env.observation_space
+        self.observation_space = spaces.Dict(
+            {"rgb": spaces.Box(inner.low, inner.high, inner.shape, inner.dtype)}
+        )
+        self.action_space = spaces.Discrete(self._env.action_space.n)
+        self.reward_range = getattr(self._env, "reward_range", None) or (-np.inf, np.inf)
+        self.observation_space.seed(seed)
+        self.action_space.seed(seed)
+        self.render_mode = "rgb_array"
+
+    def step(self, action: Any) -> Tuple[Dict[str, np.ndarray], float, bool, bool, Dict[str, Any]]:
+        obs, reward, done, info = self._env.step(action)
+        terminated = done and info["discount"] == 0
+        truncated = done and info["discount"] != 0
+        return {"rgb": obs}, reward, terminated, truncated, info
+
+    def reset(
+        self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None
+    ) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+        if seed is not None:
+            self._env._seed = seed
+        obs = self._env.reset()
+        return {"rgb": obs}, {}
+
+    def render(self) -> Optional[np.ndarray]:
+        return self._env.render()
+
+    def close(self) -> None:
+        return None

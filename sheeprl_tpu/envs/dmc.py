@@ -1,0 +1,179 @@
+"""DeepMind Control Suite bridge (reference: sheeprl/envs/dmc.py:49-244).
+
+A gymnasium-1.0 `Env` over `dm_control.suite` tasks with the dual-observation
+contract the Dreamer/SAC pipelines rely on:
+
+- `from_pixels` and `from_vectors` select what the dict observation carries:
+  a rendered "rgb" frame, the flattened "state" vector, or both.
+- Actions are exposed normalized to [-1, 1] and affinely rescaled to the
+  task's true bounds on step.
+- dm_env's TimeStep/discount protocol maps to gymnasium's pair: an episode
+  end with discount 0 is `terminated`, with discount 1 is `truncated`
+  (the suite's time limits).
+
+TPU-layout divergence from the reference: frames are channel-LAST (H, W, 3)
+by default — the whole sheeprl_tpu pixel pipeline is HWC (utils/env.py), so no
+transpose happens anywhere between the renderer and the encoder.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+from sheeprl_tpu.utils.imports import _IS_DMC_AVAILABLE, require
+
+require(_IS_DMC_AVAILABLE, "dm_control", "dm_control")
+
+import gymnasium as gym
+import numpy as np
+from dm_control import suite
+from dm_env import specs
+from gymnasium import spaces
+
+
+def _bounds_from_spec(spec_list, dtype) -> spaces.Box:
+    """Concatenate dm_env array specs into one flat Box."""
+    lows, highs = [], []
+    for s in spec_list:
+        dim = int(np.prod(s.shape))
+        if isinstance(s, specs.BoundedArray):
+            lows.append(np.broadcast_to(s.minimum, (dim,)).astype(np.float32))
+            highs.append(np.broadcast_to(s.maximum, (dim,)).astype(np.float32))
+        elif isinstance(s, specs.Array):
+            lows.append(np.full((dim,), -np.inf, np.float32))
+            highs.append(np.full((dim,), np.inf, np.float32))
+        else:
+            raise ValueError(f"Unrecognized dm_env spec: {type(s)}")
+    return spaces.Box(
+        np.concatenate(lows).astype(dtype), np.concatenate(highs).astype(dtype), dtype=dtype
+    )
+
+
+def _flatten_time_step_obs(obs: Dict[Any, Any]) -> np.ndarray:
+    parts = [np.atleast_1d(np.asarray(v)).ravel() for v in obs.values()]
+    return np.concatenate(parts, axis=0)
+
+
+class DMCWrapper(gym.Env):
+    """One dm_control suite task as a gymnasium Env with dict observations."""
+
+    metadata = {"render_modes": ["rgb_array"], "render_fps": 30}
+
+    def __init__(
+        self,
+        domain_name: str,
+        task_name: str,
+        from_pixels: bool = False,
+        from_vectors: bool = True,
+        height: int = 84,
+        width: int = 84,
+        camera_id: int = 0,
+        task_kwargs: Optional[Dict[Any, Any]] = None,
+        environment_kwargs: Optional[Dict[Any, Any]] = None,
+        channels_last: bool = True,
+        visualize_reward: bool = False,
+        seed: Optional[int] = None,
+    ):
+        if not (from_vectors or from_pixels):
+            raise ValueError(
+                "'from_vectors' and 'from_pixels' must not be both False: "
+                f"got {from_vectors} and {from_pixels} respectively."
+            )
+        self._from_pixels = from_pixels
+        self._from_vectors = from_vectors
+        self._height = height
+        self._width = width
+        self._camera_id = camera_id
+        self._channels_last = channels_last
+
+        task_kwargs = dict(task_kwargs or {})
+        # Seeding goes through reset(); a task-level random state here would
+        # be overwritten there anyway.
+        task_kwargs.pop("random", None)
+        self._env = suite.load(
+            domain_name=domain_name,
+            task_name=task_name,
+            task_kwargs=task_kwargs,
+            visualize_reward=visualize_reward,
+            environment_kwargs=environment_kwargs,
+        )
+
+        self._true_action_space = _bounds_from_spec([self._env.action_spec()], np.float32)
+        self.action_space = spaces.Box(
+            low=-1.0, high=1.0, shape=self._true_action_space.shape, dtype=np.float32
+        )
+
+        reward_space = _bounds_from_spec([self._env.reward_spec()], np.float32)
+        self.reward_range = (float(reward_space.low[0]), float(reward_space.high[0]))
+
+        obs_space: Dict[str, spaces.Space] = {}
+        if from_pixels:
+            shape = (height, width, 3) if channels_last else (3, height, width)
+            obs_space["rgb"] = spaces.Box(low=0, high=255, shape=shape, dtype=np.uint8)
+        if from_vectors:
+            obs_space["state"] = _bounds_from_spec(self._env.observation_spec().values(), np.float64)
+        self.observation_space = spaces.Dict(obs_space)
+        self.state_space = _bounds_from_spec(self._env.observation_spec().values(), np.float64)
+
+        self.current_state: Optional[np.ndarray] = None
+        self.render_mode = "rgb_array"
+        if seed is not None:
+            self._seed_spaces(seed)
+            self._pending_task_seed = seed
+        else:
+            self._pending_task_seed = None
+
+    # ------------------------------------------------------------- internals
+    def _seed_spaces(self, seed: int) -> None:
+        self._true_action_space.seed(seed)
+        self.action_space.seed(seed)
+        self.observation_space.seed(seed)
+
+    def _observation(self, time_step) -> Dict[str, np.ndarray]:
+        obs: Dict[str, np.ndarray] = {}
+        if self._from_pixels:
+            frame = self.render()
+            if not self._channels_last:
+                frame = frame.transpose(2, 0, 1).copy()
+            obs["rgb"] = frame
+        if self._from_vectors:
+            obs["state"] = _flatten_time_step_obs(time_step.observation)
+        return obs
+
+    def _rescale_action(self, action: np.ndarray) -> np.ndarray:
+        """[-1, 1] -> the task's true bounds."""
+        action = np.asarray(action, np.float64)
+        low, high = self._true_action_space.low, self._true_action_space.high
+        return ((action + 1.0) / 2.0 * (high - low) + low).astype(np.float32)
+
+    # ------------------------------------------------------------ gym API
+    def step(self, action: Any) -> Tuple[Dict[str, np.ndarray], float, bool, bool, Dict[str, Any]]:
+        time_step = self._env.step(self._rescale_action(action))
+        self.current_state = _flatten_time_step_obs(time_step.observation)
+        info = {
+            "discount": time_step.discount,
+            "internal_state": self._env.physics.get_state().copy(),
+        }
+        is_last = (not time_step.first()) and time_step.last()
+        terminated = bool(is_last and time_step.discount == 0)
+        truncated = bool(is_last and time_step.discount != 0)
+        return self._observation(time_step), time_step.reward or 0.0, terminated, truncated, info
+
+    def reset(
+        self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None
+    ) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+        seed = seed if seed is not None else self._pending_task_seed
+        self._pending_task_seed = None
+        if seed is not None:
+            self._env.task._random = np.random.RandomState(seed)
+        time_step = self._env.reset()
+        self.current_state = _flatten_time_step_obs(time_step.observation)
+        return self._observation(time_step), {}
+
+    def render(self, camera_id: Optional[int] = None) -> np.ndarray:
+        return self._env.physics.render(
+            height=self._height, width=self._width, camera_id=camera_id or self._camera_id
+        )
+
+    def close(self) -> None:
+        self._env.close()

@@ -1,0 +1,315 @@
+"""MineDojo bridge (reference: sheeprl/envs/minedojo.py:56-307).
+
+Exposes a MineDojo task as a gymnasium Env with the flattened action/obs
+contract the masked Dreamer actors consume:
+
+- Actions are a 3-way MultiDiscrete: (movement-or-functional action id,
+  craft/smelt item id, inventory item id). Each id in the first head maps to
+  one row of MineDojo's 8-slot ARNN action through ``ACTION_MAP``; craft and
+  equip/place/destroy targets are filled from the other two heads.
+- Observations are fixed-size vectors over the full Minecraft item vocabulary
+  (counts, historical max, per-step delta, equipment one-hot), life stats,
+  and the four action masks the actor needs to avoid invalid choices.
+- Sticky attack/jump repeat the respective action for a configurable number
+  of steps after it is selected (disabled for attack when the break-speed
+  multiplier already accelerates mining).
+- Pitch is clamped to ``pitch_limits`` by suppressing out-of-range camera
+  commands before they reach the simulator.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, Optional, Tuple
+
+from sheeprl_tpu.utils.imports import _IS_MINEDOJO_AVAILABLE, require
+
+require(_IS_MINEDOJO_AVAILABLE, "minedojo", "minedojo")
+
+import gymnasium as gym
+import minedojo
+import minedojo.tasks
+import numpy as np
+from minedojo.sim import ALL_CRAFT_SMELT_ITEMS, ALL_ITEMS
+
+N_ALL_ITEMS = len(ALL_ITEMS)
+
+# One row per flattened action id: (move, strafe, jump/sneak/sprint,
+# pitch-delta-bucket, yaw-delta-bucket, functional, craft-arg, inventory-arg).
+# Bucket 12 is "no camera change"; functional 0 is noop, 1 use, 2 drop,
+# 3 attack, 4 craft, 5 equip, 6 place, 7 destroy.
+ACTION_MAP = {
+    0: np.array([0, 0, 0, 12, 12, 0, 0, 0]),  # no-op
+    1: np.array([1, 0, 0, 12, 12, 0, 0, 0]),  # forward
+    2: np.array([2, 0, 0, 12, 12, 0, 0, 0]),  # back
+    3: np.array([0, 1, 0, 12, 12, 0, 0, 0]),  # strafe left
+    4: np.array([0, 2, 0, 12, 12, 0, 0, 0]),  # strafe right
+    5: np.array([1, 0, 1, 12, 12, 0, 0, 0]),  # jump + forward
+    6: np.array([1, 0, 2, 12, 12, 0, 0, 0]),  # sneak + forward
+    7: np.array([1, 0, 3, 12, 12, 0, 0, 0]),  # sprint + forward
+    8: np.array([0, 0, 0, 11, 12, 0, 0, 0]),  # pitch -15
+    9: np.array([0, 0, 0, 13, 12, 0, 0, 0]),  # pitch +15
+    10: np.array([0, 0, 0, 12, 11, 0, 0, 0]),  # yaw -15
+    11: np.array([0, 0, 0, 12, 13, 0, 0, 0]),  # yaw +15
+    12: np.array([0, 0, 0, 12, 12, 1, 0, 0]),  # use
+    13: np.array([0, 0, 0, 12, 12, 2, 0, 0]),  # drop
+    14: np.array([0, 0, 0, 12, 12, 3, 0, 0]),  # attack
+    15: np.array([0, 0, 0, 12, 12, 4, 0, 0]),  # craft
+    16: np.array([0, 0, 0, 12, 12, 5, 0, 0]),  # equip
+    17: np.array([0, 0, 0, 12, 12, 6, 0, 0]),  # place
+    18: np.array([0, 0, 0, 12, 12, 7, 0, 0]),  # destroy
+}
+ITEM_ID_TO_NAME = dict(enumerate(ALL_ITEMS))
+ITEM_NAME_TO_ID = dict(zip(ALL_ITEMS, range(N_ALL_ITEMS)))
+# minedojo.make mutates the global task-spec table; keep a pristine copy so
+# every constructed wrapper starts from the same specs.
+ALL_TASKS_SPECS = copy.deepcopy(minedojo.tasks.ALL_TASKS_SPECS)
+
+_FUNC_IDX = 5  # slot of the functional action in the ARNN vector
+_JUMP_IDX = 2  # slot of the jump/sneak/sprint action
+_ATTACK = 3
+_CRAFT = 4
+
+
+def _item_key(name: str) -> str:
+    return "_".join(name.split(" "))
+
+
+class MineDojoWrapper(gym.Wrapper):
+    def __init__(
+        self,
+        id: str,
+        height: int = 64,
+        width: int = 64,
+        pitch_limits: Tuple[int, int] = (-60, 60),
+        seed: Optional[int] = None,
+        sticky_attack: Optional[int] = 30,
+        sticky_jump: Optional[int] = 10,
+        **kwargs: Optional[Dict[Any, Any]],
+    ):
+        self._height = height
+        self._width = width
+        self._pitch_limits = pitch_limits
+        self._pos = kwargs.get("start_position", None)
+        self._break_speed_multiplier = kwargs.pop("break_speed_multiplier", 100)
+        self._start_pos = copy.deepcopy(self._pos)
+        # A break-speed multiplier > 1 already mines in few hits; sticky attack
+        # on top would overshoot, so it is disabled in that case.
+        self._sticky_attack = 0 if self._break_speed_multiplier > 1 else sticky_attack
+        self._sticky_jump = sticky_jump
+        self._sticky_attack_counter = 0
+        self._sticky_jump_counter = 0
+
+        if self._pos is not None and not (self._pitch_limits[0] <= self._pos["pitch"] <= self._pitch_limits[1]):
+            raise ValueError(
+                f"The initial position must respect the pitch limits {self._pitch_limits}, given {self._pos['pitch']}"
+            )
+
+        env = minedojo.make(
+            task_id=id,
+            image_size=(height, width),
+            world_seed=seed,
+            fast_reset=True,
+            break_speed_multiplier=self._break_speed_multiplier,
+            **kwargs,
+        )
+        super().__init__(env)
+        self._inventory: Dict[str, list] = {}
+        self._inventory_names: Optional[np.ndarray] = None
+        self._inventory_max = np.zeros(N_ALL_ITEMS)
+        self.action_space = gym.spaces.MultiDiscrete(
+            np.array([len(ACTION_MAP), len(ALL_CRAFT_SMELT_ITEMS), N_ALL_ITEMS])
+        )
+        self.observation_space = gym.spaces.Dict(
+            {
+                "rgb": gym.spaces.Box(0, 255, self.env.observation_space["rgb"].shape, np.uint8),
+                "inventory": gym.spaces.Box(0.0, np.inf, (N_ALL_ITEMS,), np.float32),
+                "inventory_max": gym.spaces.Box(0.0, np.inf, (N_ALL_ITEMS,), np.float32),
+                "inventory_delta": gym.spaces.Box(-np.inf, np.inf, (N_ALL_ITEMS,), np.float32),
+                "equipment": gym.spaces.Box(0.0, 1.0, (N_ALL_ITEMS,), np.int32),
+                "life_stats": gym.spaces.Box(0.0, np.array([20.0, 20.0, 300.0]), (3,), np.float32),
+                "mask_action_type": gym.spaces.Box(0, 1, (len(ACTION_MAP),), bool),
+                "mask_equip_place": gym.spaces.Box(0, 1, (N_ALL_ITEMS,), bool),
+                "mask_destroy": gym.spaces.Box(0, 1, (N_ALL_ITEMS,), bool),
+                "mask_craft_smelt": gym.spaces.Box(0, 1, (len(ALL_CRAFT_SMELT_ITEMS),), bool),
+            }
+        )
+        self._render_mode: str = "rgb_array"
+        self.seed(seed=seed)
+        minedojo.tasks.ALL_TASKS_SPECS = copy.deepcopy(ALL_TASKS_SPECS)
+
+    @property
+    def render_mode(self) -> Optional[str]:
+        return self._render_mode
+
+    def __getattr__(self, name):
+        return getattr(self.env, name)
+
+    # --------------------------------------------------- obs conversion
+    def _convert_inventory(self, inventory: Dict[str, Any]) -> np.ndarray:
+        """Slot list -> per-item count vector; also records, per item name,
+        which slots hold it (equip/place/destroy need a slot index)."""
+        counts = np.zeros(N_ALL_ITEMS)
+        self._inventory = {}
+        self._inventory_names = np.array([_item_key(item) for item in inventory["name"].copy().tolist()])
+        for slot, (item, quantity) in enumerate(zip(inventory["name"], inventory["quantity"])):
+            item = _item_key(item)
+            self._inventory.setdefault(item, []).append(slot)
+            # "air" slots count as one each; everything else by quantity
+            counts[ITEM_NAME_TO_ID[item]] += 1 if item == "air" else quantity
+        self._inventory_max = np.maximum(counts, self._inventory_max)
+        return counts
+
+    def _convert_inventory_delta(self, delta: Dict[str, Any]) -> np.ndarray:
+        out = np.zeros(N_ALL_ITEMS)
+        for names_key, quantities_key, sign in (
+            ("inc_name_by_craft", "inc_quantity_by_craft", +1),
+            ("dec_name_by_craft", "dec_quantity_by_craft", -1),
+            ("inc_name_by_other", "inc_quantity_by_other", +1),
+            ("dec_name_by_other", "dec_quantity_by_other", -1),
+        ):
+            for item, quantity in zip(delta[names_key], delta[quantities_key]):
+                out[ITEM_NAME_TO_ID[_item_key(item)]] += sign * quantity
+        return out
+
+    def _convert_equipment(self, equipment: Dict[str, Any]) -> np.ndarray:
+        onehot = np.zeros(N_ALL_ITEMS, dtype=np.int32)
+        onehot[ITEM_NAME_TO_ID[_item_key(equipment["name"][0])]] = 1
+        return onehot
+
+    def _convert_masks(self, masks: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        equip_mask = np.zeros(N_ALL_ITEMS, dtype=bool)
+        destroy_mask = np.zeros(N_ALL_ITEMS, dtype=bool)
+        for item, can_equip, can_destroy in zip(self._inventory_names, masks["equip"], masks["destroy"]):
+            idx = ITEM_NAME_TO_ID[item]
+            equip_mask[idx] = can_equip
+            destroy_mask[idx] = can_destroy
+        # equip/place (flattened ids 16, 17 -> functional 5, 6) are only legal
+        # when something is equipable; destroy (id 18 -> functional 7) when
+        # something is destroyable.
+        masks["action_type"][5:7] *= np.any(equip_mask).item()
+        masks["action_type"][7] *= np.any(destroy_mask).item()
+        return {
+            # the 12 movement/camera actions are always legal; functional ones
+            # follow the simulator's mask
+            "mask_action_type": np.concatenate((np.ones(12, dtype=bool), masks["action_type"][1:])),
+            "mask_equip_place": equip_mask,
+            "mask_destroy": destroy_mask,
+            "mask_craft_smelt": masks["craft_smelt"],
+        }
+
+    def _convert_obs(self, obs: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        return {
+            "rgb": obs["rgb"].copy(),
+            "inventory": self._convert_inventory(obs["inventory"]),
+            "inventory_max": self._inventory_max,
+            "inventory_delta": self._convert_inventory_delta(obs["delta_inv"]),
+            "equipment": self._convert_equipment(obs["equipment"]),
+            "life_stats": np.concatenate(
+                (obs["life_stats"]["life"], obs["life_stats"]["food"], obs["life_stats"]["oxygen"])
+            ),
+            **self._convert_masks(obs["masks"]),
+        }
+
+    # -------------------------------------------------- action conversion
+    def _apply_sticky_attack(self, arnn: np.ndarray) -> None:
+        if arnn[_FUNC_IDX] == _ATTACK:
+            self._sticky_attack_counter = self._sticky_attack - 1
+        if self._sticky_attack_counter > 0 and arnn[_FUNC_IDX] == 0:
+            arnn[_FUNC_IDX] = _ATTACK
+            self._sticky_attack_counter -= 1
+        elif arnn[_FUNC_IDX] != _ATTACK:
+            self._sticky_attack_counter = 0
+
+    def _apply_sticky_jump(self, arnn: np.ndarray) -> None:
+        if arnn[_JUMP_IDX] == 1:
+            self._sticky_jump_counter = self._sticky_jump - 1
+        if self._sticky_jump_counter > 0 and arnn[0] == 0:
+            arnn[_JUMP_IDX] = 1
+            # A sticky jump keeps the forward momentum unless the agent chose
+            # another movement this step.
+            if arnn[0] == arnn[1] == 0:
+                arnn[0] = 1
+            self._sticky_jump_counter -= 1
+        elif arnn[_JUMP_IDX] != 1:
+            self._sticky_jump_counter = 0
+
+    def _convert_action(self, action: np.ndarray) -> np.ndarray:
+        arnn = ACTION_MAP[int(action[0])].copy()
+        if self._sticky_attack:
+            self._apply_sticky_attack(arnn)
+        if self._sticky_jump:
+            self._apply_sticky_jump(arnn)
+        # craft takes its item from the second head ...
+        arnn[6] = int(action[1]) if arnn[_FUNC_IDX] == _CRAFT else 0
+        # ... equip/place/destroy take an inventory slot resolved from the
+        # third head's item id
+        if arnn[_FUNC_IDX] in (5, 6, 7):
+            arnn[7] = self._inventory[ITEM_ID_TO_NAME[int(action[2])]][0]
+        else:
+            arnn[7] = 0
+        return arnn
+
+    def _location_stats(self, obs: Dict[str, Any]) -> Dict[str, float]:
+        return {
+            "x": float(obs["location_stats"]["pos"][0]),
+            "y": float(obs["location_stats"]["pos"][1]),
+            "z": float(obs["location_stats"]["pos"][2]),
+            "pitch": float(obs["location_stats"]["pitch"].item()),
+            "yaw": float(obs["location_stats"]["yaw"].item()),
+        }
+
+    def _life_stats(self, obs: Dict[str, Any]) -> Dict[str, float]:
+        return {
+            "life": float(obs["life_stats"]["life"].item()),
+            "oxygen": float(obs["life_stats"]["oxygen"].item()),
+            "food": float(obs["life_stats"]["food"].item()),
+        }
+
+    # ------------------------------------------------------------ gym API
+    def seed(self, seed: Optional[int] = None) -> None:
+        self.observation_space.seed(seed)
+        self.action_space.seed(seed)
+
+    def step(self, action: np.ndarray) -> Tuple[Any, float, bool, bool, Dict[str, Any]]:
+        raw_action = action
+        action = self._convert_action(action)
+        # Suppress pitch commands that would leave the allowed range.
+        next_pitch = self._pos["pitch"] + (action[3] - 12) * 15
+        if not (self._pitch_limits[0] <= next_pitch <= self._pitch_limits[1]):
+            action[3] = 12
+
+        obs, reward, done, info = self.env.step(action)
+        is_timelimit = info.get("TimeLimit.truncated", False)
+        self._pos = self._location_stats(obs)
+        info.update(
+            {
+                "life_stats": self._life_stats(obs),
+                "location_stats": copy.deepcopy(self._pos),
+                "action": raw_action.tolist(),
+                "biomeid": float(obs["location_stats"]["biome_id"].item()),
+            }
+        )
+        return self._convert_obs(obs), reward, done and not is_timelimit, done and is_timelimit, info
+
+    def reset(
+        self, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None
+    ) -> Tuple[np.ndarray, Dict[str, Any]]:
+        obs = self.env.reset()
+        self._pos = self._location_stats(obs)
+        self._sticky_jump_counter = 0
+        self._sticky_attack_counter = 0
+        self._inventory_max = np.zeros(N_ALL_ITEMS)
+        return self._convert_obs(obs), {
+            "life_stats": self._life_stats(obs),
+            "location_stats": copy.deepcopy(self._pos),
+            "biomeid": float(obs["location_stats"]["biome_id"].item()),
+        }
+
+    def render(self) -> Optional[np.ndarray]:
+        if self._render_mode == "human":
+            return super().render()
+        if self._render_mode == "rgb_array":
+            prev = self.env.unwrapped._prev_obs
+            return None if prev is None else prev["rgb"]
+        return None

@@ -1,0 +1,253 @@
+"""MineRL bridge (reference: sheeprl/envs/minerl.py:48-322).
+
+Drives the custom MineRL task specs (envs/minerl_envs/) through a flattened
+discrete action space: index 0 is a no-op and every further index toggles
+exactly one primitive (a keyboard key, one of four 15-degree camera moves, or
+one value of an Enum action like craft/place/equip); jump/sneak/sprint imply
+forward. Observations become fixed-size vectors (inventory counts + running
+max over the item vocabulary, equipment one-hot, life stats, optional
+compass angle).
+
+Sticky attack/jump mirror the MineDojo bridge; pitch is clamped to
+``pitch_limits`` by zeroing out-of-range camera commands. MineRL cannot
+distinguish termination from truncation, so the task specs disable its time
+limit and the outer TimeLimit wrapper owns truncation (step always returns
+truncated=False here).
+
+TPU-layout divergence: frames stay channel-LAST (H, W, C) — the reference
+transposes to CHW for torch (minerl.py:278).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, Optional, Tuple
+
+from sheeprl_tpu.utils.imports import _IS_MINERL_AVAILABLE, require
+
+require(_IS_MINERL_AVAILABLE, "minerl", "minerl==0.4.4")
+
+import gymnasium as gym
+import minerl
+import numpy as np
+from minerl.herobraine.hero import mc
+
+from sheeprl_tpu.envs.minerl_envs.navigate import CustomNavigate
+from sheeprl_tpu.envs.minerl_envs.obtain import CustomObtainDiamond, CustomObtainIronPickaxe
+
+CUSTOM_ENVS = {
+    "custom_navigate": CustomNavigate,
+    "custom_obtain_diamond": CustomObtainDiamond,
+    "custom_obtain_iron_pickaxe": CustomObtainIronPickaxe,
+}
+
+N_ALL_ITEMS = len(mc.ALL_ITEMS)
+NOOP: Dict[str, Any] = {
+    "camera": (0, 0),
+    "forward": 0,
+    "back": 0,
+    "left": 0,
+    "right": 0,
+    "attack": 0,
+    "sprint": 0,
+    "jump": 0,
+    "sneak": 0,
+    "craft": "none",
+    "nearbyCraft": "none",
+    "nearbySmelt": "none",
+    "place": "none",
+    "equip": "none",
+}
+ITEM_ID_TO_NAME = dict(enumerate(mc.ALL_ITEMS))
+ITEM_NAME_TO_ID = dict(zip(mc.ALL_ITEMS, range(N_ALL_ITEMS)))
+
+_CAMERA_MOVES = (
+    np.array([-15, 0]),  # pitch down
+    np.array([15, 0]),   # pitch up
+    np.array([0, -15]),  # yaw left
+    np.array([0, 15]),   # yaw right
+)
+
+
+class MineRLWrapper(gym.Wrapper):
+    """One custom MineRL task as a gymnasium Env with flattened actions.
+
+    Args:
+        id: key into CUSTOM_ENVS (custom_navigate | custom_obtain_diamond |
+            custom_obtain_iron_pickaxe).
+        height/width: POV frame size.
+        pitch_limits: allowed pitch range; camera commands leaving it are
+            suppressed.
+        seed: action/observation-space seed.
+        sticky_attack: steps to repeat attack after it is selected (disabled
+            when break_speed_multiplier > 1 already accelerates mining).
+        sticky_jump: steps to repeat jump after it is selected.
+        break_speed_multiplier: block-breaking speed-up baked into the spec.
+        multihot_inventory: vector over ALL Minecraft items (True) or only the
+            task's obtainable items (False).
+    """
+
+    def __init__(
+        self,
+        id: str,
+        height: int = 64,
+        width: int = 64,
+        pitch_limits: Tuple[int, int] = (-60, 60),
+        seed: Optional[int] = None,
+        sticky_attack: Optional[int] = 30,
+        sticky_jump: Optional[int] = 10,
+        break_speed_multiplier: Optional[int] = 100,
+        multihot_inventory: bool = True,
+        **kwargs: Optional[Dict[Any, Any]],
+    ):
+        self._height = height
+        self._width = width
+        self._pitch_limits = pitch_limits
+        self._sticky_attack = 0 if break_speed_multiplier > 1 else sticky_attack
+        self._sticky_jump = sticky_jump
+        self._sticky_attack_counter = 0
+        self._sticky_jump_counter = 0
+        self._break_speed_multiplier = break_speed_multiplier
+        self._multihot_inventory = multihot_inventory
+        if "navigate" not in id.lower():
+            kwargs.pop("extreme", None)
+
+        env = CUSTOM_ENVS[id.lower()](break_speed=break_speed_multiplier, **kwargs).make()
+        super().__init__(env)
+
+        # Flatten the Dict action space: one discrete index per primitive.
+        self.ACTIONS_MAP: Dict[int, Dict[str, Any]] = {0: {}}
+        act_idx = 1
+        for act in self.env.action_space:
+            if isinstance(self.env.action_space[act], minerl.herobraine.hero.spaces.Enum):
+                values = set(self.env.action_space[act].values.tolist()) - {"none"}
+            elif act == "camera":
+                values = _CAMERA_MOVES
+            else:
+                values = [1]
+            for v in values:
+                self.ACTIONS_MAP[act_idx] = {act: v}
+                if act in ("jump", "sneak", "sprint"):
+                    self.ACTIONS_MAP[act_idx]["forward"] = 1
+                act_idx += 1
+        self.action_space = gym.spaces.Discrete(len(self.ACTIONS_MAP))
+
+        if multihot_inventory:
+            self.inventory_size = N_ALL_ITEMS
+            self.inventory_item_to_id = ITEM_NAME_TO_ID
+        else:
+            self.inventory_size = len(self.env.observation_space["inventory"])
+            self.inventory_item_to_id = dict(
+                zip(self.env.observation_space["inventory"], range(self.inventory_size))
+            )
+
+        obs_space: Dict[str, gym.spaces.Space] = {
+            "rgb": gym.spaces.Box(0, 255, (height, width, 3), np.uint8),
+            "life_stats": gym.spaces.Box(0.0, np.array([20.0, 20.0, 300.0]), (3,), np.float32),
+            "inventory": gym.spaces.Box(0.0, np.inf, (self.inventory_size,), np.float32),
+            "max_inventory": gym.spaces.Box(0.0, np.inf, (self.inventory_size,), np.float32),
+        }
+        if "compass" in self.env.observation_space.spaces:
+            obs_space["compass"] = gym.spaces.Box(-180, 180, (1,), np.float32)
+        if "equipped_items" in self.env.observation_space.spaces:
+            if multihot_inventory:
+                self.equip_size = N_ALL_ITEMS
+                self.equip_item_to_id = ITEM_NAME_TO_ID
+            else:
+                equipable = self.env.observation_space["equipped_items"]["mainhand"]["type"].values.tolist()
+                self.equip_size = len(equipable)
+                self.equip_item_to_id = dict(zip(equipable, range(self.equip_size)))
+            obs_space["equipment"] = gym.spaces.Box(0.0, 1.0, (self.equip_size,), np.int32)
+        self.observation_space = gym.spaces.Dict(obs_space)
+
+        self._pos = {"pitch": 0.0, "yaw": 0.0}
+        self._max_inventory = np.zeros(self.inventory_size)
+        self._render_mode: str = "rgb_array"
+        self.seed(seed=seed)
+
+    @property
+    def render_mode(self) -> Optional[str]:
+        return self._render_mode
+
+    def __getattr__(self, name):
+        return getattr(self.env, name)
+
+    # -------------------------------------------------- action conversion
+    def _convert_actions(self, action: np.ndarray) -> Dict[str, Any]:
+        converted = copy.deepcopy(NOOP)
+        converted.update(self.ACTIONS_MAP[action.item()])
+        if self._sticky_attack:
+            if converted["attack"]:
+                self._sticky_attack_counter = self._sticky_attack
+            if self._sticky_attack_counter > 0:
+                converted["attack"] = 1
+                converted["jump"] = 0
+                self._sticky_attack_counter -= 1
+        if self._sticky_jump:
+            if converted["jump"]:
+                self._sticky_jump_counter = self._sticky_jump
+            if self._sticky_jump_counter > 0:
+                converted["jump"] = 1
+                converted["forward"] = 1
+                self._sticky_jump_counter -= 1
+        return converted
+
+    # --------------------------------------------------- obs conversion
+    def _convert_inventory(self, inventory: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        counts = np.zeros(self.inventory_size)
+        for item, quantity in inventory.items():
+            # "air" reports a slot count, everything else a quantity
+            counts[self.inventory_item_to_id[item]] += 1 if item == "air" else quantity
+        self._max_inventory = np.maximum(counts, self._max_inventory)
+        return {"inventory": counts, "max_inventory": self._max_inventory.copy()}
+
+    def _convert_equipment(self, equipment: Dict[str, Any]) -> np.ndarray:
+        onehot = np.zeros(self.equip_size, dtype=np.int32)
+        name = equipment["mainhand"]["type"]
+        onehot[self.equip_item_to_id.get(name, self.equip_item_to_id["air"])] = 1
+        return onehot
+
+    def _convert_obs(self, obs: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        converted = {
+            "rgb": obs["pov"].copy(),
+            "life_stats": np.array(
+                [obs["life_stats"]["life"], obs["life_stats"]["food"], obs["life_stats"]["air"]],
+                dtype=np.float32,
+            ),
+            **self._convert_inventory(obs["inventory"]),
+        }
+        if "equipment" in self.observation_space.spaces:
+            converted["equipment"] = self._convert_equipment(obs["equipped_items"])
+        if "compass" in self.observation_space.spaces:
+            converted["compass"] = obs["compass"]["angle"].reshape(-1)
+        return converted
+
+    # ------------------------------------------------------------ gym API
+    def seed(self, seed: Optional[int] = None) -> None:
+        self.observation_space.seed(seed)
+        self.action_space.seed(seed)
+
+    def step(self, actions: np.ndarray) -> Tuple[Dict[str, Any], float, bool, bool, Dict[str, Any]]:
+        converted = self._convert_actions(actions)
+        next_pitch = self._pos["pitch"] + converted["camera"][0]
+        next_yaw = ((self._pos["yaw"] + converted["camera"][1]) + 180) % 360 - 180
+        if not (self._pitch_limits[0] <= next_pitch <= self._pitch_limits[1]):
+            converted["camera"] = np.array([0, converted["camera"][1]])
+            next_pitch = self._pos["pitch"]
+
+        obs, reward, done, info = self.env.step(converted)
+        self._pos = {"pitch": next_pitch, "yaw": next_yaw}
+        return self._convert_obs(obs), reward, done, False, info
+
+    def reset(
+        self, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None
+    ) -> Tuple[np.ndarray, Dict[str, Any]]:
+        obs = self.env.reset()
+        self._max_inventory = np.zeros(self.inventory_size)
+        self._sticky_attack_counter = 0
+        self._sticky_jump_counter = 0
+        self._pos = {"pitch": 0.0, "yaw": 0.0}
+        return self._convert_obs(obs), {}
+
+    def render(self, mode: Optional[str] = "rgb_array"):
+        return self.env.render(self._render_mode)

@@ -1,0 +1,71 @@
+"""Super Mario Bros bridge (reference: sheeprl/envs/super_mario_bros.py:26-70).
+
+gym-super-mario-bros is a legacy gym env driven through nes-py's JoypadSpace;
+this bridge exposes it as a gymnasium Env with the framework's dict-obs
+contract ("rgb" key) and splits the legacy done flag into
+terminated/truncated using the in-game timer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple, Union
+
+from sheeprl_tpu.utils.imports import _IS_SUPER_MARIO_BROS_AVAILABLE, require
+
+require(_IS_SUPER_MARIO_BROS_AVAILABLE, "gym_super_mario_bros", "gym-super-mario-bros")
+
+import gym_super_mario_bros as gsmb
+import gymnasium as gym
+import numpy as np
+from gym_super_mario_bros.actions import COMPLEX_MOVEMENT, RIGHT_ONLY, SIMPLE_MOVEMENT
+from nes_py.wrappers import JoypadSpace
+
+ACTIONS_SPACE_MAP = {"simple": SIMPLE_MOVEMENT, "right_only": RIGHT_ONLY, "complex": COMPLEX_MOVEMENT}
+
+
+class _JoypadSpaceSeedable(JoypadSpace):
+    """JoypadSpace whose reset forwards gymnasium's seed/options kwargs."""
+
+    def reset(self, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
+        return self.env.reset(seed=seed, options=options)
+
+
+class SuperMarioBrosWrapper(gym.Env):
+    metadata = {"render_modes": ["rgb_array"], "render_fps": 60}
+
+    def __init__(self, id: str, action_space: str = "simple", render_mode: str = "rgb_array"):
+        if action_space not in ACTIONS_SPACE_MAP:
+            raise ValueError(
+                f"Unknown Mario action space '{action_space}', expected one of {sorted(ACTIONS_SPACE_MAP)}"
+            )
+        self._env = _JoypadSpaceSeedable(gsmb.make(id), ACTIONS_SPACE_MAP[action_space])
+        self.render_mode = render_mode
+
+        inner = self._env.observation_space
+        self.observation_space = gym.spaces.Dict(
+            {"rgb": gym.spaces.Box(inner.low, inner.high, inner.shape, inner.dtype)}
+        )
+        self.action_space = gym.spaces.Discrete(self._env.action_space.n)
+
+    def step(self, action: Union[np.ndarray, int]) -> Tuple[Any, float, bool, bool, Dict[str, Any]]:
+        if isinstance(action, np.ndarray):
+            action = action.squeeze().item()
+        obs, reward, done, info = self._env.step(action)
+        # The NES timer running out is a time limit, not a failure state.
+        timed_out = bool(info.get("time", False))
+        return {"rgb": obs.copy()}, reward, done and not timed_out, done and timed_out, info
+
+    def reset(
+        self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None
+    ) -> Tuple[Any, Dict[str, Any]]:
+        obs = self._env.reset(seed=seed, options=options)
+        return {"rgb": obs.copy()}, {}
+
+    def render(self) -> Optional[np.ndarray]:
+        frame = self._env.render(mode=self.render_mode)
+        if self.render_mode == "rgb_array" and frame is not None:
+            return frame.copy()
+        return None
+
+    def close(self) -> None:
+        self._env.close()

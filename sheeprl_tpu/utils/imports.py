@@ -1,0 +1,38 @@
+"""Optional-dependency availability flags (reference: sheeprl/utils/imports.py).
+
+Each env family ships as an import-gated module: the flag is checked at module
+import time so a missing simulator fails fast with an actionable message, and
+`register_all()` skips the family without breaking the rest of the framework.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+
+
+def module_available(name: str) -> bool:
+    """True when ``name`` can be imported (checked without importing it)."""
+    try:
+        return importlib.util.find_spec(name) is not None
+    except (ModuleNotFoundError, ValueError):
+        return False
+
+
+_IS_ALE_AVAILABLE = module_available("ale_py")
+_IS_CRAFTER_AVAILABLE = module_available("crafter")
+_IS_DIAMBRA_AVAILABLE = module_available("diambra")
+_IS_DIAMBRA_ARENA_AVAILABLE = module_available("diambra.arena")
+_IS_DMC_AVAILABLE = module_available("dm_control")
+_IS_MINEDOJO_AVAILABLE = module_available("minedojo")
+_IS_MINERL_AVAILABLE = module_available("minerl")
+_IS_MLFLOW_AVAILABLE = module_available("mlflow")
+_IS_SUPER_MARIO_BROS_AVAILABLE = module_available("gym_super_mario_bros")
+
+
+def require(flag: bool, package: str, extra: str) -> None:
+    """Raise a uniform gate error for a missing optional simulator."""
+    if not flag:
+        raise ModuleNotFoundError(
+            f"The '{package}' package is required for this environment family but is not "
+            f"installed. Install it (e.g. `pip install {extra}`) to use it."
+        )

@@ -196,6 +196,19 @@ class TestDreamerV3:
     def test_dry_run_continuous(self, tmp_path):
         run(dv3_overrides(**{"env.id": "continuous_dummy", "env.wrapper.id": "continuous_dummy"}))
 
+    def test_dry_run_dmc_pixel_and_vector(self, tmp_path, monkeypatch):
+        # Real dm_control walker-walk with the dual rgb+state observation.
+        pytest.importorskip("dm_control")
+        monkeypatch.setenv("MUJOCO_GL", os.environ.get("MUJOCO_GL", "egl"))
+        args = dv3_overrides(**{"env.num_envs": 1})
+        args = [a for a in args if not a.startswith("env=")]
+        args += [
+            "env=dmc",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[state]",
+        ]
+        run(args)
+
     def test_dry_run_decoupled_rssm(self, tmp_path):
         run(dv3_overrides(**{"algo.world_model.decoupled_rssm": True}))
 
