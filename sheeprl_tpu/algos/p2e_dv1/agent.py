@@ -1,0 +1,128 @@
+"""Plan2Explore on DreamerV1: agent construction
+(reference: sheeprl/algos/p2e_dv1/agent.py:30-155).
+
+Task side is the DV1 agent unchanged; P2E adds an exploration actor, an
+exploration critic (no target network in DV1), and the vmapped disagreement
+ensemble. DV1's ensemble members predict the next OBSERVATION EMBEDDING
+(encoder output) rather than the next stochastic state — the embedding size
+is probed with one dummy encoder application at build time.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v1.agent import DV1Agent, build_agent as dv1_build_agent
+from sheeprl_tpu.algos.dreamer_v3.agent import trunc_normal_init
+from sheeprl_tpu.models import MLP
+
+
+@dataclass(frozen=True)
+class P2EDV1Agent:
+    dv1: DV1Agent
+    ensemble: MLP
+    n_ensembles: int
+
+    @property
+    def actor(self):
+        return self.dv1.actor
+
+    @property
+    def world_model(self):
+        return self.dv1.world_model
+
+    @property
+    def actor_spec(self):
+        return self.dv1.actor_spec
+
+    @property
+    def actions_dim(self):
+        return self.dv1.actions_dim
+
+    def ensemble_apply(self, stacked_params, x: jax.Array) -> jax.Array:
+        return jax.vmap(lambda p: self.ensemble.apply(p, x))(stacked_params)
+
+
+def build_agent(
+    runtime,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+    world_model_state: Optional[Any] = None,
+    ensembles_state: Optional[Any] = None,
+    actor_task_state: Optional[Any] = None,
+    critic_task_state: Optional[Any] = None,
+    actor_exploration_state: Optional[Any] = None,
+    critic_exploration_state: Optional[Any] = None,
+) -> Tuple[P2EDV1Agent, Dict[str, Any]]:
+    dv1_agent, dv1_state = dv1_build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        obs_space,
+        world_model_state,
+        actor_task_state,
+        critic_task_state,
+    )
+    wm_cfg = cfg.algo.world_model
+    latent_state_size = int(wm_cfg.stochastic_size) + int(wm_cfg.recurrent_model.recurrent_state_size)
+    dtype = runtime.precision.compute_dtype
+
+    # Probe the encoder embedding size (the ensemble's regression target).
+    dummy_obs = {
+        k: jnp.zeros((1, *obs_space[k].shape), jnp.float32)
+        for k in list(cfg.algo.cnn_keys.encoder) + list(cfg.algo.mlp_keys.encoder)
+    }
+    embed_dim = int(
+        dv1_agent.wm(dv1_state["world_model"], dummy_obs, method="embed_obs").shape[-1]
+    )
+
+    ens_cfg = cfg.algo.ensembles
+    ensemble = MLP(
+        hidden_sizes=[int(ens_cfg.dense_units)] * int(ens_cfg.mlp_layers),
+        output_dim=embed_dim,
+        activation="elu",
+        kernel_init=trunc_normal_init,
+        dtype=dtype,
+    )
+    agent = P2EDV1Agent(dv1=dv1_agent, ensemble=ensemble, n_ensembles=int(ens_cfg.n))
+
+    k_actor_expl, k_critic_expl, k_ens = jax.random.split(jax.random.fold_in(runtime.root_key, 3), 3)
+    dummy_latent = jnp.zeros((1, latent_state_size), jnp.float32)
+
+    if actor_exploration_state is not None:
+        actor_expl_params = jax.tree_util.tree_map(jnp.asarray, actor_exploration_state)
+    else:
+        actor_expl_params = dv1_agent.actor.init(k_actor_expl, dummy_latent)
+
+    if critic_exploration_state is not None:
+        critic_expl_params = jax.tree_util.tree_map(jnp.asarray, critic_exploration_state)
+    else:
+        critic_expl_params = dv1_agent.critic.init(k_critic_expl, dummy_latent)
+
+    ens_in = int(np.sum(actions_dim)) + latent_state_size
+    if ensembles_state is not None:
+        ens_params = jax.tree_util.tree_map(jnp.asarray, ensembles_state)
+    else:
+        dummy_ens = jnp.zeros((1, ens_in), jnp.float32)
+        ens_params = jax.vmap(lambda k: ensemble.init(k, dummy_ens))(
+            jax.random.split(k_ens, int(ens_cfg.n))
+        )
+
+    state = {
+        "world_model": dv1_state["world_model"],
+        "actor_task": dv1_state["actor"],
+        "critic_task": dv1_state["critic"],
+        "actor_exploration": actor_expl_params,
+        "critic_exploration": critic_expl_params,
+        "ensembles": ens_params,
+    }
+    return agent, state

@@ -1,0 +1,46 @@
+"""P2E-DV1 evaluation entrypoint (reference: sheeprl/algos/p2e_dv1/evaluate.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.algos.dreamer_v1.agent import build_agent as dv1_build_agent
+from sheeprl_tpu.algos.dreamer_v1.utils import test
+from sheeprl_tpu.algos.ppo.agent import actions_metadata
+from sheeprl_tpu.registry import register_evaluation
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+
+
+@register_evaluation(algorithms=["p2e_dv1_exploration", "p2e_dv1_finetuning"])
+def evaluate_p2e_dv1(runtime, cfg: Dict[str, Any], state: Dict[str, Any]):
+    logger = get_logger(runtime, cfg)
+    if logger is not None:
+        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
+    runtime.print(f"Log dir: {log_dir}")
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    observation_space = env.observation_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    actions_dim, is_continuous = actions_metadata(env.action_space)
+    env.close()
+
+    agent, agent_state = dv1_build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        observation_space,
+        state["world_model"],
+        state["actor_task"],
+        state["critic_task"],
+    )
+    if cfg.algo.player.actor_type == "exploration":
+        agent_state["actor"] = jax.tree_util.tree_map(jnp.asarray, state["actor_exploration"])
+    test(agent, agent_state, runtime, cfg, log_dir, logger)

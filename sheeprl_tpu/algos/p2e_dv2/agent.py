@@ -1,0 +1,130 @@
+"""Plan2Explore on DreamerV2: agent construction
+(reference: sheeprl/algos/p2e_dv2/agent.py:33-209).
+
+Task side is the DV2 agent unchanged; P2E adds an exploration actor, an
+exploration critic with its own target network, and the vmapped disagreement
+ensemble (members predict the next stochastic state from latent + action).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v2.agent import DV2Agent, build_agent as dv2_build_agent
+from sheeprl_tpu.algos.dreamer_v3.agent import trunc_normal_init
+from sheeprl_tpu.models import MLP
+
+
+@dataclass(frozen=True)
+class P2EDV2Agent:
+    dv2: DV2Agent
+    ensemble: MLP
+    n_ensembles: int
+
+    @property
+    def actor(self):
+        return self.dv2.actor
+
+    @property
+    def world_model(self):
+        return self.dv2.world_model
+
+    @property
+    def actor_spec(self):
+        return self.dv2.actor_spec
+
+    @property
+    def actions_dim(self):
+        return self.dv2.actions_dim
+
+    def ensemble_apply(self, stacked_params, x: jax.Array) -> jax.Array:
+        return jax.vmap(lambda p: self.ensemble.apply(p, x))(stacked_params)
+
+
+def build_agent(
+    runtime,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+    world_model_state: Optional[Any] = None,
+    ensembles_state: Optional[Any] = None,
+    actor_task_state: Optional[Any] = None,
+    critic_task_state: Optional[Any] = None,
+    target_critic_task_state: Optional[Any] = None,
+    actor_exploration_state: Optional[Any] = None,
+    critic_exploration_state: Optional[Any] = None,
+    target_critic_exploration_state: Optional[Any] = None,
+) -> Tuple[P2EDV2Agent, Dict[str, Any]]:
+    dv2_agent, dv2_state = dv2_build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        obs_space,
+        world_model_state,
+        actor_task_state,
+        critic_task_state,
+        target_critic_task_state,
+    )
+    wm_cfg = cfg.algo.world_model
+    stoch_state_size = int(wm_cfg.stochastic_size) * int(wm_cfg.discrete_size)
+    latent_state_size = stoch_state_size + int(wm_cfg.recurrent_model.recurrent_state_size)
+    dtype = runtime.precision.compute_dtype
+
+    ens_cfg = cfg.algo.ensembles
+    use_ln = bool(ens_cfg.get("layer_norm", False))
+    ensemble = MLP(
+        hidden_sizes=[int(ens_cfg.dense_units)] * int(ens_cfg.mlp_layers),
+        output_dim=stoch_state_size,
+        activation="elu",
+        norm_layer="layer_norm" if use_ln else None,
+        norm_args={"eps": 1e-3} if use_ln else {},
+        kernel_init=trunc_normal_init,
+        dtype=dtype,
+    )
+    agent = P2EDV2Agent(dv2=dv2_agent, ensemble=ensemble, n_ensembles=int(ens_cfg.n))
+
+    k_actor_expl, k_critic_expl, k_ens = jax.random.split(jax.random.fold_in(runtime.root_key, 2), 3)
+    dummy_latent = jnp.zeros((1, latent_state_size), jnp.float32)
+
+    if actor_exploration_state is not None:
+        actor_expl_params = jax.tree_util.tree_map(jnp.asarray, actor_exploration_state)
+    else:
+        actor_expl_params = dv2_agent.actor.init(k_actor_expl, dummy_latent)
+
+    if critic_exploration_state is not None:
+        critic_expl_params = jax.tree_util.tree_map(jnp.asarray, critic_exploration_state)
+    else:
+        critic_expl_params = dv2_agent.critic.init(k_critic_expl, dummy_latent)
+    if target_critic_exploration_state is not None:
+        target_critic_expl_params = jax.tree_util.tree_map(jnp.asarray, target_critic_exploration_state)
+    else:
+        target_critic_expl_params = jax.tree_util.tree_map(jnp.copy, critic_expl_params)
+
+    ens_in = int(np.sum(actions_dim)) + latent_state_size
+    if ensembles_state is not None:
+        ens_params = jax.tree_util.tree_map(jnp.asarray, ensembles_state)
+    else:
+        dummy_ens = jnp.zeros((1, ens_in), jnp.float32)
+        ens_params = jax.vmap(lambda k: ensemble.init(k, dummy_ens))(
+            jax.random.split(k_ens, int(ens_cfg.n))
+        )
+
+    state = {
+        "world_model": dv2_state["world_model"],
+        "actor_task": dv2_state["actor"],
+        "critic_task": dv2_state["critic"],
+        "target_critic_task": dv2_state["target_critic"],
+        "actor_exploration": actor_expl_params,
+        "critic_exploration": critic_expl_params,
+        "target_critic_exploration": target_critic_expl_params,
+        "ensembles": ens_params,
+    }
+    return agent, state

@@ -1,0 +1,182 @@
+"""Plan2Explore on DreamerV3: agent construction
+(reference: sheeprl/algos/p2e_dv3/agent.py:28-223).
+
+Everything task-side is the DV3 agent unchanged. P2E adds:
+
+- an *exploration actor* (same Actor module, separate params),
+- a dict of *exploration critics* (same TwoHot critic MLP definition; each
+  entry carries a weight, a reward type — "intrinsic" or "task" — plus its
+  own params and target params),
+- an *ensemble* of N next-latent predictors whose disagreement (variance of
+  their predictions) is the intrinsic reward. TPU-first layout: the ensemble
+  is ONE MLP definition with params stacked along a leading member axis
+  (initialized from N different seeds) and applied with `jax.vmap` — one
+  batched matmul per layer instead of N small ones.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v3.agent import (
+    DV3Agent,
+    _ln_cfg,
+    build_agent as dv3_build_agent,
+    trunc_normal_init,
+    uniform_init,
+)
+from sheeprl_tpu.models import MLP
+
+
+@dataclass(frozen=True)
+class P2EDV3Agent:
+    """DV3Agent + the exploration-side modules. Static module definitions
+    only; all params live in the separate state pytree."""
+
+    dv3: DV3Agent
+    ensemble: MLP  # one member's definition; params are stacked [N, ...]
+    n_ensembles: int
+    # name -> {"weight": float, "reward_type": "intrinsic"|"task"} (static)
+    critics_exploration: Dict[str, Dict[str, Any]]
+
+    @property
+    def actor(self):
+        return self.dv3.actor
+
+    @property
+    def world_model(self):
+        return self.dv3.world_model
+
+    @property
+    def actor_spec(self):
+        return self.dv3.actor_spec
+
+    @property
+    def actions_dim(self):
+        return self.dv3.actions_dim
+
+    def ensemble_apply(self, stacked_params, x: jax.Array) -> jax.Array:
+        """Apply all N members to the same input: [N, *x.shape[:-1], out]."""
+        return jax.vmap(lambda p: self.ensemble.apply(p, x))(stacked_params)
+
+    def exploration_critic_logits(self, params, latent: jax.Array) -> jax.Array:
+        return self.dv3.critic.apply(params, latent)
+
+
+def build_agent(
+    runtime,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+    world_model_state: Optional[Any] = None,
+    ensembles_state: Optional[Any] = None,
+    actor_task_state: Optional[Any] = None,
+    critic_task_state: Optional[Any] = None,
+    target_critic_task_state: Optional[Any] = None,
+    actor_exploration_state: Optional[Any] = None,
+    critics_exploration_state: Optional[Any] = None,
+) -> Tuple[P2EDV3Agent, Dict[str, Any]]:
+    """Construct task + exploration modules and their initial (or restored)
+    params. State keys: world_model, actor_task, critic_task,
+    target_critic_task, actor_exploration, critics_exploration ({name:
+    {"module", "target_module"}}), ensembles (stacked)."""
+    dv3_agent, dv3_state = dv3_build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        obs_space,
+        world_model_state,
+        actor_task_state,
+        critic_task_state,
+        target_critic_task_state,
+    )
+    wm_cfg = cfg.algo.world_model
+    stoch_state_size = int(wm_cfg.stochastic_size) * int(wm_cfg.discrete_size)
+    latent_state_size = stoch_state_size + int(wm_cfg.recurrent_model.recurrent_state_size)
+    dtype = runtime.precision.compute_dtype
+
+    # Static exploration-critic table; only critics with weight > 0 exist.
+    critics_cfg: Dict[str, Dict[str, Any]] = {}
+    intrinsic_critics = 0
+    for k, v in cfg.algo.critics_exploration.items():
+        if v.weight > 0:
+            if v.reward_type not in ("intrinsic", "task"):
+                raise ValueError(
+                    f"Exploration critic '{k}' has unknown reward_type '{v.reward_type}' "
+                    "(valid: intrinsic | task)"
+                )
+            intrinsic_critics += v.reward_type == "intrinsic"
+            critics_cfg[k] = {"weight": float(v.weight), "reward_type": str(v.reward_type)}
+    if intrinsic_critics == 0:
+        raise RuntimeError("You must specify at least one intrinsic critic (`reward_type='intrinsic'`)")
+
+    ens_cfg = cfg.algo.ensembles
+    ens_ln, ens_ln_kw = _ln_cfg(ens_cfg.get("layer_norm", {}))
+    ensemble = MLP(
+        hidden_sizes=[int(ens_cfg.dense_units)] * int(ens_cfg.mlp_layers),
+        output_dim=stoch_state_size,
+        activation="silu",
+        layer_args={"bias": ens_ln is None},
+        norm_layer=ens_ln,
+        norm_args=ens_ln_kw,
+        kernel_init=trunc_normal_init,
+        dtype=dtype,
+    )
+
+    agent = P2EDV3Agent(
+        dv3=dv3_agent,
+        ensemble=ensemble,
+        n_ensembles=int(ens_cfg.n),
+        critics_exploration=critics_cfg,
+    )
+
+    k_actor_expl, k_critics, k_ens = jax.random.split(jax.random.fold_in(runtime.root_key, 1), 3)
+    dummy_latent = jnp.zeros((1, latent_state_size), jnp.float32)
+
+    # Exploration actor: same module as the task actor, fresh params.
+    if actor_exploration_state is not None:
+        actor_expl_params = jax.tree_util.tree_map(jnp.asarray, actor_exploration_state)
+    else:
+        actor_expl_params = dv3_agent.actor.init(k_actor_expl, dummy_latent)
+
+    # Exploration critics + their targets.
+    critics_state: Dict[str, Dict[str, Any]] = {}
+    for i, name in enumerate(sorted(critics_cfg)):
+        if critics_exploration_state is not None and name in critics_exploration_state:
+            module = jax.tree_util.tree_map(jnp.asarray, critics_exploration_state[name]["module"])
+            target = jax.tree_util.tree_map(
+                jnp.asarray, critics_exploration_state[name]["target_module"]
+            )
+        else:
+            module = dv3_agent.critic.init(jax.random.fold_in(k_critics, i), dummy_latent)
+            target = jax.tree_util.tree_map(jnp.copy, module)
+        critics_state[name] = {"module": module, "target_module": target}
+
+    # Ensemble members initialized from different seeds so they disagree.
+    ens_in = int(np.sum(actions_dim)) + latent_state_size
+    if ensembles_state is not None:
+        ens_params = jax.tree_util.tree_map(jnp.asarray, ensembles_state)
+    else:
+        dummy_ens = jnp.zeros((1, ens_in), jnp.float32)
+        ens_params = jax.vmap(lambda k: ensemble.init(k, dummy_ens))(
+            jax.random.split(k_ens, int(ens_cfg.n))
+        )
+
+    state = {
+        "world_model": dv3_state["world_model"],
+        "actor_task": dv3_state["actor"],
+        "critic_task": dv3_state["critic"],
+        "target_critic_task": dv3_state["target_critic"],
+        "actor_exploration": actor_expl_params,
+        "critics_exploration": critics_state,
+        "ensembles": ens_params,
+    }
+    return agent, state

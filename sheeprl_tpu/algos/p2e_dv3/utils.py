@@ -1,0 +1,49 @@
+"""P2E-DV3 auxiliary contract (reference: sheeprl/algos/p2e_dv3/utils.py)."""
+
+from __future__ import annotations
+
+from sheeprl_tpu.algos.dreamer_v3.utils import (  # noqa: F401 (re-export)
+    AGGREGATOR_KEYS as AGGREGATOR_KEYS_DV3,
+    prepare_obs,
+    test,
+)
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/policy_loss_task",
+    "Loss/value_loss_task",
+    "Loss/policy_loss_exploration",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "Loss/ensemble_loss",
+    "State/kl",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "Grads/world_model",
+    "Grads/actor_task",
+    "Grads/critic_task",
+    "Grads/actor_exploration",
+    "Grads/ensemble",
+    # Template names: expanded per exploration critic at startup
+    # ("<key>_<critic_name>").
+    "Loss/value_loss_exploration",
+    "Values_exploration/predicted_values",
+    "Values_exploration/lambda_values",
+    "Grads/critic_exploration",
+    "Rewards/intrinsic",
+}.union(AGGREGATOR_KEYS_DV3)
+
+MODELS_TO_REGISTER = {
+    "world_model",
+    "ensembles",
+    "actor_exploration",
+    "critics_exploration",
+    "actor_task",
+    "critic_task",
+    "target_critic_task",
+    "moments",
+}

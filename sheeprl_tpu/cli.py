@@ -25,14 +25,19 @@ from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import dotdict, print_config
 
 
+def _load_ckpt_config(ckpt_path: pathlib.Path) -> dotdict:
+    """Load the config.yaml saved next to a run's checkpoint directory."""
+    import yaml
+
+    with open(ckpt_path.parent.parent / "config.yaml") as fp:
+        return dotdict(yaml.safe_load(fp))
+
+
 def resume_from_checkpoint(cfg: dotdict) -> dotdict:
     """Force-merge the original run's config.yaml, keeping the new run's
     total_steps/paths (reference: cli.py:23-57)."""
-    import yaml
-
     ckpt_path = pathlib.Path(cfg.checkpoint.resume_from)
-    with open(ckpt_path.parent.parent / "config.yaml") as fp:
-        old_cfg = dotdict(yaml.safe_load(fp))
+    old_cfg = _load_ckpt_config(ckpt_path)
     if old_cfg.env.id != cfg.env.id:
         raise ValueError(
             "This experiment is run with a different environment from the one of the experiment you want to restart. "
@@ -134,6 +139,53 @@ def run_algorithm(cfg: dotdict) -> None:
 
     _prune_metric_and_model_keys(cfg, utils_module)
 
+    kwargs = {}
+    if "finetuning" in cfg.algo.name and "p2e" in entry.module:
+        # P2E chaining: the finetuning phase inherits the exploration run's
+        # environment setup from the checkpoint's saved config
+        # (reference: cli.py:117-148).
+        expl_ckpt = cfg.checkpoint.get("exploration_ckpt_path")
+        if not expl_ckpt or str(expl_ckpt) == "???":
+            raise ValueError(
+                "P2E finetuning needs the exploration phase's checkpoint: set "
+                "'checkpoint.exploration_ckpt_path=<path-to-exploration-ckpt>'."
+            )
+        ckpt_path = pathlib.Path(expl_ckpt)
+        exploration_cfg = _load_ckpt_config(ckpt_path)
+        if exploration_cfg.env.id != cfg.env.id:
+            raise ValueError(
+                "This experiment is run with a different environment from "
+                "the one of the exploration you want to finetune. "
+                f"Got '{cfg.env.id}', but the environment used during exploration "
+                f"was {exploration_cfg.env.id}. "
+                "Set properly the environment for finetuning the experiment."
+            )
+        kwargs["exploration_cfg"] = exploration_cfg
+        for env_key in (
+            "frame_stack",
+            "screen_size",
+            "action_repeat",
+            "grayscale",
+            "clip_rewards",
+            "frame_stack_dilation",
+            "max_episode_steps",
+            "reward_as_observation",
+        ):
+            cfg.env[env_key] = exploration_cfg.env[env_key]
+        _env_target = str(cfg.env.wrapper.get("_target_", "")).lower()
+        if "minerl" in _env_target or "minedojo" in _env_target:
+            for env_key in (
+                "max_pitch",
+                "min_pitch",
+                "sticky_jump",
+                "sticky_attack",
+                "break_speed_multiplier",
+            ):
+                cfg.env[env_key] = exploration_cfg.env[env_key]
+        if cfg.buffer.load_from_exploration:
+            cfg.fabric.devices = exploration_cfg.fabric.devices
+            cfg.fabric.num_nodes = exploration_cfg.fabric.num_nodes
+
     runtime = instantiate(cfg.fabric)
     runtime.launch()
     runtime.seed_everything(cfg.seed)
@@ -143,7 +195,7 @@ def run_algorithm(cfg: dotdict) -> None:
     # host may pin a different default backend, e.g. a tunneled TPU while the
     # config selects cpu or vice versa).
     with jax.default_device(runtime.device):
-        command(runtime, cfg)
+        command(runtime, cfg, **kwargs)
 
 
 def run(args: Optional[Sequence[str]] = None) -> None:

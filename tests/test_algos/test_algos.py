@@ -454,3 +454,61 @@ class TestPPODecoupled:
         checkpoint_eval_resume_roundtrip(
             lambda **e: ppo_decoupled_overrides(**{"fabric.devices": 2, **e}), tmp_path
         )
+
+
+def p2e_overrides(exp, **extra):
+    """Tiny P2E dry-run config: the matching Dreamer tiny sizes + a micro
+    disagreement ensemble."""
+    base = {
+        "p2e_dv1_exploration": "dreamer_v1",
+        "p2e_dv1_finetuning": "dreamer_v1",
+        "p2e_dv2_exploration": "dreamer_v2",
+        "p2e_dv2_finetuning": "dreamer_v2",
+        "p2e_dv3_exploration": "dreamer_v3",
+        "p2e_dv3_finetuning": "dreamer_v3",
+    }[exp]
+    args = [a for a in dreamer_overrides(base) if not a.startswith("exp=")]
+    args.insert(0, f"exp={exp}")
+    args += [
+        "algo.ensembles.n=3",
+        "algo.ensembles.dense_units=8",
+        "algo.ensembles.mlp_layers=1",
+    ]
+    for k, v in extra.items():
+        args.append(f"{k}={v}")
+    return args
+
+
+class TestPlan2Explore:
+    @pytest.mark.parametrize("version", ["dv1", "dv2", "dv3"])
+    def test_exploration_then_finetuning_chain(self, tmp_path, version):
+        expl_args = p2e_overrides(f"p2e_{version}_exploration", **{"checkpoint.save_last": True})
+        expl_args = [a for a in expl_args if not a.startswith("checkpoint.every")]
+        run(expl_args)
+        ckpts = find_checkpoints(tmp_path / "logs")
+        assert ckpts, "no exploration checkpoint written"
+        # Evaluate the exploration checkpoint (plays the exploration actor)
+        evaluation([f"checkpoint_path={ckpts[-1]}", "fabric.accelerator=cpu"])
+        # Finetune from the exploration checkpoint (ckpt-inheriting chain),
+        # saving the finetuning phase's own checkpoint
+        fin_args = p2e_overrides(f"p2e_{version}_finetuning", **{"checkpoint.save_last": True})
+        fin_args = [a for a in fin_args if not a.startswith("checkpoint.every")]
+        fin_args.append(f"checkpoint.exploration_ckpt_path={ckpts[-1]}")
+        run(fin_args)
+        fin_ckpts = [c for c in find_checkpoints(tmp_path / "logs") if "finetuning" in c]
+        assert fin_ckpts, "no finetuning checkpoint written"
+        # Evaluate + resume the interrupted finetuning phase
+        evaluation([f"checkpoint_path={fin_ckpts[-1]}", "fabric.accelerator=cpu"])
+        resume_args = p2e_overrides(f"p2e_{version}_finetuning")
+        resume_args.append(f"checkpoint.exploration_ckpt_path={ckpts[-1]}")
+        resume_args.append(f"checkpoint.resume_from={fin_ckpts[-1]}")
+        run(resume_args)
+
+    def test_finetuning_without_exploration_ckpt_fails(self, tmp_path):
+        with pytest.raises(ValueError, match="exploration_ckpt_path"):
+            run(p2e_overrides("p2e_dv3_finetuning"))
+
+    def test_exploration_resume_roundtrip(self, tmp_path):
+        checkpoint_eval_resume_roundtrip(
+            lambda **e: p2e_overrides("p2e_dv3_exploration", **e), tmp_path
+        )
