@@ -1,0 +1,150 @@
+"""DroQ agent (flax): SAC with Dropout+LayerNorm Q networks
+(reference: sheeprl/algos/droq/agent.py:20-278; architecture from
+https://arxiv.org/abs/2110.02034).
+
+Same TPU structure as the SAC agent: the critic ensemble is ONE module
+vmapped over a leading member axis (params AND dropout rngs split per
+member), target critics are a params copy EMA'd by tree_map. The reference's
+sequential per-critic MSE updates against a fixed target collapse to one
+joint update — the per-critic losses touch disjoint parameters, so the
+gradients are identical and Adam moments are per-parameter anyway.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from math import prod
+from typing import Any, Dict, Optional, Tuple
+
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from sheeprl_tpu.algos.sac.agent import SACActorModule, SACAgent
+from sheeprl_tpu.models import MLP
+
+
+class DROQCriticModule(nn.Module):
+    """Q(obs, act) MLP with per-layer Dropout and LayerNorm
+    (reference: DROQCritic, agent.py:20-61)."""
+
+    hidden_size: int = 256
+    num_critics: int = 1
+    dropout: float = 0.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, action: jax.Array, deterministic: bool = True) -> jax.Array:
+        x = jnp.concatenate([obs, action], axis=-1)
+        return MLP(
+            hidden_sizes=(self.hidden_size, self.hidden_size),
+            output_dim=self.num_critics,
+            activation="relu",
+            dropout=self.dropout if self.dropout > 0 else None,
+            norm_layer="layer_norm",
+            norm_args={},
+            dtype=self.dtype,
+            name="model",
+        )(x, deterministic=deterministic)
+
+
+class DROQCriticEnsemble(nn.Module):
+    """N independent DroQ critics vmapped into one module; dropout rngs are
+    split per member so every critic draws its own masks."""
+
+    n: int
+    hidden_size: int = 256
+    dropout: float = 0.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, action: jax.Array, deterministic: bool = True) -> jax.Array:
+        ensemble = nn.vmap(
+            DROQCriticModule,
+            in_axes=None,
+            out_axes=-1,
+            axis_size=self.n,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+        )(
+            hidden_size=self.hidden_size,
+            num_critics=1,
+            dropout=self.dropout,
+            dtype=self.dtype,
+            name="qfs",
+        )
+        return ensemble(obs, action, deterministic)[..., 0, :]  # [B, 1, n] -> [B, n]
+
+
+@dataclass(frozen=True)
+class DROQAgent(SACAgent):
+    """SACAgent with dropout-aware Q methods; the actor-side helpers
+    (actions_and_log_probs, get_actions, target_ema) are inherited. Train
+    state dict: {actor, qfs, qfs_target, log_alpha}."""
+
+    def q_values(
+        self, qf_params, obs: jax.Array, action: jax.Array, dropout_key: Optional[jax.Array] = None
+    ) -> jax.Array:
+        if dropout_key is None:
+            return self.critics.apply(qf_params, obs, action, True)
+        return self.critics.apply(qf_params, obs, action, False, rngs={"dropout": dropout_key})
+
+    def next_target_q_values(
+        self, state: Dict[str, Any], next_obs, rewards, terminated, gamma: float, key: jax.Array
+    ) -> jax.Array:
+        """Soft Bellman target with live dropout in the target critics
+        (reference: get_next_target_q_values, agent.py:196-202 — the modules
+        stay in train mode)."""
+        k_act, k_drop = jax.random.split(key)
+        next_actions, next_log_pi = self.actions_and_log_probs(state["actor"], next_obs, k_act)
+        qf_next = self.q_values(state["qfs_target"], next_obs, next_actions, dropout_key=k_drop)
+        alpha = jnp.exp(state["log_alpha"])
+        min_qf_next = jnp.min(qf_next, axis=-1, keepdims=True) - alpha * next_log_pi
+        return rewards + (1 - terminated) * gamma * min_qf_next
+
+
+def build_agent(
+    runtime,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+    action_space: gymnasium.spaces.Box,
+    agent_state: Optional[Dict[str, Any]] = None,
+) -> Tuple[DROQAgent, Dict[str, Any]]:
+    """Construct modules + initial (or restored) train state
+    (reference: build_agent, agent.py:212-278)."""
+    act_dim = int(prod(action_space.shape))
+    obs_dim = int(sum(prod(obs_space[k].shape) for k in cfg.algo.mlp_keys.encoder))
+    dtype = runtime.precision.compute_dtype
+    actor = SACActorModule(action_dim=act_dim, hidden_size=cfg.algo.actor.hidden_size, dtype=dtype)
+    critics = DROQCriticEnsemble(
+        n=cfg.algo.critic.n,
+        hidden_size=cfg.algo.critic.hidden_size,
+        dropout=float(cfg.algo.critic.dropout),
+        dtype=dtype,
+    )
+    agent = DROQAgent(
+        actor=actor,
+        critics=critics,
+        action_scale=np.asarray((action_space.high - action_space.low) / 2.0, np.float32),
+        action_bias=np.asarray((action_space.high + action_space.low) / 2.0, np.float32),
+        target_entropy=float(-act_dim),
+        tau=float(cfg.algo.tau),
+        num_critics=int(cfg.algo.critic.n),
+    )
+    if agent_state is not None:
+        state = jax.tree_util.tree_map(jnp.asarray, agent_state)
+    else:
+        k_actor, k_qfs = jax.random.split(runtime.root_key)
+        dummy_obs = jnp.zeros((1, obs_dim), jnp.float32)
+        dummy_act = jnp.zeros((1, act_dim), jnp.float32)
+        actor_params = actor.init(k_actor, dummy_obs)
+        qf_params = critics.init(k_qfs, dummy_obs, dummy_act)
+        state = {
+            "actor": actor_params,
+            "qfs": qf_params,
+            "qfs_target": jax.tree_util.tree_map(jnp.copy, qf_params),
+            "log_alpha": jnp.log(jnp.asarray([float(cfg.algo.alpha.alpha)], jnp.float32)),
+        }
+    return agent, state

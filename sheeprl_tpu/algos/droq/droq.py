@@ -1,0 +1,409 @@
+"""DroQ training loop (reference: sheeprl/algos/droq/droq.py:31-436).
+
+SAC's loop with the DroQ recipe (https://arxiv.org/abs/2110.02034): a high
+replay ratio (20 gradient steps per env step by default), Dropout+LayerNorm
+critics with live dropout in online AND target networks, target EMA after
+every critic update, and the actor trained on the ensemble MEAN of the
+Q-values over a separately sampled batch. One jitted, donated call runs the
+G critic minibatches as a `lax.scan` followed by the single actor/alpha
+update — the reference's python loop of G x num_critics backward passes
+becomes one compiled program.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import warnings
+from functools import partial
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sheeprl_tpu.algos.droq.agent import DROQAgent, build_agent
+from sheeprl_tpu.algos.droq.utils import prepare_obs, test
+from sheeprl_tpu.algos.sac.loss import entropy_loss, policy_loss
+from sheeprl_tpu.algos.sac.sac import _make_optimizer
+from sheeprl_tpu.config.instantiate import instantiate
+from sheeprl_tpu.core.mesh import DATA_AXIS
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.registry import register_algorithm
+from sheeprl_tpu.utils.checkpoint import load_checkpoint, restore_opt_state, save_checkpoint
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import Ratio, save_configs
+
+
+def make_train_step(agent: DROQAgent, txs: Dict[str, optax.GradientTransformation], cfg: Dict[str, Any], mesh):
+    """Build the jitted (G critic steps + 1 actor step) update."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    gamma = float(cfg.algo.gamma)
+    batch_sharding = NamedSharding(mesh, P(None, DATA_AXIS))
+    flat_sharding = NamedSharding(mesh, P(DATA_AXIS))
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(state, opt_states, critic_data, actor_data, key):
+        """critic_data: dict of [G, B, ...]; actor_data: dict of [B, ...]."""
+
+        def critic_step(carry, batch):
+            state, qf_opt = carry
+            k_target, k_drop = jax.random.split(batch.pop("_key"))
+
+            # Fixed soft target for this minibatch (reference: droq.py:99-104)
+            next_target = agent.next_target_q_values(
+                state, batch["next_observations"], batch["rewards"], batch["terminated"], gamma, k_target
+            )
+
+            def qf_loss_fn(qf_params):
+                qf_values = agent.q_values(
+                    qf_params, batch["observations"], batch["actions"], dropout_key=k_drop
+                )
+                # Per-member MSE against the shared target, summed: identical
+                # gradients to the reference's sequential per-critic steps.
+                return ((qf_values - next_target) ** 2).mean(0).sum()
+
+            qf_l, qf_grads = jax.value_and_grad(qf_loss_fn)(state["qfs"])
+            qf_updates, qf_opt = txs["qf"].update(qf_grads, qf_opt, state["qfs"])
+            state["qfs"] = optax.apply_updates(state["qfs"], qf_updates)
+            # EMA after every critic update (reference: droq.py:117)
+            state["qfs_target"] = agent.target_ema(state["qfs"], state["qfs_target"])
+            return (state, qf_opt), qf_l
+
+        critic_data = jax.lax.with_sharding_constraint(
+            critic_data, {k: batch_sharding for k in critic_data}
+        )
+        actor_data = jax.lax.with_sharding_constraint(
+            actor_data, {k: flat_sharding for k in actor_data}
+        )
+        k_scan, k_actor, k_actor_drop = jax.random.split(key, 3)
+        keys = jax.random.split(k_scan, critic_data["rewards"].shape[0])
+        critic_data = dict(critic_data, _key=keys)
+        (state, qf_opt), qf_losses = jax.lax.scan(
+            critic_step, (state, opt_states["qf"]), critic_data
+        )
+
+        # ----------------------------- actor + alpha (reference: droq.py:120-134)
+        alpha = jnp.exp(state["log_alpha"])
+
+        def actor_loss_fn(actor_params):
+            actions, logprobs = agent.actions_and_log_probs(
+                actor_params, actor_data["observations"], k_actor
+            )
+            qf_values = agent.q_values(
+                state["qfs"], actor_data["observations"], actions, dropout_key=k_actor_drop
+            )
+            mean_qf = jnp.mean(qf_values, axis=-1, keepdims=True)
+            return policy_loss(alpha, logprobs, mean_qf), logprobs
+
+        (actor_l, logprobs), actor_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(state["actor"])
+        actor_updates, actor_opt = txs["actor"].update(actor_grads, opt_states["actor"], state["actor"])
+        state["actor"] = optax.apply_updates(state["actor"], actor_updates)
+
+        def alpha_loss_fn(log_alpha):
+            return entropy_loss(log_alpha, logprobs, agent.target_entropy)
+
+        alpha_l, alpha_grads = jax.value_and_grad(alpha_loss_fn)(state["log_alpha"])
+        alpha_updates, alpha_opt = txs["alpha"].update(alpha_grads, opt_states["alpha"], state["log_alpha"])
+        state["log_alpha"] = optax.apply_updates(state["log_alpha"], alpha_updates)
+
+        opt_states = {"qf": qf_opt, "actor": actor_opt, "alpha": alpha_opt}
+        return state, opt_states, {
+            "value_loss": qf_losses.mean(),
+            "policy_loss": actor_l,
+            "alpha_loss": alpha_l,
+        }
+
+    return train_step
+
+
+@register_algorithm()
+def main(runtime, cfg: Dict[str, Any]):
+    mesh = runtime.mesh
+    rank = runtime.global_rank
+    world_size = jax.process_count()
+
+    if "minedojo" in str(cfg.env.wrapper.get("_target_", "")).lower():
+        raise ValueError(
+            "MineDojo is not currently supported by DroQ agent, since it does not take "
+            "into consideration the action masks provided by the environment, but needed "
+            "in order to play correctly the game. "
+            "As an alternative you can use one of the Dreamers' agents."
+        )
+
+    state_ckpt = None
+    if cfg.checkpoint.resume_from:
+        state_ckpt = load_checkpoint(cfg.checkpoint.resume_from)
+
+    if len(cfg.algo.cnn_keys.encoder) > 0:
+        warnings.warn("DroQ algorithm cannot allow to use images as observations, the CNN keys will be ignored")
+        cfg.algo.cnn_keys.encoder = []
+
+    logger = get_logger(runtime, cfg)
+    if logger is not None:
+        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
+    runtime.print(f"Log dir: {log_dir}")
+
+    vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
+    envs = vectorized_env(
+        [
+            make_env(
+                cfg,
+                cfg.seed + rank * cfg.env.num_envs + i,
+                rank * cfg.env.num_envs,
+                log_dir if rank == 0 else None,
+                "train",
+                vector_env_idx=i,
+            )
+            for i in range(cfg.env.num_envs)
+        ],
+        autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
+    )
+    action_space = envs.single_action_space
+    observation_space = envs.single_observation_space
+    if not isinstance(action_space, gym.spaces.Box):
+        raise ValueError("Only continuous action space is supported for the DroQ agent")
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    if len(cfg.algo.mlp_keys.encoder) == 0:
+        raise RuntimeError("You should specify at least one MLP key for the encoder: `mlp_keys.encoder=[state]`")
+    for k in cfg.algo.mlp_keys.encoder:
+        if len(observation_space[k].shape) > 1:
+            raise ValueError(
+                "Only environments with vector-only observations are supported by the DroQ agent. "
+                f"The observation with key '{k}' has shape {observation_space[k].shape}. "
+                f"Provided environment: {cfg.env.id}"
+            )
+    if cfg.metric.log_level > 0:
+        runtime.print("Encoder MLP keys:", cfg.algo.mlp_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+
+    agent, agent_state = build_agent(
+        runtime, cfg, observation_space, action_space,
+        state_ckpt["agent"] if state_ckpt is not None else None,
+    )
+
+    txs = {
+        "qf": _make_optimizer(cfg.algo.critic.optimizer),
+        "actor": _make_optimizer(cfg.algo.actor.optimizer),
+        "alpha": _make_optimizer(cfg.algo.alpha.optimizer),
+    }
+    opt_states = {
+        "qf": txs["qf"].init(agent_state["qfs"]),
+        "actor": txs["actor"].init(agent_state["actor"]),
+        "alpha": txs["alpha"].init(agent_state["log_alpha"]),
+    }
+    if state_ckpt is not None:
+        for name, ckpt_key in (("qf", "qf_optimizer"), ("actor", "actor_optimizer"), ("alpha", "alpha_optimizer")):
+            opt_states[name] = restore_opt_state(opt_states[name], state_ckpt[ckpt_key])
+
+    if runtime.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
+
+    buffer_size = cfg.buffer.size // int(cfg.env.num_envs * world_size) if not cfg.dry_run else 1
+    rb = ReplayBuffer(
+        buffer_size,
+        cfg.env.num_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+    )
+    if state_ckpt is not None and cfg.buffer.checkpoint and state_ckpt.get("rb") is not None:
+        rb = state_ckpt["rb"]
+
+    last_train = 0
+    train_step_count = 0
+    start_iter = (state_ckpt["iter_num"] // world_size) + 1 if state_ckpt is not None else 1
+    policy_step = state_ckpt["iter_num"] * cfg.env.num_envs if state_ckpt is not None else 0
+    last_log = state_ckpt["last_log"] if state_ckpt is not None else 0
+    last_checkpoint = state_ckpt["last_checkpoint"] if state_ckpt is not None else 0
+    policy_steps_per_iter = int(cfg.env.num_envs * world_size)
+    total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
+    prefill_steps = learning_starts - int(learning_starts > 0)
+    if state_ckpt is not None:
+        cfg.algo.per_rank_batch_size = state_ckpt["batch_size"] // world_size
+        learning_starts += start_iter
+        prefill_steps += start_iter
+
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    if state_ckpt is not None:
+        ratio.load_state_dict(state_ckpt["ratio"])
+
+    if cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"The metric.log_every parameter ({cfg.metric.log_every}) is not a multiple of the "
+            f"policy_steps_per_iter value ({policy_steps_per_iter}), so "
+            "the metrics will be logged at the nearest greater multiple of the policy_steps_per_iter value."
+        )
+    if cfg.checkpoint.every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"The checkpoint.every parameter ({cfg.checkpoint.every}) is not a multiple of the "
+            f"policy_steps_per_iter value ({policy_steps_per_iter}), so "
+            "the checkpoint will be saved at the nearest greater multiple of the policy_steps_per_iter value."
+        )
+
+    player_fn = jax.jit(lambda p, o, k: agent.get_actions(p, o, k, greedy=False))
+    train_fn = make_train_step(agent, txs, cfg, mesh)
+
+    rollout_key, train_key = jax.random.split(jax.random.fold_in(runtime.root_key, rank))
+
+    step_data = {}
+    obs = envs.reset(seed=cfg.seed)[0]
+
+    cumulative_per_rank_gradient_steps = 0
+    for iter_num in range(start_iter, total_iters + 1):
+        policy_step += policy_steps_per_iter
+
+        with timer("Time/env_interaction_time"):
+            if iter_num <= learning_starts:
+                actions = envs.action_space.sample()
+            else:
+                jnp_obs = prepare_obs(obs, mlp_keys=mlp_keys, num_envs=cfg.env.num_envs)
+                rollout_key, sub = jax.random.split(rollout_key)
+                actions = np.asarray(player_fn(agent_state["actor"], jnp_obs, sub))
+            next_obs, rewards, terminated, truncated, infos = envs.step(
+                actions.reshape(envs.action_space.shape)
+            )
+            rewards = rewards.reshape(cfg.env.num_envs, -1)
+
+        if cfg.metric.log_level > 0 and "final_info" in infos:
+            fi = infos["final_info"]
+            for i in np.nonzero(fi.get("_episode", []))[0]:
+                ep_rew = float(fi["episode"]["r"][i])
+                ep_len = float(fi["episode"]["l"][i])
+                if aggregator and not aggregator.disabled:
+                    aggregator.update("Rewards/rew_avg", ep_rew)
+                    aggregator.update("Game/ep_len_avg", ep_len)
+                runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+
+        real_next_obs = copy.deepcopy(next_obs)
+        if "final_obs" in infos:
+            done_mask = np.logical_or(terminated, truncated)
+            for idx in np.nonzero(done_mask)[0]:
+                final = infos["final_obs"][idx]
+                if final is not None:
+                    for k, v in final.items():
+                        real_next_obs[k][idx] = v
+        real_next_obs_cat = np.concatenate([real_next_obs[k] for k in mlp_keys], axis=-1).astype(np.float32)
+
+        step_data["terminated"] = terminated.reshape(1, cfg.env.num_envs, -1).astype(np.uint8)
+        step_data["truncated"] = truncated.reshape(1, cfg.env.num_envs, -1).astype(np.uint8)
+        step_data["actions"] = actions.reshape(1, cfg.env.num_envs, -1)
+        step_data["observations"] = np.concatenate([obs[k] for k in mlp_keys], axis=-1).astype(np.float32)[np.newaxis]
+        if not cfg.buffer.sample_next_obs:
+            step_data["next_observations"] = real_next_obs_cat[np.newaxis]
+        step_data["rewards"] = rewards[np.newaxis].astype(np.float32)
+        rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+        obs = next_obs
+
+        if iter_num >= learning_starts:
+            per_rank_gradient_steps = ratio((policy_step - prefill_steps + policy_steps_per_iter) / world_size)
+            if per_rank_gradient_steps > 0:
+                # One big critic sample + one separate actor sample
+                # (reference: droq.py:44-94).
+                critic_sample = rb.sample_tensors(
+                    batch_size=per_rank_gradient_steps * cfg.algo.per_rank_batch_size,
+                    sample_next_obs=cfg.buffer.sample_next_obs,
+                )
+                critic_data = {
+                    k: np.asarray(v)
+                    .astype(np.float32)
+                    .reshape(per_rank_gradient_steps, cfg.algo.per_rank_batch_size, *np.asarray(v).shape[2:])
+                    for k, v in critic_sample.items()
+                }
+                actor_sample = rb.sample_tensors(
+                    batch_size=cfg.algo.per_rank_batch_size,
+                    sample_next_obs=cfg.buffer.sample_next_obs,
+                )
+                actor_data = {
+                    k: np.asarray(v).astype(np.float32).reshape(cfg.algo.per_rank_batch_size, *np.asarray(v).shape[2:])
+                    for k, v in actor_sample.items()
+                }
+                with timer("Time/train_time"):
+                    train_key, sub = jax.random.split(train_key)
+                    agent_state, opt_states, train_metrics = train_fn(
+                        agent_state, opt_states, critic_data, actor_data, sub
+                    )
+                    jax.block_until_ready(agent_state["actor"])
+                    cumulative_per_rank_gradient_steps += per_rank_gradient_steps
+                train_step_count += world_size
+
+                if aggregator and not aggregator.disabled:
+                    aggregator.update("Loss/value_loss", np.asarray(train_metrics["value_loss"]))
+                    aggregator.update("Loss/policy_loss", np.asarray(train_metrics["policy_loss"]))
+                    aggregator.update("Loss/alpha_loss", np.asarray(train_metrics["alpha_loss"]))
+
+        if cfg.metric.log_level > 0 and logger is not None and (
+            policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
+        ):
+            if aggregator and not aggregator.disabled:
+                logger.log_dict(aggregator.compute(), policy_step)
+                aggregator.reset()
+            logger.log(
+                "Params/replay_ratio", cumulative_per_rank_gradient_steps * world_size / policy_step, policy_step
+            )
+            if not timer.disabled:
+                timer_metrics = timer.compute()
+                if timer_metrics.get("Time/train_time", 0) > 0:
+                    logger.log(
+                        "Time/sps_train",
+                        (train_step_count - last_train) / timer_metrics["Time/train_time"],
+                        policy_step,
+                    )
+                if timer_metrics.get("Time/env_interaction_time", 0) > 0:
+                    logger.log(
+                        "Time/sps_env_interaction",
+                        ((policy_step - last_log) / world_size * cfg.env.action_repeat)
+                        / timer_metrics["Time/env_interaction_time"],
+                        policy_step,
+                    )
+                timer.reset()
+            last_log = policy_step
+            last_train = train_step_count
+
+        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+            iter_num == total_iters and cfg.checkpoint.save_last
+        ):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "agent": agent_state,
+                "qf_optimizer": opt_states["qf"],
+                "actor_optimizer": opt_states["actor"],
+                "alpha_optimizer": opt_states["alpha"],
+                "ratio": ratio.state_dict(),
+                "iter_num": iter_num * world_size,
+                "batch_size": cfg.algo.per_rank_batch_size * world_size,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{rank}.ckpt")
+            saved_tail = None
+            tail = (rb._pos - 1) % rb.buffer_size
+            if cfg.buffer.checkpoint:
+                if rb["truncated"] is not None:
+                    saved_tail = np.asarray(rb["truncated"][tail, :]).copy()
+                    rb["truncated"][tail, :] = 1
+                ckpt_state["rb"] = rb
+            if runtime.is_global_zero:
+                save_checkpoint(ckpt_path, ckpt_state, keep_last=cfg.checkpoint.keep_last)
+            if saved_tail is not None:
+                rb["truncated"][tail, :] = saved_tail
+
+    envs.close()
+    if runtime.is_global_zero and cfg.algo.run_test:
+        test(agent, agent_state, runtime, cfg, log_dir, logger)
+
+    if logger is not None:
+        logger.close()

@@ -1,0 +1,10 @@
+"""DroQ auxiliary contract (reference: sheeprl/algos/droq/utils.py)."""
+
+from __future__ import annotations
+
+from sheeprl_tpu.algos.sac.utils import (  # noqa: F401 (re-export)
+    AGGREGATOR_KEYS,
+    MODELS_TO_REGISTER,
+    prepare_obs,
+    test,
+)

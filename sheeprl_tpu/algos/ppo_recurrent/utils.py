@@ -1,0 +1,44 @@
+"""Recurrent PPO auxiliary contract (reference: sheeprl/algos/ppo_recurrent/utils.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.ppo.utils import AGGREGATOR_KEYS, prepare_obs  # noqa: F401 (re-export)
+from sheeprl_tpu.utils.env import make_env
+
+MODELS_TO_REGISTER = {"agent"}
+
+
+def test(agent, params, runtime, cfg: Dict[str, Any], log_dir: str, logger=None) -> float:
+    """One greedy episode threading the LSTM carry
+    (reference: utils.py:37-70)."""
+    env = make_env(cfg, None, 0, log_dir, "test", vector_env_idx=0)()
+    done = False
+    cumulative_rew = 0.0
+    obs = env.reset(seed=cfg.seed)[0]
+    get_actions = jax.jit(
+        lambda p, o, a, c: agent.get_actions(p, o, a, c, greedy=True)
+    )
+    carry = agent.initial_states(1)
+    prev_actions = jnp.zeros((1, int(np.sum(agent.actions_dim))), jnp.float32)
+    while not done:
+        jnp_obs = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder)
+        actions_cat, real_actions, carry = get_actions(params, jnp_obs, prev_actions, carry)
+        prev_actions = actions_cat
+        obs, reward, done, truncated, _ = env.step(
+            np.asarray(real_actions).reshape(env.action_space.shape)
+        )
+        done = done or truncated
+        cumulative_rew += reward
+        if cfg.dry_run:
+            done = True
+    runtime.print("Test - Reward:", cumulative_rew)
+    if cfg.metric.log_level > 0 and logger is not None:
+        logger.log_dict({"Test/cumulative_reward": cumulative_rew}, 0)
+    env.close()
+    return cumulative_rew

@@ -97,10 +97,14 @@ def squash_and_logprob(
     key: jax.Array,
     action_scale: jax.Array,
     action_bias: jax.Array,
+    log_std_clip: Optional[Tuple[float, float]] = (LOG_STD_MIN, LOG_STD_MAX),
 ) -> Tuple[jax.Array, jax.Array]:
     """Reparameterized tanh-squashed sample, rescaled to env bounds, with the
-    eq. 26 log-prob correction (reference: agent.py:110-142)."""
-    std = jnp.exp(jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX))
+    eq. 26 log-prob correction (reference: agent.py:110-142). Pass
+    ``log_std_clip=None`` when the actor already bounds log_std (SAC-AE)."""
+    if log_std_clip is not None:
+        log_std = jnp.clip(log_std, *log_std_clip)
+    std = jnp.exp(log_std)
     x_t = mean + std * jax.random.normal(key, mean.shape, mean.dtype)
     y_t = jnp.tanh(x_t)
     action = y_t * action_scale + action_bias

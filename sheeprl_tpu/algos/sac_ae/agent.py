@@ -1,0 +1,433 @@
+"""SAC-AE agent (flax): shared conv encoder + autoencoder + SAC heads
+(reference: sheeprl/algos/sac_ae/agent.py:26-640; architecture from
+https://arxiv.org/abs/1910.01741).
+
+TPU restructuring:
+- Pixels are NHWC end-to-end (the reference is NCHW).
+- The Q ensemble is ONE module vmapped over a member axis, taking the
+  ENCODED features (the encoder is a separate param tree so the critic
+  update can propagate into it while the actor update cannot — the
+  reference's `detach_encoder_features` flag becomes "which param trees the
+  loss differentiates", which jax makes explicit for free).
+- Target networks (critic ensemble AND encoder) are param copies EMA'd with
+  their own taus by tree_map.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from math import prod
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from sheeprl_tpu.algos.sac.agent import squash_and_logprob
+from sheeprl_tpu.models import MLP, CNN, DeCNN
+
+LOG_STD_MIN = -10
+LOG_STD_MAX = 2
+
+orthogonal_init = jax.nn.initializers.orthogonal()
+
+
+class SACAECNNEncoder(nn.Module):
+    """4x conv k3 (strides 2,1,1,1) -> Dense -> LayerNorm -> tanh
+    (reference: CNNEncoder, agent.py:26-87)."""
+
+    keys: Sequence[str]
+    features_dim: int
+    channels_multiplier: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        x = jnp.concatenate([obs[k] for k in self.keys], axis=-1)
+        x = CNN(
+            hidden_channels=[32 * self.channels_multiplier] * 4,
+            layer_args=[
+                {"kernel_size": 3, "stride": 2},
+                {"kernel_size": 3, "stride": 1},
+                {"kernel_size": 3, "stride": 1},
+                {"kernel_size": 3, "stride": 1},
+            ],
+            kernel_init=orthogonal_init,
+            dtype=self.dtype,
+            name="model",
+        )(x)
+        x = x.reshape(*x.shape[:-3], -1)
+        x = nn.Dense(self.features_dim, kernel_init=orthogonal_init, dtype=self.dtype, name="fc")(x)
+        x = nn.LayerNorm(name="ln")(x)
+        return jnp.tanh(x)
+
+
+class SACAEMLPEncoder(nn.Module):
+    """Vector branch (reference: MLPEncoder, agent.py:89-120)."""
+
+    keys: Sequence[str]
+    dense_units: int = 64
+    mlp_layers: int = 2
+    layer_norm: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        x = jnp.concatenate([obs[k] for k in self.keys], axis=-1)
+        return MLP(
+            hidden_sizes=[self.dense_units] * self.mlp_layers,
+            activation="relu",
+            norm_layer="layer_norm" if self.layer_norm else None,
+            kernel_init=orthogonal_init,
+            dtype=self.dtype,
+            name="model",
+        )(x)
+
+
+class SACAEEncoder(nn.Module):
+    """Concat of the active branches' features."""
+
+    cnn_keys: Sequence[str]
+    mlp_keys: Sequence[str]
+    features_dim: int
+    channels_multiplier: int
+    dense_units: int
+    mlp_layers: int
+    layer_norm: bool
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        feats = []
+        if len(self.cnn_keys) > 0:
+            feats.append(
+                SACAECNNEncoder(
+                    keys=list(self.cnn_keys),
+                    features_dim=self.features_dim,
+                    channels_multiplier=self.channels_multiplier,
+                    dtype=self.dtype,
+                    name="cnn_encoder",
+                )(obs)
+            )
+        if len(self.mlp_keys) > 0:
+            feats.append(
+                SACAEMLPEncoder(
+                    keys=list(self.mlp_keys),
+                    dense_units=self.dense_units,
+                    mlp_layers=self.mlp_layers,
+                    layer_norm=self.layer_norm,
+                    dtype=self.dtype,
+                    name="mlp_encoder",
+                )(obs)
+            )
+        return jnp.concatenate(feats, axis=-1)
+
+
+class SACAECNNDecoder(nn.Module):
+    """Dense back to the conv grid -> 3x deconv s1 -> s2 deconv to pixels
+    (reference: CNNDecoder, agent.py:153-202)."""
+
+    keys: Sequence[str]
+    channels: Sequence[int]  # per-key output channels
+    conv_output_shape: Tuple[int, int, int]  # (H, W, C) at the encoder's conv output
+    channels_multiplier: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> Dict[str, jax.Array]:
+        batch = x.shape[:-1]
+        x = nn.Dense(int(np.prod(self.conv_output_shape)), kernel_init=orthogonal_init, dtype=self.dtype, name="fc")(x)
+        x = x.reshape(*batch, *self.conv_output_shape)
+        x = DeCNN(
+            hidden_channels=[32 * self.channels_multiplier] * 3,
+            layer_args={"kernel_size": 3, "stride": 1},
+            kernel_init=orthogonal_init,
+            dtype=self.dtype,
+            name="model",
+        )(x)
+        x = DeCNN(
+            hidden_channels=[sum(self.channels)],
+            layer_args={"kernel_size": 3, "stride": 2, "output_padding": 1},
+            activation=None,
+            kernel_init=orthogonal_init,
+            dtype=self.dtype,
+            name="to_obs",
+        )(x)
+        splits = np.cumsum(self.channels)[:-1]
+        return dict(zip(self.keys, jnp.split(x, splits, axis=-1)))
+
+
+class SACAEMLPDecoder(nn.Module):
+    """MLP trunk + one head per vector key (reference: MLPDecoder, agent.py:122-151)."""
+
+    keys: Sequence[str]
+    output_dims: Sequence[int]
+    dense_units: int = 64
+    mlp_layers: int = 2
+    layer_norm: bool = False
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> Dict[str, jax.Array]:
+        x = MLP(
+            hidden_sizes=[self.dense_units] * self.mlp_layers,
+            activation="relu",
+            norm_layer="layer_norm" if self.layer_norm else None,
+            kernel_init=orthogonal_init,
+            dtype=self.dtype,
+            name="model",
+        )(x)
+        return {
+            k: nn.Dense(d, kernel_init=orthogonal_init, dtype=self.dtype, name=f"head_{k}")(x)
+            for k, d in zip(self.keys, self.output_dims)
+        }
+
+
+class SACAEDecoder(nn.Module):
+    """Multi-branch decoder over the shared latent."""
+
+    cnn_keys: Sequence[str]
+    mlp_keys: Sequence[str]
+    cnn_channels: Sequence[int]
+    mlp_output_dims: Sequence[int]
+    conv_output_shape: Tuple[int, int, int]
+    channels_multiplier: int
+    dense_units: int
+    mlp_layers: int
+    layer_norm: bool
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, latent: jax.Array) -> Dict[str, jax.Array]:
+        out: Dict[str, jax.Array] = {}
+        if len(self.cnn_keys) > 0:
+            out.update(
+                SACAECNNDecoder(
+                    keys=list(self.cnn_keys),
+                    channels=list(self.cnn_channels),
+                    conv_output_shape=self.conv_output_shape,
+                    channels_multiplier=self.channels_multiplier,
+                    dtype=self.dtype,
+                    name="cnn_decoder",
+                )(latent)
+            )
+        if len(self.mlp_keys) > 0:
+            out.update(
+                SACAEMLPDecoder(
+                    keys=list(self.mlp_keys),
+                    output_dims=list(self.mlp_output_dims),
+                    dense_units=self.dense_units,
+                    mlp_layers=self.mlp_layers,
+                    layer_norm=self.layer_norm,
+                    dtype=self.dtype,
+                    name="mlp_decoder",
+                )(latent)
+            )
+        return out
+
+
+class SACAEQFunction(nn.Module):
+    """Q(features, act) MLP (reference: SACAEQFunction, agent.py:204-224)."""
+
+    hidden_size: int = 256
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, features: jax.Array, action: jax.Array) -> jax.Array:
+        x = jnp.concatenate([features, action], axis=-1)
+        return MLP(
+            hidden_sizes=(self.hidden_size, self.hidden_size),
+            output_dim=1,
+            activation="relu",
+            kernel_init=orthogonal_init,
+            dtype=self.dtype,
+            name="model",
+        )(x)
+
+
+class SACAEQEnsemble(nn.Module):
+    n: int
+    hidden_size: int = 256
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, features: jax.Array, action: jax.Array) -> jax.Array:
+        ensemble = nn.vmap(
+            SACAEQFunction,
+            in_axes=None,
+            out_axes=-1,
+            axis_size=self.n,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+        )(hidden_size=self.hidden_size, dtype=self.dtype, name="qfs")
+        return ensemble(features, action)[..., 0, :]
+
+
+class SACAEActorModule(nn.Module):
+    """Actor trunk over (detached) encoder features, tanh-bounded log_std
+    (reference: SACAEContinuousActor, agent.py:240-318)."""
+
+    action_dim: int
+    hidden_size: int = 1024
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, features: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        x = MLP(
+            hidden_sizes=(self.hidden_size, self.hidden_size),
+            kernel_init=orthogonal_init,
+            dtype=self.dtype,
+            name="model",
+        )(features)
+        mean = nn.Dense(self.action_dim, kernel_init=orthogonal_init, dtype=self.dtype, name="fc_mean")(x)
+        log_std = nn.Dense(self.action_dim, kernel_init=orthogonal_init, dtype=self.dtype, name="fc_logstd")(x)
+        log_std = jnp.tanh(log_std)
+        log_std = LOG_STD_MIN + 0.5 * (LOG_STD_MAX - LOG_STD_MIN) * (log_std + 1)
+        return mean, log_std
+
+
+@dataclass(frozen=True)
+class SACAEAgent:
+    """Train state dict: {encoder, encoder_target, actor, qfs, qfs_target,
+    decoder, log_alpha}."""
+
+    encoder: SACAEEncoder
+    decoder: SACAEDecoder
+    actor: SACAEActorModule
+    critics: SACAEQEnsemble
+    action_scale: np.ndarray
+    action_bias: np.ndarray
+    target_entropy: float
+    tau: float
+    encoder_tau: float
+    num_critics: int
+
+    def encode(self, encoder_params, obs: Dict[str, jax.Array]) -> jax.Array:
+        return self.encoder.apply(encoder_params, obs)
+
+    def decode(self, decoder_params, latent: jax.Array) -> Dict[str, jax.Array]:
+        return self.decoder.apply(decoder_params, latent)
+
+    def actions_and_log_probs(self, actor_params, features: jax.Array, key: jax.Array):
+        mean, log_std = self.actor.apply(actor_params, features)
+        # log_std is already tanh-bounded by the actor — no extra clip.
+        return squash_and_logprob(
+            mean, log_std, key, jnp.asarray(self.action_scale), jnp.asarray(self.action_bias),
+            log_std_clip=None,
+        )
+
+    def q_values(self, qf_params, features: jax.Array, action: jax.Array) -> jax.Array:
+        return self.critics.apply(qf_params, features, action)
+
+    def next_target_q_values(
+        self, state: Dict[str, Any], next_obs, rewards, terminated, gamma: float, key: jax.Array
+    ) -> jax.Array:
+        """Soft Bellman target through the TARGET encoder
+        (reference: get_next_target_q_values, agent.py:402-409)."""
+        next_features = self.encode(state["encoder"], next_obs)
+        next_actions, next_log_pi = self.actions_and_log_probs(state["actor"], next_features, key)
+        target_features = self.encode(state["encoder_target"], next_obs)
+        qf_next = self.q_values(state["qfs_target"], target_features, next_actions)
+        alpha = jnp.exp(state["log_alpha"])
+        min_qf_next = jnp.min(qf_next, axis=-1, keepdims=True) - alpha * next_log_pi
+        return rewards + (1 - terminated) * gamma * min_qf_next
+
+    def get_actions(
+        self, state: Dict[str, Any], obs: Dict[str, jax.Array], key: Optional[jax.Array] = None, greedy: bool = False
+    ):
+        features = self.encode(state["encoder"], obs)
+        mean, log_std = self.actor.apply(state["actor"], features)
+        scale = jnp.asarray(self.action_scale)
+        bias = jnp.asarray(self.action_bias)
+        if greedy:
+            return jnp.tanh(mean) * scale + bias
+        std = jnp.exp(log_std)
+        x_t = mean + std * jax.random.normal(key, mean.shape, mean.dtype)
+        return jnp.tanh(x_t) * scale + bias
+
+
+def build_agent(
+    runtime,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+    action_space: gymnasium.spaces.Box,
+    agent_state: Optional[Dict[str, Any]] = None,
+) -> Tuple[SACAEAgent, Dict[str, Any]]:
+    """Construct modules + initial (or restored) train state
+    (reference: build_agent, agent.py:500-640)."""
+    act_dim = int(prod(action_space.shape))
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    cnn_dec_keys = list(cfg.algo.cnn_keys.decoder)
+    mlp_dec_keys = list(cfg.algo.mlp_keys.decoder)
+    dtype = runtime.precision.compute_dtype
+    screen = int(cfg.env.screen_size)
+
+    encoder = SACAEEncoder(
+        cnn_keys=cnn_keys,
+        mlp_keys=mlp_keys,
+        features_dim=int(cfg.algo.encoder.features_dim),
+        channels_multiplier=int(cfg.algo.encoder.cnn_channels_multiplier),
+        dense_units=int(cfg.algo.encoder.dense_units),
+        mlp_layers=int(cfg.algo.encoder.mlp_layers),
+        layer_norm=bool(cfg.algo.encoder.layer_norm),
+        dtype=dtype,
+    )
+    # Spatial size after k3 strides (2,1,1,1) on screen x screen
+    s = (screen - 3) // 2 + 1
+    s = s - 2 * 3  # three stride-1 k3 convs
+    conv_output_shape = (s, s, 32 * int(cfg.algo.decoder.cnn_channels_multiplier))
+    decoder = SACAEDecoder(
+        cnn_keys=cnn_dec_keys,
+        mlp_keys=mlp_dec_keys,
+        cnn_channels=[int(obs_space[k].shape[-1]) for k in cnn_dec_keys],
+        mlp_output_dims=[int(np.prod(obs_space[k].shape)) for k in mlp_dec_keys],
+        conv_output_shape=conv_output_shape,
+        channels_multiplier=int(cfg.algo.decoder.cnn_channels_multiplier),
+        dense_units=int(cfg.algo.decoder.dense_units),
+        mlp_layers=int(cfg.algo.decoder.mlp_layers),
+        layer_norm=bool(cfg.algo.decoder.layer_norm),
+        dtype=dtype,
+    )
+    actor = SACAEActorModule(action_dim=act_dim, hidden_size=int(cfg.algo.hidden_size), dtype=dtype)
+    critics = SACAEQEnsemble(n=int(cfg.algo.critic.n), hidden_size=int(cfg.algo.critic.hidden_size), dtype=dtype)
+
+    agent = SACAEAgent(
+        encoder=encoder,
+        decoder=decoder,
+        actor=actor,
+        critics=critics,
+        action_scale=np.asarray((action_space.high - action_space.low) / 2.0, np.float32),
+        action_bias=np.asarray((action_space.high + action_space.low) / 2.0, np.float32),
+        target_entropy=float(-act_dim),
+        tau=float(cfg.algo.tau),
+        encoder_tau=float(cfg.algo.encoder.tau),
+        num_critics=int(cfg.algo.critic.n),
+    )
+
+    if agent_state is not None:
+        state = jax.tree_util.tree_map(jnp.asarray, agent_state)
+        return agent, state
+
+    k_enc, k_dec, k_actor, k_qfs = jax.random.split(runtime.root_key, 4)
+    dummy_obs = {
+        k: jnp.zeros((1, *obs_space[k].shape), jnp.float32) for k in cnn_keys + mlp_keys
+    }
+    encoder_params = encoder.init(k_enc, dummy_obs)
+    features = encoder.apply(encoder_params, dummy_obs)
+    decoder_params = decoder.init(k_dec, features)
+    actor_params = actor.init(k_actor, features)
+    dummy_act = jnp.zeros((1, act_dim), jnp.float32)
+    qf_params = critics.init(k_qfs, features, dummy_act)
+    state = {
+        "encoder": encoder_params,
+        "encoder_target": jax.tree_util.tree_map(jnp.copy, encoder_params),
+        "decoder": decoder_params,
+        "actor": actor_params,
+        "qfs": qf_params,
+        "qfs_target": jax.tree_util.tree_map(jnp.copy, qf_params),
+        "log_alpha": jnp.log(jnp.asarray([float(cfg.algo.alpha.alpha)], jnp.float32)),
+    }
+    return agent, state

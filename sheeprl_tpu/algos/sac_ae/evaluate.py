@@ -1,0 +1,32 @@
+"""SAC-AE evaluation entrypoint (reference: sheeprl/algos/sac_ae/evaluate.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import gymnasium as gym
+
+from sheeprl_tpu.algos.sac_ae.agent import build_agent
+from sheeprl_tpu.algos.sac_ae.utils import test
+from sheeprl_tpu.registry import register_evaluation
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+
+
+@register_evaluation(algorithms="sac_ae")
+def evaluate_sac_ae(runtime, cfg: Dict[str, Any], state: Dict[str, Any]):
+    logger = get_logger(runtime, cfg)
+    if logger is not None:
+        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
+    runtime.print(f"Log dir: {log_dir}")
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    observation_space = env.observation_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    action_space = env.action_space
+    env.close()
+
+    agent, agent_state = build_agent(runtime, cfg, observation_space, action_space, state["agent"])
+    test(agent, agent_state, runtime, cfg, log_dir, logger)

@@ -1,0 +1,76 @@
+"""SAC-AE auxiliary contract (reference: sheeprl/algos/sac_ae/utils.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.utils.env import make_env
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/alpha_loss",
+    "Loss/reconstruction_loss",
+}
+MODELS_TO_REGISTER = {"agent", "encoder", "decoder"}
+
+
+def preprocess_obs(obs: jax.Array, key: jax.Array, bits: int = 8) -> jax.Array:
+    """Bit-reduction + uniform dequantization noise for reconstruction
+    targets (reference: utils.py:68-76; https://arxiv.org/abs/1807.03039)."""
+    bins = 2**bits
+    if bits < 8:
+        obs = jnp.floor(obs / 2 ** (8 - bits))
+    obs = obs / bins
+    obs = obs + jax.random.uniform(key, obs.shape, obs.dtype) / bins
+    return obs - 0.5
+
+
+def prepare_obs(
+    obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), mlp_keys: Sequence[str] = (), num_envs: int = 1
+) -> Dict[str, jax.Array]:
+    """Host obs dict -> device dict; pixels stay uint8 (normalized in-graph)."""
+    out = {}
+    for k in cnn_keys:
+        out[k] = jnp.asarray(np.asarray(obs[k]).reshape(num_envs, *np.asarray(obs[k]).shape[-3:]))
+    for k in mlp_keys:
+        out[k] = jnp.asarray(np.asarray(obs[k], np.float32).reshape(num_envs, -1))
+    return out
+
+
+def normalize_pixels(obs: Dict[str, jax.Array], cnn_keys: Sequence[str]) -> Dict[str, jax.Array]:
+    return {k: (v / 255.0 if k in cnn_keys else v) for k, v in obs.items()}
+
+
+def test(agent, state, runtime, cfg: Dict[str, Any], log_dir: str, logger=None) -> float:
+    """One greedy episode (reference: utils.py:28-53)."""
+    env = make_env(cfg, None, 0, log_dir, "test", vector_env_idx=0)()
+    done = False
+    cumulative_rew = 0.0
+    obs = env.reset(seed=cfg.seed)[0]
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    get_actions = jax.jit(
+        lambda s, o: agent.get_actions(s, normalize_pixels(o, cnn_keys), greedy=True)
+    )
+    while not done:
+        jnp_obs = prepare_obs(obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys)
+        actions = get_actions(state, jnp_obs)
+        obs, reward, done, truncated, _ = env.step(
+            np.asarray(actions).reshape(env.action_space.shape)
+        )
+        done = done or truncated
+        cumulative_rew += reward
+        if cfg.dry_run:
+            done = True
+    runtime.print("Test - Reward:", cumulative_rew)
+    if cfg.metric.log_level > 0 and logger is not None:
+        logger.log_dict({"Test/cumulative_reward": cumulative_rew}, 0)
+    env.close()
+    return cumulative_rew

@@ -33,6 +33,16 @@ def adam(
     return optax.adam(lr, b1=betas[0], b2=betas[1], eps=eps)
 
 
+def adamw(
+    lr: float = 2e-4,
+    eps: float = 1e-8,
+    weight_decay: float = 1e-2,
+    betas: Sequence[float] = (0.9, 0.999),
+) -> optax.GradientTransformation:
+    # torch.optim.AdamW semantics: decoupled weight decay.
+    return optax.adamw(lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=weight_decay)
+
+
 def sgd(
     lr: float = 2e-4,
     momentum: float = 0.0,

@@ -512,3 +512,132 @@ class TestPlan2Explore:
         checkpoint_eval_resume_roundtrip(
             lambda **e: p2e_overrides("p2e_dv3_exploration", **e), tmp_path
         )
+
+
+def droq_overrides(**extra):
+    args = [
+        "exp=droq",
+        "env=dummy",
+        "env.id=continuous_dummy",
+        "env.wrapper.id=continuous_dummy",
+        "dry_run=True",
+        "metric.log_level=0",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "algo.per_rank_batch_size=4",
+        "algo.learning_starts=0",
+        "algo.hidden_size=8",
+        "buffer.memmap=False",
+        "buffer.size=64",
+        "checkpoint.every=0",
+        "fabric.accelerator=cpu",
+    ]
+    for k, v in extra.items():
+        args.append(f"{k}={v}")
+    return args
+
+
+class TestDroQ:
+    @pytest.mark.parametrize("devices", [1, 2])
+    def test_dry_run(self, tmp_path, devices):
+        run(droq_overrides(**{"fabric.devices": devices}))
+
+    def test_checkpoint_eval_resume_roundtrip(self, tmp_path):
+        checkpoint_eval_resume_roundtrip(droq_overrides, tmp_path)
+
+
+def sac_ae_overrides(**extra):
+    args = [
+        "exp=sac_ae",
+        "env=dummy",
+        "env.id=continuous_dummy",
+        "env.wrapper.id=continuous_dummy",
+        "dry_run=True",
+        "metric.log_level=0",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "env.screen_size=64",
+        "algo.per_rank_batch_size=4",
+        "algo.learning_starts=0",
+        "algo.hidden_size=8",
+        "algo.dense_units=8",
+        "algo.cnn_channels_multiplier=2",
+        "algo.encoder.features_dim=8",
+        "algo.critic.hidden_size=8",
+        "buffer.memmap=False",
+        "buffer.size=64",
+        "checkpoint.every=0",
+        "fabric.accelerator=cpu",
+    ]
+    for k, v in extra.items():
+        args.append(f"{k}={v}")
+    return args
+
+
+class TestSACAE:
+    @pytest.mark.parametrize("devices", [1, 2])
+    def test_dry_run_pixel(self, tmp_path, devices):
+        run(sac_ae_overrides(**{"fabric.devices": devices}))
+
+    def test_dry_run_pixel_and_mlp(self, tmp_path):
+        run(
+            sac_ae_overrides(
+                **{
+                    "algo.mlp_keys.encoder": "[state]",
+                    "algo.mlp_keys.decoder": "[state]",
+                }
+            )
+        )
+
+    def test_checkpoint_eval_resume_roundtrip(self, tmp_path):
+        checkpoint_eval_resume_roundtrip(sac_ae_overrides, tmp_path)
+
+
+def ppo_recurrent_overrides(**extra):
+    args = [
+        "exp=ppo_recurrent",
+        "env=dummy",
+        "dry_run=True",
+        "metric.log_level=0",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "algo.rollout_steps=8",
+        "algo.per_rank_sequence_length=4",
+        "algo.per_rank_num_batches=2",
+        "algo.update_epochs=2",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.encoder.cnn_features_dim=16",
+        "algo.encoder.mlp_features_dim=8",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.rnn.lstm.hidden_size=8",
+        "buffer.memmap=False",
+        "checkpoint.every=0",
+        "fabric.accelerator=cpu",
+    ]
+    for k, v in extra.items():
+        args.append(f"{k}={v}")
+    return args
+
+
+class TestPPORecurrent:
+    @pytest.mark.parametrize("devices", [1, 2])
+    def test_dry_run_mlp(self, tmp_path, devices):
+        run(ppo_recurrent_overrides(**{"fabric.devices": devices}))
+
+    def test_dry_run_continuous(self, tmp_path):
+        run(
+            ppo_recurrent_overrides(
+                **{"env.id": "continuous_dummy", "env.wrapper.id": "continuous_dummy"}
+            )
+        )
+
+    def test_rollout_not_multiple_of_sequence_fails(self, tmp_path):
+        with pytest.raises(ValueError, match="multiple of"):
+            run(ppo_recurrent_overrides(**{"algo.per_rank_sequence_length": 3}))
+
+    def test_checkpoint_eval_resume_roundtrip(self, tmp_path):
+        checkpoint_eval_resume_roundtrip(ppo_recurrent_overrides, tmp_path)
