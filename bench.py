@@ -89,6 +89,8 @@ def bench_dreamer_v3():
         "env.screen_size=64",
         "algo.cnn_keys.encoder=[rgb]",
         "algo.mlp_keys.encoder=[]",
+        "algo.mlp_keys.decoder=[]",
+        "algo.cnn_keys.decoder=[rgb]",
         # micro world model, reference benchmark sizes
         "algo.dense_units=8",
         "algo.mlp_layers=1",

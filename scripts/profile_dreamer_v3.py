@@ -1,0 +1,157 @@
+"""Profile the DreamerV3-S train step on the real TPU chip.
+
+Times the full jitted gradient step at the S-model benchmark shape
+(batch 16 x sequence 64, 64x64 pixels), reports XLA's FLOPs estimate and the
+resulting MFU, A/Bs the fused Pallas LN-GRU path against the unfused one,
+and writes a jax.profiler trace for the fused configuration.
+
+Usage: python scripts/profile_dreamer_v3.py [--trace-dir /tmp/dv3_trace]
+Writes a summary JSON to stdout; paste the numbers into PROFILE.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# v5e peak: ~197 TFLOP/s bf16, ~49 TFLOP/s fp32 (public spec)
+PEAK_FLOPS = {"bf16": 197e12, "f32": 49e12}
+
+
+def build(cfg_overrides):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import sheeprl_tpu
+
+    sheeprl_tpu.register_all()
+    from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import _make_optimizer, make_train_step
+    from sheeprl_tpu.cli import check_configs
+    from sheeprl_tpu.config.instantiate import instantiate
+    from sheeprl_tpu.config.loader import compose
+    import gymnasium as gym
+
+    cfg = compose(
+        "config",
+        [
+            "exp=dreamer_v3",
+            "algo=dreamer_v3_S",
+            "env=dummy",
+            "env.num_envs=1",
+            "env.capture_video=False",
+            "env.screen_size=64",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[]",
+            "algo.mlp_keys.decoder=[]",
+            "algo.cnn_keys.decoder=[rgb]",
+            "algo.run_test=False",
+            "metric.log_level=0",
+            "checkpoint.every=0",
+        ]
+        + cfg_overrides,
+    )
+    check_configs(cfg)
+    runtime = instantiate(cfg.fabric)
+    runtime.launch()
+    runtime.seed_everything(cfg.seed)
+
+    obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (64, 64, 3), np.uint8)})
+    agent, agent_state = build_agent(runtime, (6,), False, cfg, obs_space)
+    txs = {
+        "world_model": _make_optimizer(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients),
+        "actor": _make_optimizer(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients),
+        "critic": _make_optimizer(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients),
+    }
+    opt_states = {k: txs[k].init(agent_state[k]) for k in ("world_model", "actor", "critic")}
+    from sheeprl_tpu.utils.ops import init_moments
+
+    train_fn = make_train_step(agent, txs, cfg, runtime.mesh)
+
+    T, B = int(cfg.algo.per_rank_sequence_length), int(cfg.algo.per_rank_batch_size)
+    key = jax.random.PRNGKey(0)
+    data = {
+        "rgb": jax.random.randint(key, (T, B, 64, 64, 3), 0, 255, jnp.int32).astype(jnp.uint8),
+        "actions": jnp.zeros((T, B, 6), jnp.float32),
+        "rewards": jnp.zeros((T, B, 1), jnp.float32),
+        "terminated": jnp.zeros((T, B, 1), jnp.float32),
+        "truncated": jnp.zeros((T, B, 1), jnp.float32),
+        "is_first": jnp.zeros((T, B, 1), jnp.float32),
+    }
+    return train_fn, agent_state, opt_states, init_moments(), data, (T, B)
+
+
+def time_step(train_fn, agent_state, opt_states, moments, data, iters=100):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    key = jax.random.PRNGKey(1)
+    tau = jnp.asarray(0.02, jnp.float32)
+    # Warmup / compile. The step donates its inputs, so thread the state.
+    # TWO warmup calls: the second call's inputs are donated outputs of the
+    # first and can trigger one more compile (layout change) — keep it out
+    # of the timed loop. Each measurement fetches a scalar from the LAST step
+    # of the chain: on the tunneled TPU backend block_until_ready does not
+    # reliably flush the execution queue, a host fetch does.
+    s, o, m, mt = train_fn(agent_state, opt_states, moments, data, key, tau)
+    float(np.asarray(mt["Loss/world_model_loss"]))
+    s, o, m, mt = train_fn(s, o, m, data, key, tau)
+    float(np.asarray(mt["Loss/world_model_loss"]))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        s, o, m, mt = train_fn(s, o, m, data, key, tau)
+    float(np.asarray(mt["Loss/world_model_loss"]))  # force the whole chain
+    return (time.perf_counter() - t0) / iters, (s, o, m)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--trace-dir", default="/tmp/dv3_trace")
+    parser.add_argument("--iters", type=int, default=20)
+    args = parser.parse_args()
+
+    import jax
+
+    summary = {"backend": jax.default_backend(), "device": str(jax.devices()[0])}
+
+    results = {}
+    for fused, label in ((False, "unfused"), (True, "fused")):
+        os.environ["SHEEPRL_TPU_FUSED_GRU"] = "1" if fused else "0"
+        train_fn, agent_state, opt_states, moments, data, (T, B) = build([])
+        dt, carry = time_step(train_fn, agent_state, opt_states, moments, data, args.iters)
+        results[label] = dt
+        if fused:
+            # FLOPs estimate from XLA for MFU
+            import jax.numpy as jnp
+
+            key = jax.random.PRNGKey(1)
+            tau = jnp.asarray(0.02, jnp.float32)
+            lowered = train_fn.lower(*carry, data, key, tau)
+            cost = lowered.compile().cost_analysis()
+            if isinstance(cost, list):
+                cost = cost[0]
+            flops = float(cost.get("flops", 0.0)) if cost else 0.0
+            summary["flops_per_step"] = flops
+            summary["mfu_f32_peak"] = round(flops / dt / PEAK_FLOPS["f32"], 4) if flops else None
+            summary["mfu_bf16_peak"] = round(flops / dt / PEAK_FLOPS["bf16"], 4) if flops else None
+            with jax.profiler.trace(args.trace_dir):
+                s, o, m, _ = train_fn(*carry, data, key, tau)
+                jax.block_until_ready(s["world_model"])
+            summary["trace_dir"] = args.trace_dir
+
+    summary["train_step_ms_unfused"] = round(results["unfused"] * 1e3, 3)
+    summary["train_step_ms_fused"] = round(results["fused"] * 1e3, 3)
+    summary["fused_speedup"] = round(results["unfused"] / results["fused"], 4)
+    summary["batch"] = {"sequence_length": T, "batch_size": B}
+    print(json.dumps(summary, indent=2))
+
+
+if __name__ == "__main__":
+    main()

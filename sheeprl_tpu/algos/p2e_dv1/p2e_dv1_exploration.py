@@ -513,8 +513,10 @@ def main(runtime, cfg: Dict[str, Any]):
                     sub,
                     jnp.asarray(amount, jnp.float32),
                 )
-                actions = np.asarray(actions_cat)
-                real_actions = np.asarray(real_actions_j)
+                # One host fetch for both arrays: each separate np.asarray
+                # is a full device->host roundtrip (painful over a tunneled
+                # chip); jax.device_get of the tuple costs one.
+                actions, real_actions = jax.device_get((actions_cat, real_actions_j))
                 if aggregator and not aggregator.disabled:
                     aggregator.update("Params/exploration_amount", amount)
 
@@ -597,10 +599,12 @@ def main(runtime, cfg: Dict[str, Any]):
                     train_step_count += world_size
 
                 if aggregator and not aggregator.disabled:
-                    for m in per_step_metrics:
+                    # One host fetch for every metric of every gradient step
+                    # (each np.asarray would be its own roundtrip).
+                    for m in jax.device_get(per_step_metrics):
                         for k, v in m.items():
                             if k in aggregator:
-                                aggregator.update(k, np.asarray(v))
+                                aggregator.update(k, v)
 
         # -------------------------------------------------------- logging
         if cfg.metric.log_level > 0 and logger is not None and (

@@ -238,8 +238,8 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
                 sub,
                 jnp.asarray(amount, jnp.float32),
             )
-            actions = np.asarray(actions_cat)
-            real_actions = np.asarray(real_actions_j)
+            # One host fetch for both arrays (single roundtrip).
+            actions, real_actions = jax.device_get((actions_cat, real_actions_j))
             if aggregator and not aggregator.disabled:
                 aggregator.update("Params/exploration_amount", amount)
 
@@ -324,10 +324,12 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
                     train_step_count += world_size
 
                 if aggregator and not aggregator.disabled:
-                    for m in per_step_metrics:
+                    # One host fetch for every metric of every gradient step
+                    # (each np.asarray would be its own roundtrip).
+                    for m in jax.device_get(per_step_metrics):
                         for k, v in m.items():
                             if k in aggregator:
-                                aggregator.update(k, np.asarray(v))
+                                aggregator.update(k, v)
 
         # -------------------------------------------------------- logging
         if cfg.metric.log_level > 0 and logger is not None and (

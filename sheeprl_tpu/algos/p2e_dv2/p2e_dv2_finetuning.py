@@ -249,8 +249,8 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
             actions_cat, real_actions_j, player_state = player_step_fn(
                 agent_state["world_model"], player_actor, player_state, jnp_obs, sub
             )
-            actions = np.asarray(actions_cat)
-            real_actions = np.asarray(real_actions_j)
+            # One host fetch for both arrays (single roundtrip).
+            actions, real_actions = jax.device_get((actions_cat, real_actions_j))
 
             step_data["is_first"] = copy.deepcopy(
                 np.logical_or(step_data["terminated"], step_data["truncated"]).astype(np.float32)
@@ -350,10 +350,12 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
                     train_step_count += world_size
 
                 if aggregator and not aggregator.disabled:
-                    for m in per_step_metrics:
+                    # One host fetch for every metric of every gradient step
+                    # (each np.asarray would be its own roundtrip).
+                    for m in jax.device_get(per_step_metrics):
                         for k, v in m.items():
                             if k in aggregator:
-                                aggregator.update(k, np.asarray(v))
+                                aggregator.update(k, v)
 
         # -------------------------------------------------------- logging
         if cfg.metric.log_level > 0 and logger is not None and (

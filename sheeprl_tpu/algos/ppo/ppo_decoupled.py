@@ -214,8 +214,11 @@ def main(runtime, cfg: Dict[str, Any]):
                     prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs), player_device
                 )
                 rollout_key, sub = jax.random.split(rollout_key)
-                actions, real_actions, logprobs, values = player_step_fn(params_player, jnp_obs, sub)
-                real_actions_np = np.asarray(real_actions)
+                # Single host fetch for the whole step output (one
+                # device->host roundtrip instead of four).
+                actions, real_actions_np, logprobs, values = jax.device_get(
+                    player_step_fn(params_player, jnp_obs, sub)
+                )
 
                 obs, rewards, terminated, truncated, info = envs.step(
                     real_actions_np.reshape(envs.action_space.shape)
@@ -237,9 +240,9 @@ def main(runtime, cfg: Dict[str, Any]):
                 rewards = clip_rewards_fn(rewards).reshape(cfg.env.num_envs, -1).astype(np.float32)
 
             step_data["dones"] = dones[np.newaxis]
-            step_data["values"] = np.asarray(values)[np.newaxis]
-            step_data["actions"] = np.asarray(actions)[np.newaxis]
-            step_data["logprobs"] = np.asarray(logprobs)[np.newaxis]
+            step_data["values"] = values[np.newaxis]
+            step_data["actions"] = actions[np.newaxis]
+            step_data["logprobs"] = logprobs[np.newaxis]
             step_data["rewards"] = rewards[np.newaxis]
             if cfg.buffer.memmap:
                 step_data["returns"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
@@ -307,9 +310,11 @@ def main(runtime, cfg: Dict[str, Any]):
         train_step_count += n_trainers
 
         if aggregator and not aggregator.disabled:
-            aggregator.update("Loss/policy_loss", np.asarray(train_metrics["policy_loss"]))
-            aggregator.update("Loss/value_loss", np.asarray(train_metrics["value_loss"]))
-            aggregator.update("Loss/entropy_loss", np.asarray(train_metrics["entropy_loss"]))
+            # One host fetch for the whole metrics dict (single roundtrip).
+            tm = jax.device_get(train_metrics)
+            aggregator.update("Loss/policy_loss", tm["policy_loss"])
+            aggregator.update("Loss/value_loss", tm["value_loss"])
+            aggregator.update("Loss/entropy_loss", tm["entropy_loss"])
 
         # ------------------------------------------------------- logging
         if cfg.metric.log_level > 0 and logger is not None:

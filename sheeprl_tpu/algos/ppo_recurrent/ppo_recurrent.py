@@ -282,10 +282,15 @@ def main(runtime, cfg: Dict[str, Any]):
                 jnp_obs = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
                 rollout_key, sub = jax.random.split(rollout_key)
                 prev_carry = carry
-                actions, real_actions, logprobs, values, carry = player_step_fn(
+                actions_j, real_actions_j, logprobs_j, values_j, carry = player_step_fn(
                     params, jnp_obs, jnp.asarray(prev_actions), carry, sub
                 )
-                real_actions_np = np.asarray(real_actions)
+                # Single host fetch for the step outputs AND the pre-step
+                # carry snapshot the buffer stores (the post-step carry stays
+                # on device) — one device->host roundtrip instead of six.
+                actions, real_actions_np, logprobs, values, prev_cx_np, prev_hx_np = jax.device_get(
+                    (actions_j, real_actions_j, logprobs_j, values_j, prev_carry[0], prev_carry[1])
+                )
 
                 obs, rewards, terminated, truncated, info = envs.step(
                     real_actions_np.reshape(envs.action_space.shape)
@@ -305,7 +310,7 @@ def main(runtime, cfg: Dict[str, Any]):
                         get_values_fn(
                             params,
                             jnp_next,
-                            jnp.asarray(np.asarray(actions)[truncated_envs]),
+                            jnp.asarray(actions[truncated_envs]),
                             trunc_carry,
                         )
                     )
@@ -314,12 +319,12 @@ def main(runtime, cfg: Dict[str, Any]):
                 rewards = clip_rewards_fn(rewards).reshape(cfg.env.num_envs, -1).astype(np.float32)
 
             step_data["dones"] = dones[np.newaxis]
-            step_data["values"] = np.asarray(values)[np.newaxis]
-            step_data["actions"] = np.asarray(actions)[np.newaxis]
-            step_data["logprobs"] = np.asarray(logprobs)[np.newaxis]
+            step_data["values"] = values[np.newaxis]
+            step_data["actions"] = actions[np.newaxis]
+            step_data["logprobs"] = logprobs[np.newaxis]
             step_data["rewards"] = rewards[np.newaxis]
-            step_data["prev_hx"] = np.asarray(prev_carry[1])[np.newaxis]
-            step_data["prev_cx"] = np.asarray(prev_carry[0])[np.newaxis]
+            step_data["prev_hx"] = prev_hx_np[np.newaxis]
+            step_data["prev_cx"] = prev_cx_np[np.newaxis]
             step_data["prev_actions"] = prev_actions[np.newaxis]
             if cfg.buffer.memmap:
                 step_data["returns"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
@@ -329,7 +334,7 @@ def main(runtime, cfg: Dict[str, Any]):
 
             # A done resets the next step's previous action and carry
             # (reference: ppo_recurrent.py:357-372).
-            prev_actions = ((1 - dones) * np.asarray(actions)).astype(np.float32)
+            prev_actions = ((1 - dones) * actions).astype(np.float32)
             if cfg.algo.reset_recurrent_state_on_done:
                 carry = reset_states_fn(carry, jnp.asarray(dones))
 
@@ -367,17 +372,27 @@ def main(runtime, cfg: Dict[str, Any]):
         chunks = T // sl
         n_envs = cfg.env.num_envs
 
-        # Shifted dones drive the in-scan reset; each chunk's stored initial
-        # carry already includes the reset from the step before it.
+        # Shifted dones drive the in-scan reset, matching what the player did
+        # during the rollout; each chunk's stored initial carry already
+        # includes the reset from the step before it. With
+        # reset_recurrent_state_on_done=False the player never reset, so
+        # training must not either.
         dones_arr = np.asarray(local_data["dones"], np.float32)  # [T, N, 1]
-        shifted = np.concatenate([np.zeros_like(dones_arr[:1]), dones_arr[:-1]], 0)
-        shifted = shifted.reshape(chunks, sl, n_envs, 1)
-        shifted[:, 0] = 0.0
+        if cfg.algo.reset_recurrent_state_on_done:
+            shifted = np.concatenate([np.zeros_like(dones_arr[:1]), dones_arr[:-1]], 0)
+            shifted = shifted.reshape(chunks, sl, n_envs, 1)
+            shifted[:, 0] = 0.0
+        else:
+            shifted = np.zeros_like(dones_arr).reshape(chunks, sl, n_envs, 1)
 
+        # Only what the loss consumes travels into the jitted update.
+        loss_keys = set(obs_keys) | {
+            "prev_actions", "actions", "logprobs", "values", "advantages", "returns"
+        }
         seq_data = {
             k: _to_sequences(np.asarray(v, np.float32), chunks, sl)
             for k, v in local_data.items()
-            if k not in ("prev_hx", "prev_cx")
+            if k in loss_keys
         }
         seq_data["prev_dones"] = _to_sequences(shifted.reshape(T, n_envs, 1), chunks, sl)
         hx = np.asarray(local_data["prev_hx"], np.float32).reshape(chunks, sl, n_envs, -1)
@@ -401,9 +416,11 @@ def main(runtime, cfg: Dict[str, Any]):
         train_step_count += world_size
 
         if aggregator and not aggregator.disabled:
-            aggregator.update("Loss/policy_loss", np.asarray(train_metrics["policy_loss"]))
-            aggregator.update("Loss/value_loss", np.asarray(train_metrics["value_loss"]))
-            aggregator.update("Loss/entropy_loss", np.asarray(train_metrics["entropy_loss"]))
+            # One host fetch for the whole metrics dict (single roundtrip).
+            tm = jax.device_get(train_metrics)
+            aggregator.update("Loss/policy_loss", tm["policy_loss"])
+            aggregator.update("Loss/value_loss", tm["value_loss"])
+            aggregator.update("Loss/entropy_loss", tm["entropy_loss"])
 
         # ------------------------------------------------------- logging
         if cfg.metric.log_level > 0 and logger is not None:

@@ -322,9 +322,11 @@ def main(runtime, cfg: Dict[str, Any]):
                 train_step_count += world_size
 
                 if aggregator and not aggregator.disabled:
-                    aggregator.update("Loss/value_loss", np.asarray(train_metrics["value_loss"]))
-                    aggregator.update("Loss/policy_loss", np.asarray(train_metrics["policy_loss"]))
-                    aggregator.update("Loss/alpha_loss", np.asarray(train_metrics["alpha_loss"]))
+                    # One host fetch for the whole metrics dict (single roundtrip).
+                    tm = jax.device_get(train_metrics)
+                    aggregator.update("Loss/value_loss", tm["value_loss"])
+                    aggregator.update("Loss/policy_loss", tm["policy_loss"])
+                    aggregator.update("Loss/alpha_loss", tm["alpha_loss"])
 
         if cfg.metric.log_level > 0 and logger is not None and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters

@@ -289,9 +289,11 @@ def main(runtime, cfg: Dict[str, Any]):
                         # Time/train_time (and sps_train) meaningful whenever
                         # they are actually reported; with metrics off the
                         # dispatch stays fully async.
-                        aggregator.update("Loss/value_loss", np.asarray(train_metrics["value_loss"]))
-                        aggregator.update("Loss/policy_loss", np.asarray(train_metrics["policy_loss"]))
-                        aggregator.update("Loss/alpha_loss", np.asarray(train_metrics["alpha_loss"]))
+                        # One host fetch for the whole metrics dict (single roundtrip).
+                        tm = jax.device_get(train_metrics)
+                        aggregator.update("Loss/value_loss", tm["value_loss"])
+                        aggregator.update("Loss/policy_loss", tm["policy_loss"])
+                        aggregator.update("Loss/alpha_loss", tm["alpha_loss"])
                 train_step_count += n_trainers
 
         # ------------------------------------------------------------ logging

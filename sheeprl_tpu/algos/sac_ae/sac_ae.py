@@ -132,7 +132,6 @@ def make_train_step(agent: SACAEAgent, txs: Dict[str, optax.GradientTransformati
             def rec_loss_fn(params):
                 hidden = agent.encode(params["encoder"], obs)
                 reconstruction = agent.decode(params["decoder"], hidden)
-                l2 = 0.5 * (hidden**2).sum(-1).mean()
                 loss = 0.0
                 for k in cnn_dec_keys + mlp_dec_keys:
                     target = (
@@ -143,8 +142,12 @@ def make_train_step(agent: SACAEAgent, txs: Dict[str, optax.GradientTransformati
                     rec = reconstruction[k]
                     if k in mlp_dec_keys:
                         target = target.reshape(rec.shape)
-                    loss += ((target - rec) ** 2).mean() + l2_lambda * l2
-                return loss
+                    loss += ((target - rec) ** 2).mean()
+                # Latent L2 penalty applied ONCE (documented divergence: the
+                # reference adds it inside the per-key loop, sac_ae.py:105-111,
+                # scaling the regularizer with the number of decoder keys;
+                # identical for the usual single-key configs).
+                return loss + l2_lambda * 0.5 * (hidden**2).sum(-1).mean()
 
             rec_group = {"encoder": state["encoder"], "decoder": state["decoder"]}
             rec_l, rec_grads = jax.value_and_grad(rec_loss_fn)(rec_group)
@@ -416,13 +419,15 @@ def main(runtime, cfg: Dict[str, Any]):
                 # Only feed losses whose update actually ran this step — the
                 # skipped branches report placeholder zeros.
                 if aggregator and not aggregator.disabled:
-                    for m, did_actor, did_decoder in per_step_metrics:
-                        aggregator.update("Loss/value_loss", np.asarray(m["value_loss"]))
+                    # One host fetch for all gradient steps' metrics.
+                    fetched = jax.device_get([m for m, _, _ in per_step_metrics])
+                    for m, (_, did_actor, did_decoder) in zip(fetched, per_step_metrics):
+                        aggregator.update("Loss/value_loss", m["value_loss"])
                         if did_actor:
-                            aggregator.update("Loss/policy_loss", np.asarray(m["policy_loss"]))
-                            aggregator.update("Loss/alpha_loss", np.asarray(m["alpha_loss"]))
+                            aggregator.update("Loss/policy_loss", m["policy_loss"])
+                            aggregator.update("Loss/alpha_loss", m["alpha_loss"])
                         if did_decoder:
-                            aggregator.update("Loss/reconstruction_loss", np.asarray(m["reconstruction_loss"]))
+                            aggregator.update("Loss/reconstruction_loss", m["reconstruction_loss"])
 
         if cfg.metric.log_level > 0 and logger is not None and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters

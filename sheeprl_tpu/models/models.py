@@ -23,6 +23,8 @@ from __future__ import annotations
 
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
 
+import os
+
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
@@ -384,19 +386,55 @@ class LayerNormGRUCell(nn.Module):
         h' = update * cand + (1 - update) * h
 
     This is the per-step body of every Dreamer RSSM; the sequence loop lives
-    in the caller as `lax.scan` (never a Python loop — SURVEY §7.2). A fused
-    Pallas kernel can swap in behind the same signature.
+    in the caller as `lax.scan` (never a Python loop — SURVEY §7.2). With
+    ``fused=None`` (auto) the standard bias+LN configuration routes through
+    the Pallas kernel (models/pallas_gru.py) on TPU — same math, same param
+    tree, one VMEM-resident epilogue instead of an HBM round-trip of z.
     """
 
     hidden_size: int
     bias: bool = True
     layer_norm: bool = True
+    fused: Optional[bool] = None  # None = auto (TPU + bias + LN)
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
 
     @nn.compact
     def __call__(self, h: jax.Array, x: jax.Array) -> jax.Array:
         inp = jnp.concatenate([h.astype(self.dtype), x.astype(self.dtype)], axis=-1)
+        # Auto default is OFF: the measured A/B (PROFILE.md) shows the fused
+        # kernel wins at the cell level forward (1.45x at B=1024) but is
+        # neutral-to-slightly-negative inside the full DV3 train step, where
+        # convs dominate and the custom-VJP boundary blocks XLA cross-fusion.
+        # ONE knob: opt in per-module (fused=True) or globally via
+        # SHEEPRL_TPU_FUSED_GRU=1 (read only here).
+        use_fused = (
+            self.fused
+            if self.fused is not None
+            else os.environ.get("SHEEPRL_TPU_FUSED_GRU", "0") == "1"
+        )
+        if use_fused and self.layer_norm:
+            from sheeprl_tpu.models.pallas_gru import fused_ln_gru
+
+            # Raw params declared under the SAME tree as the unfused path
+            # (linear/{kernel[,bias]}, norm/LayerNorm_0/{scale,bias}) so
+            # checkpoints and the `fused` flag are interchangeable. The
+            # Dreamer RSSM config (bias=False: LN provides the shift) feeds
+            # the kernel a constant zero bias.
+            kernel, dense_bias = _DenseParams(
+                3 * self.hidden_size, self.bias, self.param_dtype, name="linear"
+            )(inp.shape[-1])
+            if dense_bias is None:
+                dense_bias = jnp.zeros((3 * self.hidden_size,), self.dtype)
+            scale, ln_bias = _LayerNormParams(self.param_dtype, name="norm")(3 * self.hidden_size)
+            return fused_ln_gru(
+                inp,
+                kernel.astype(self.dtype),
+                dense_bias.astype(self.dtype),
+                scale,
+                ln_bias,
+                h.astype(self.dtype),
+            )
         z = nn.Dense(
             3 * self.hidden_size,
             use_bias=self.bias,
@@ -411,6 +449,47 @@ class LayerNormGRUCell(nn.Module):
         cand = jnp.tanh(reset * cand)
         update = nn.sigmoid(update - 1)
         return update * cand + (1 - update) * h.astype(self.dtype)
+
+
+class _DenseParams(nn.Module):
+    """Param-holder mirroring nn.Dense's tree ({kernel, bias})."""
+
+    features: int
+    use_bias: bool
+    param_dtype: Dtype
+
+    @nn.compact
+    def __call__(self, in_dim: int):
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(), (in_dim, self.features), self.param_dtype
+        )
+        bias = (
+            self.param("bias", nn.initializers.zeros_init(), (self.features,), self.param_dtype)
+            if self.use_bias
+            else None
+        )
+        return kernel, bias
+
+
+class _LayerNormInnerParams(nn.Module):
+    param_dtype: Dtype
+
+    @nn.compact
+    def __call__(self, dim: int):
+        scale = self.param("scale", nn.initializers.ones_init(), (dim,), self.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros_init(), (dim,), self.param_dtype)
+        return scale, bias
+
+
+class _LayerNormParams(nn.Module):
+    """Param-holder mirroring models.LayerNorm's tree
+    (norm/LayerNorm_0/{scale, bias})."""
+
+    param_dtype: Dtype
+
+    @nn.compact
+    def __call__(self, dim: int):
+        return _LayerNormInnerParams(self.param_dtype, name="LayerNorm_0")(dim)
 
 
 class MultiEncoder(nn.Module):

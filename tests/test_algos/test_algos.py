@@ -209,6 +209,22 @@ class TestDreamerV3:
         ]
         run(args)
 
+    def test_dry_run_model_axis_tensor_parallel(self, tmp_path):
+        # fabric.model_axis=2: the 1024-wide RSSM dense stacks shard over the
+        # model axis (2 data x 2 model devices on the virtual CPU mesh).
+        run(
+            dv3_overrides(
+                **{
+                    "fabric.devices": 2,
+                    "fabric.model_axis": 2,
+                    "algo.dense_units": 256,
+                    "algo.world_model.recurrent_model.recurrent_state_size": 1024,
+                    "algo.world_model.representation_model.hidden_size": 1024,
+                    "algo.world_model.transition_model.hidden_size": 1024,
+                }
+            )
+        )
+
     def test_dry_run_decoupled_rssm(self, tmp_path):
         run(dv3_overrides(**{"algo.world_model.decoupled_rssm": True}))
 

@@ -1,0 +1,92 @@
+"""Fused LN-GRU Pallas kernel: numerics, gradients, and param-tree parity
+with the unfused LayerNormGRUCell path (kernel itself exercised through the
+Pallas interpreter on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.models.models import LayerNormGRUCell
+from sheeprl_tpu.models.pallas_gru import _pallas_ln_gru, _plain_ln_gru, fused_ln_gru
+
+
+def _random_case(key, batch=16, d=384, hidden=128):
+    ks = jax.random.split(key, 6)
+    inp = jax.random.normal(ks[0], (batch, d), jnp.float32)
+    w = jax.random.normal(ks[1], (d, 3 * hidden), jnp.float32) * 0.1
+    b = jax.random.normal(ks[2], (3 * hidden,), jnp.float32) * 0.1
+    scale = 1.0 + jax.random.normal(ks[3], (3 * hidden,), jnp.float32) * 0.1
+    ln_bias = jax.random.normal(ks[4], (3 * hidden,), jnp.float32) * 0.1
+    h = jax.random.normal(ks[5], (batch, hidden), jnp.float32)
+    return inp, w, b, scale, ln_bias, h
+
+
+class TestFusedLNGRU:
+    def test_kernel_matches_plain(self):
+        args = _random_case(jax.random.PRNGKey(0))
+        out_plain = _plain_ln_gru(*args)[0]
+        out_kernel = _pallas_ln_gru(*args, interpret=True)[0]
+        np.testing.assert_allclose(np.asarray(out_kernel), np.asarray(out_plain), atol=1e-5)
+
+    def test_kernel_handles_unaligned_batch_and_d(self):
+        # batch not a multiple of 8, D not a multiple of 128 -> padded path
+        args = _random_case(jax.random.PRNGKey(1), batch=5, d=200, hidden=128)
+        out_plain = _plain_ln_gru(*args)[0]
+        out_kernel = _pallas_ln_gru(*args, interpret=True)[0]
+        assert out_kernel.shape == out_plain.shape
+        np.testing.assert_allclose(np.asarray(out_kernel), np.asarray(out_plain), atol=1e-5)
+
+    def test_multiple_d_tiles_accumulate(self):
+        # D > _D_TILE forces the k-grid accumulation path
+        args = _random_case(jax.random.PRNGKey(2), batch=8, d=1024, hidden=128)
+        out_plain = _plain_ln_gru(*args)[0]
+        out_kernel = _pallas_ln_gru(*args, interpret=True)[0]
+        np.testing.assert_allclose(
+            np.asarray(out_kernel), np.asarray(out_plain), atol=1e-4, rtol=1e-4
+        )
+
+    def test_gradients_match_plain(self):
+        args = _random_case(jax.random.PRNGKey(3), batch=8, d=256, hidden=128)
+
+        def loss_fused(*a):
+            return (fused_ln_gru(*a) ** 2).sum()
+
+        def loss_plain(*a):
+            return (_plain_ln_gru(*a)[0] ** 2).sum()
+
+        g_fused = jax.grad(loss_fused, argnums=(0, 1, 2, 3, 4, 5))(*args)
+        g_plain = jax.grad(loss_plain, argnums=(0, 1, 2, 3, 4, 5))(*args)
+        for gf, gp in zip(g_fused, g_plain):
+            np.testing.assert_allclose(np.asarray(gf), np.asarray(gp), atol=1e-5)
+
+    def test_param_tree_parity_and_same_outputs(self):
+        """fused=True and fused=False declare identical param trees and (off
+        TPU, where fused falls back to the plain math) identical outputs, so
+        checkpoints move freely between the two paths."""
+        h = jnp.zeros((4, 128))
+        x = jax.random.normal(jax.random.PRNGKey(4), (4, 96))
+        cell_fused = LayerNormGRUCell(hidden_size=128, fused=True)
+        cell_plain = LayerNormGRUCell(hidden_size=128, fused=False)
+        params = cell_fused.init(jax.random.PRNGKey(5), h, x)
+        params_plain = cell_plain.init(jax.random.PRNGKey(5), h, x)
+        assert jax.tree_util.tree_structure(params) == jax.tree_util.tree_structure(params_plain)
+        shapes_f = jax.tree_util.tree_map(jnp.shape, params)
+        shapes_p = jax.tree_util.tree_map(jnp.shape, params_plain)
+        assert shapes_f == shapes_p
+        out_fused = cell_fused.apply(params, h, x)
+        out_plain = cell_plain.apply(params, h, x)
+        np.testing.assert_allclose(np.asarray(out_fused), np.asarray(out_plain), atol=1e-6)
+
+    def test_auto_default_reads_env(self, monkeypatch):
+        """fused=None resolves to SHEEPRL_TPU_FUSED_GRU (default off); both
+        states produce identical results off-TPU."""
+        h = jnp.zeros((4, 128))
+        x = jax.random.normal(jax.random.PRNGKey(6), (4, 96))
+        cell = LayerNormGRUCell(hidden_size=128)
+        params = cell.init(jax.random.PRNGKey(7), h, x)
+        monkeypatch.delenv("SHEEPRL_TPU_FUSED_GRU", raising=False)
+        out_off = cell.apply(params, h, x)
+        monkeypatch.setenv("SHEEPRL_TPU_FUSED_GRU", "1")
+        out_on = cell.apply(params, h, x)
+        np.testing.assert_allclose(np.asarray(out_on), np.asarray(out_off), atol=1e-6)
