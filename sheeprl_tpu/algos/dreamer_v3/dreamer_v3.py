@@ -666,7 +666,11 @@ def main(runtime, cfg: Dict[str, Any]):
                         )
                         per_step_metrics.append(train_metrics)
                         cumulative_per_rank_gradient_steps += 1
-                    jax.block_until_ready(agent_state["world_model"])
+                    # Block only when the train timer needs an accurate stop;
+                    # with metrics off the dispatch stays fully async, so the
+                    # H2D infeed + train overlap the next env steps.
+                    if not timer.disabled:
+                        jax.block_until_ready(agent_state["world_model"])
                     train_step_count += world_size
 
                 # Feed EVERY gradient step's losses to the aggregator (the

@@ -336,7 +336,11 @@ def main(runtime, cfg: Dict[str, Any]):
                     agent_state, opt_states, train_metrics = train_fn(
                         agent_state, opt_states, critic_data, actor_data, sub
                     )
-                    jax.block_until_ready(agent_state["actor"])
+                    # Block only when the train timer needs an accurate stop;
+                    # with metrics off the dispatch stays fully async, so the
+                    # H2D infeed + train overlap the next env steps.
+                    if not timer.disabled:
+                        jax.block_until_ready(agent_state["actor"])
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                 train_step_count += world_size
 

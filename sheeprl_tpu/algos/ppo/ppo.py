@@ -355,7 +355,11 @@ def main(runtime, cfg: Dict[str, Any]):
                 jnp.asarray(cfg.algo.clip_coef, jnp.float32),
                 jnp.asarray(cfg.algo.ent_coef, jnp.float32),
             )
-            jax.block_until_ready(params)
+            # Block only when the train timer needs an accurate stop;
+            # with metrics off the dispatch stays fully async, so the
+            # H2D infeed + train overlap the next env steps.
+            if not timer.disabled:
+                jax.block_until_ready(params)
         train_step_count += world_size
 
         if aggregator and not aggregator.disabled:

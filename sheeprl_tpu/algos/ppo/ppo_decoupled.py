@@ -304,9 +304,10 @@ def main(runtime, cfg: Dict[str, Any]):
             )
             # The broadcast back: the player's next rollout waits on this copy.
             params_player = jax.device_put(params, player_device)
-            # PPO is lockstep anyway (the next rollout needs these weights), so
-            # block here to keep Time/train_time meaningful.
-            jax.block_until_ready(params_player)
+            # PPO is lockstep anyway (the next rollout waits on this copy);
+            # block only when the timer needs an accurate stop.
+            if not timer.disabled:
+                jax.block_until_ready(params_player)
         train_step_count += n_trainers
 
         if aggregator and not aggregator.disabled:

@@ -317,7 +317,11 @@ def main(runtime, cfg: Dict[str, Any]):
                         sub,
                         jnp.asarray(agent.tau if do_ema else 0.0, jnp.float32),
                     )
-                    jax.block_until_ready(agent_state["actor"])
+                    # Block only when the train timer needs an accurate stop;
+                    # with metrics off the dispatch stays fully async, so the
+                    # H2D infeed + train overlap the next env steps.
+                    if not timer.disabled:
+                        jax.block_until_ready(agent_state["actor"])
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                 train_step_count += world_size
 

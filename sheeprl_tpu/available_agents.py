@@ -1,0 +1,44 @@
+"""Print every registered algorithm and its evaluation entrypoint
+(reference: sheeprl/available_agents.py; `python -m sheeprl_tpu.available_agents`)."""
+
+from __future__ import annotations
+
+from rich.console import Console
+from rich.table import Table
+
+import sheeprl_tpu
+from sheeprl_tpu.registry import algorithm_registry, evaluation_registry
+
+
+def available_agents() -> None:
+    sheeprl_tpu.register_all()
+    table = Table(title="sheeprl-tpu Agents")
+    table.add_column("Module")
+    table.add_column("Algorithm")
+    table.add_column("Entrypoint")
+    table.add_column("Decoupled")
+    table.add_column("Evaluated by")
+
+    for name in sorted(algorithm_registry):
+        entry = algorithm_registry[name]
+        evaluated_by = "Undefined"
+        if name in evaluation_registry:
+            ev = evaluation_registry[name]
+            evaluated_by = f"{ev.module}.{ev.entrypoint.__name__}"
+        table.add_row(
+            entry.module,
+            entry.name,
+            entry.entrypoint.__name__,
+            str(entry.decoupled),
+            evaluated_by,
+        )
+
+    Console().print(table)
+
+
+# Console-script entry (pyproject: sheeprl-tpu-agents)
+main = available_agents
+
+
+if __name__ == "__main__":
+    available_agents()

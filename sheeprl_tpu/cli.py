@@ -215,6 +215,61 @@ def run(args: Optional[Sequence[str]] = None) -> None:
     run_algorithm(cfg)
 
 
+def registration(args: Optional[Sequence[str]] = None) -> None:
+    """Model-registration entry: `python -m sheeprl_tpu.registration
+    checkpoint_path=<ckpt> model_manager=<algo> [overrides...]` — logs the
+    checkpoint's models to MLflow and registers the ones selected by the
+    model_manager config (reference: cli.registration, cli.py:408-450)."""
+    import sheeprl_tpu
+
+    sheeprl_tpu.register_all()
+    overrides = list(args) if args is not None else sys.argv[1:]
+    ckpt_override = [o for o in overrides if o.startswith("checkpoint_path=")]
+    if not ckpt_override:
+        raise ValueError("You must specify checkpoint_path=<path-to-checkpoint>")
+    checkpoint_path = pathlib.Path(ckpt_override[-1].split("=", 1)[1])
+    ckpt_cfg = _load_ckpt_config(checkpoint_path)
+
+    # The model_manager configs interpolate ${exp_name}/${env.id}: supply them
+    # from the checkpoint's run identity before composing.
+    cfg = compose(
+        "model_manager_config",
+        overrides + [f"+exp_name={ckpt_cfg.exp_name}", f"+env.id={ckpt_cfg.env.id}"],
+    )
+    # Inherit the rest of the run's identity from the checkpoint's config
+    for key in ("env", "algo", "distribution", "seed"):
+        cfg[key] = ckpt_cfg[key]
+    cfg.to_log = ckpt_cfg
+
+    # The models to register are the algorithm's registered-model contract
+    entry = algorithm_registry.get(cfg.algo.name)
+    if entry is None:
+        raise RuntimeError(f"Unknown algorithm '{cfg.algo.name}' in the checkpoint config")
+    utils_module = importlib.import_module(entry.module.rsplit(".", 1)[0] + ".utils")
+    models_keys = sorted(getattr(utils_module, "MODELS_TO_REGISTER", set()))
+    cfg.model_manager.disabled = False
+    for k in set(cfg.model_manager.models.keys()) - set(models_keys):
+        cfg.model_manager.models.pop(k, None)
+
+    from sheeprl_tpu.utils.checkpoint import load_checkpoint
+    from sheeprl_tpu.utils.mlflow import register_model_from_checkpoint
+
+    state = load_checkpoint(str(checkpoint_path))
+    runtime = instantiate(
+        dotdict(
+            {
+                "_target_": "sheeprl_tpu.core.runtime.Runtime",
+                "devices": 1,
+                "accelerator": "cpu",
+                "precision": str(ckpt_cfg.fabric.get("precision", "32-true")),
+            }
+        )
+    )
+    runtime.launch()
+    runtime.seed_everything(cfg.seed)
+    register_model_from_checkpoint(runtime, cfg, state, models_keys)
+
+
 def evaluation(args: Optional[Sequence[str]] = None) -> None:
     """Evaluation entry: `python -m sheeprl_tpu.eval checkpoint_path=... [overrides]`
     (reference: cli.evaluation, cli.py:369-405 + eval_algorithm 202-268)."""

@@ -1,0 +1,7 @@
+"""`python -m sheeprl_tpu.registration` → model-registration CLI
+(reference console script `sheeprl-registration`)."""
+
+from sheeprl_tpu.cli import registration
+
+if __name__ == "__main__":
+    registration()

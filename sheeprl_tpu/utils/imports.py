@@ -30,9 +30,9 @@ _IS_SUPER_MARIO_BROS_AVAILABLE = module_available("gym_super_mario_bros")
 
 
 def require(flag: bool, package: str, extra: str) -> None:
-    """Raise a uniform gate error for a missing optional simulator."""
+    """Raise a uniform gate error for a missing optional dependency."""
     if not flag:
         raise ModuleNotFoundError(
-            f"The '{package}' package is required for this environment family but is not "
+            f"The '{package}' package is required for this feature but is not "
             f"installed. Install it (e.g. `pip install {extra}`) to use it."
         )

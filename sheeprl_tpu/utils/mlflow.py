@@ -1,0 +1,306 @@
+"""MLflow integration: experiment logger + model registry manager
+(reference: sheeprl/utils/mlflow.py:75-427).
+
+Import-gated on the optional `mlflow` package. Differences from the
+reference, by design:
+
+- Models are jax/flax param pytrees, not torch modules, so they are logged
+  as **pyfunc models** wrapping the flattened parameter arrays (saved with
+  numpy .npz) instead of `mlflow.pytorch.log_model`.
+- The reference ships a near-identical `log_models_from_checkpoint` per
+  algorithm; here ONE generic `log_models_from_checkpoint` driven by the
+  algorithm's `MODELS_TO_REGISTER` set covers every algorithm.
+"""
+
+from __future__ import annotations
+
+import getpass
+import os
+import tempfile
+from abc import ABC, abstractmethod
+from datetime import datetime
+from typing import Any, Dict, Literal, Optional, Sequence
+
+import numpy as np
+
+from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE, require
+
+require(_IS_MLFLOW_AVAILABLE, "mlflow", "mlflow")
+
+import mlflow  # noqa: E402
+from mlflow.entities.model_registry import ModelVersion  # noqa: E402
+from mlflow.tracking import MlflowClient  # noqa: E402
+
+VERSION_MD_TEMPLATE = "## **Version {}**\n"
+DESCRIPTION_MD_TEMPLATE = "### Description: \n{}\n"
+
+
+class MLflowLogger:
+    """MLflow run logger exposing the log/log_dict/log_hyperparams surface
+    the algorithms use (the analog of logger/mlflow.yaml's MLFlowLogger)."""
+
+    def __init__(
+        self,
+        experiment_name: str,
+        tracking_uri: Optional[str] = None,
+        run_name: Optional[str] = None,
+        tags: Optional[Dict[str, Any]] = None,
+        **_: Any,
+    ):
+        if tracking_uri:
+            mlflow.set_tracking_uri(tracking_uri)
+        mlflow.set_experiment(experiment_name)
+        self._run = mlflow.start_run(run_name=run_name, tags=tags)
+        self.run_id = self._run.info.run_id
+        self.log_dir = None
+
+    def log(self, name: str, value: Any, step: int) -> None:
+        mlflow.log_metric(name.replace("/", "_"), float(np.asarray(value)), step=step)
+
+    def log_dict(self, metrics: Dict[str, Any], step: int) -> None:
+        mlflow.log_metrics(
+            {k.replace("/", "_"): float(np.asarray(v)) for k, v in metrics.items()}, step=step
+        )
+
+    def log_hyperparams(self, cfg: Dict[str, Any]) -> None:
+        flat: Dict[str, Any] = {}
+
+        def _flatten(node, prefix=""):
+            if isinstance(node, dict):
+                for k, v in node.items():
+                    _flatten(v, f"{prefix}{k}.")
+            else:
+                flat[prefix[:-1]] = node
+
+        _flatten(dict(cfg))
+        # MLflow caps params per batch; log defensively.
+        for i in range(0, len(flat), 90):
+            chunk = dict(list(flat.items())[i : i + 90])
+            try:
+                mlflow.log_params({k: str(v)[:250] for k, v in chunk.items()})
+            except Exception:  # pragma: no cover - server-side validation
+                pass
+
+    def close(self) -> None:
+        mlflow.end_run()
+
+
+class _ParamsModel(mlflow.pyfunc.PythonModel):
+    """Pyfunc wrapper over a saved flax param pytree (predict = identity over
+    the flattened param listing; the artifact is the model of record)."""
+
+    def load_context(self, context):
+        self.params = dict(np.load(context.artifacts["params"], allow_pickle=False))
+
+    def predict(self, context, model_input, params=None):  # pragma: no cover
+        return {k: v.shape for k, v in self.params.items()}
+
+
+def _flatten_tree(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten_tree(v, f"{prefix}{k}/"))
+    else:
+        out[prefix[:-1]] = np.asarray(tree)
+    return out
+
+
+def log_model(tree: Any, artifact_path: str) -> Any:
+    """Log one param pytree as an MLflow pyfunc model; returns ModelInfo."""
+    with tempfile.TemporaryDirectory() as tmp:
+        npz = os.path.join(tmp, "params.npz")
+        np.savez(npz, **_flatten_tree(tree))
+        return mlflow.pyfunc.log_model(
+            artifact_path,
+            python_model=_ParamsModel(),
+            artifacts={"params": npz},
+        )
+
+
+def log_models_from_checkpoint(
+    runtime, cfg: Dict[str, Any], state: Dict[str, Any], models_keys: Sequence[str]
+) -> Dict[str, Any]:
+    """Log every requested model's params from a checkpoint state under the
+    current (or a new) MLflow run (reference: the per-algo
+    log_models_from_checkpoint functions)."""
+    model_info: Dict[str, Any] = {}
+    run_cfg = cfg.get("run", {}) or {}
+    exp_cfg = cfg.get("experiment", {}) or {}
+    with mlflow.start_run(
+        run_id=run_cfg.get("id"),
+        experiment_id=exp_cfg.get("id"),
+        run_name=run_cfg.get("name"),
+        nested=True,
+    ):
+        for key in models_keys:
+            if key not in state:
+                continue
+            model_info[key] = log_model(state[key], key)
+        if cfg.get("to_log"):
+            mlflow.log_dict(dict(cfg["to_log"]), "config.json")
+    return model_info
+
+
+def register_model_from_checkpoint(runtime, cfg: Dict[str, Any], state: Dict[str, Any], models_keys: Sequence[str]):
+    """The registration CLI's worker: log the checkpoint's models and register
+    the ones selected in cfg.model_manager.models (the reference's separate
+    in-training register_model path collapses into this — registration always
+    goes through a checkpoint here)."""
+    model_info = log_models_from_checkpoint(runtime, cfg, state, models_keys)
+    if cfg.model_manager.disabled:
+        return
+    tracking_uri = getattr(cfg, "tracking_uri", None) or os.environ.get("MLFLOW_TRACKING_URI")
+    manager = MlflowModelManager(runtime, tracking_uri)
+    for k, info in model_info.items():
+        entry = cfg.model_manager.models.get(k)
+        if entry is None:
+            continue
+        manager.register_model(info.model_uri, entry["model_name"], entry.get("description"), entry.get("tags"))
+
+
+class AbstractModelManager(ABC):
+    """Abstract model-registry manager (reference: mlflow.py:35-73)."""
+
+    @abstractmethod
+    def __init__(self, runtime) -> None:
+        self.runtime = runtime
+
+    @abstractmethod
+    def register_model(
+        self, model_location: str, model_name: str, description: Optional[str] = None, tags: Optional[Dict] = None
+    ) -> Any:
+        """Register a model in the model registry."""
+
+    @abstractmethod
+    def get_latest_version(self, model_name: str) -> Any:
+        """Get the latest version of a model."""
+
+    @abstractmethod
+    def transition_model(self, model_name: str, version: int, stage: str, description: Optional[str] = None) -> Any:
+        """Transition the model with the given version to a new stage."""
+
+    @abstractmethod
+    def delete_model(self, model_name: str, version: int, description: Optional[str] = None) -> None:
+        """Delete a model with the given version."""
+
+    @abstractmethod
+    def register_best_models(
+        self,
+        experiment_name: str,
+        models_info: Dict[str, Dict[str, Any]],
+        metric: str = "Test/cumulative_reward",
+        mode: Literal["max", "min"] = "max",
+    ) -> Any:
+        """Register the best models from an experiment."""
+
+    @abstractmethod
+    def download_model(self, model_name: str, version: int, output_path: str) -> None:
+        """Download the model with the given version."""
+
+
+class MlflowModelManager(AbstractModelManager):
+    """MLflow-backed registry manager (reference: mlflow.py:76-366)."""
+
+    def __init__(self, runtime, tracking_uri: Optional[str] = None):
+        super().__init__(runtime)
+        self.tracking_uri = tracking_uri or mlflow.get_tracking_uri()
+        mlflow.set_tracking_uri(self.tracking_uri)
+        self.client = MlflowClient()
+
+    @staticmethod
+    def _author_and_date() -> str:
+        return (
+            f"**Author**: {getpass.getuser()}  \n"
+            f"**Date**: {datetime.now().strftime('%d/%m/%Y %H:%M:%S')}  \n"
+        )
+
+    @staticmethod
+    def _description(description: Optional[str]) -> str:
+        return DESCRIPTION_MD_TEMPLATE.format(description or "-")
+
+    def register_model(
+        self, model_location: str, model_name: str, description: Optional[str] = None, tags: Optional[Dict] = None
+    ) -> ModelVersion:
+        model_version = mlflow.register_model(model_uri=model_location, name=model_name, tags=tags)
+        self.runtime.print(f"Registered model {model_name} with version {model_version.version}")
+        registered = self.client.get_registered_model(model_name).description or ""
+        header = "# MODEL CHANGELOG\n" if model_version.version == "1" else ""
+        entry = (
+            VERSION_MD_TEMPLATE.format(model_version.version)
+            + self._author_and_date()
+            + self._description(description)
+        )
+        self.client.update_registered_model(model_name, header + registered + entry)
+        self.client.update_model_version(model_name, model_version.version, "# MODEL CHANGELOG\n" + entry)
+        return model_version
+
+    def get_latest_version(self, model_name: str) -> ModelVersion:
+        versions = self.client.search_model_versions(f"name = '{model_name}'")
+        latest = max(versions, key=lambda v: int(v.version))
+        return latest
+
+    def transition_model(
+        self, model_name: str, version: int, stage: str, description: Optional[str] = None
+    ) -> ModelVersion:
+        model_version = self.client.get_model_version(model_name, version)
+        self.runtime.print(f"Transitioning model {model_name} version {version} to {stage}")
+        self.client.transition_model_version_stage(model_name, version, stage)
+        entry = (
+            f"### Transition: \n**Version {version}** to stage **{stage}**\n"
+            + self._author_and_date()
+            + self._description(description)
+        )
+        registered = self.client.get_registered_model(model_name).description or ""
+        self.client.update_registered_model(model_name, registered + entry)
+        self.client.update_model_version(
+            model_name, version, (model_version.description or "") + entry
+        )
+        return self.client.get_model_version(model_name, version)
+
+    def delete_model(self, model_name: str, version: int, description: Optional[str] = None) -> None:
+        self.runtime.print(f"Deleting model {model_name} version {version}")
+        self.client.delete_model_version(model_name, version)
+        registered = self.client.get_registered_model(model_name).description or ""
+        entry = (
+            f"### Deletion: \n**Version {version}**\n"
+            + self._author_and_date()
+            + self._description(description)
+        )
+        self.client.update_registered_model(model_name, registered + entry)
+
+    def register_best_models(
+        self,
+        experiment_name: str,
+        models_info: Dict[str, Dict[str, Any]],
+        metric: str = "Test/cumulative_reward",
+        mode: Literal["max", "min"] = "max",
+    ) -> Dict[str, ModelVersion]:
+        experiment = mlflow.get_experiment_by_name(experiment_name)
+        if experiment is None:
+            raise ValueError(f"Experiment '{experiment_name}' not found")
+        order = "DESC" if mode == "max" else "ASC"
+        runs = self.client.search_runs(
+            [experiment.experiment_id],
+            order_by=[f"metrics.`{metric.replace('/', '_')}` {order}"],
+            max_results=1,
+        )
+        if not runs:
+            raise ValueError(f"No runs found for experiment '{experiment_name}'")
+        best_run = runs[0]
+        registered: Dict[str, ModelVersion] = {}
+        for key, info in models_info.items():
+            registered[key] = self.register_model(
+                f"runs:/{best_run.info.run_id}/{info.get('path', key)}",
+                info["model_name"],
+                info.get("description"),
+                info.get("tags"),
+            )
+        return registered
+
+    def download_model(self, model_name: str, version: int, output_path: str) -> None:
+        if not os.path.exists(output_path):
+            self.runtime.print(f"Creating output path {output_path}")
+            os.makedirs(output_path)
+        artifact_uri = self.client.get_model_version_download_uri(model_name, version)
+        mlflow.artifacts.download_artifacts(artifact_uri=artifact_uri, dst_path=output_path)

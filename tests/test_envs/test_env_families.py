@@ -84,7 +84,7 @@ class TestImportGates:
         if available:
             importlib.import_module(module)  # must import cleanly
         else:
-            with pytest.raises(ModuleNotFoundError, match="is required for this environment"):
+            with pytest.raises(ModuleNotFoundError, match="is required for this feature"):
                 importlib.import_module(module)
 
 
