@@ -18,7 +18,9 @@ AGGREGATOR_KEYS = {
     "Loss/alpha_loss",
     "Loss/reconstruction_loss",
 }
-MODELS_TO_REGISTER = {"agent", "encoder", "decoder"}
+# The whole train state (incl. encoder/decoder params) checkpoints under
+# one "agent" key, so that is the registered-model unit.
+MODELS_TO_REGISTER = {"agent"}
 
 
 def preprocess_obs(obs: jax.Array, key: jax.Array, bits: int = 8) -> jax.Array:

@@ -113,19 +113,30 @@ def _prune_metric_and_model_keys(cfg: dotdict, utils_module) -> None:
         MetricAggregator.disabled = cfg.metric.log_level == 0 or len(cfg.metric.aggregator.metrics) == 0
 
     if cfg.get("model_manager") is not None and not cfg.model_manager.disabled:
-        predefined = set()
-        if not hasattr(utils_module, "MODELS_TO_REGISTER"):
-            warnings.warn(
-                f"No 'MODELS_TO_REGISTER' set found for the {cfg.algo.name} algorithm. "
-                "No model will be registered.",
-                UserWarning,
-            )
-        else:
-            predefined = utils_module.MODELS_TO_REGISTER
-        for k in set(cfg.model_manager.models.keys()) - predefined:
-            cfg.model_manager.models.pop(k, None)
-        if len(cfg.model_manager.models) == 0:
-            cfg.model_manager.disabled = True
+        _prune_model_keys(cfg, utils_module)
+
+
+def _prune_model_keys(cfg: dotdict, utils_module) -> None:
+    """Drop model-manager entries the algorithm does not checkpoint; warn and
+    disable when nothing remains."""
+    predefined = set()
+    if not hasattr(utils_module, "MODELS_TO_REGISTER"):
+        warnings.warn(
+            f"No 'MODELS_TO_REGISTER' set found for the {cfg.algo.name} algorithm. "
+            "No model will be registered.",
+            UserWarning,
+        )
+    else:
+        predefined = utils_module.MODELS_TO_REGISTER
+    for k in set(cfg.model_manager.models.keys()) - predefined:
+        cfg.model_manager.models.pop(k, None)
+    if len(cfg.model_manager.models) == 0:
+        warnings.warn(
+            f"No model-manager entries match the '{cfg.algo.name}' algorithm's registered-model "
+            f"contract ({sorted(predefined)}); model registration is disabled.",
+            UserWarning,
+        )
+        cfg.model_manager.disabled = True
 
 
 def run_algorithm(cfg: dotdict) -> None:
@@ -248,8 +259,7 @@ def registration(args: Optional[Sequence[str]] = None) -> None:
     utils_module = importlib.import_module(entry.module.rsplit(".", 1)[0] + ".utils")
     models_keys = sorted(getattr(utils_module, "MODELS_TO_REGISTER", set()))
     cfg.model_manager.disabled = False
-    for k in set(cfg.model_manager.models.keys()) - set(models_keys):
-        cfg.model_manager.models.pop(k, None)
+    _prune_model_keys(cfg, utils_module)
 
     from sheeprl_tpu.utils.checkpoint import load_checkpoint
     from sheeprl_tpu.utils.mlflow import register_model_from_checkpoint

@@ -1,0 +1,7 @@
+"""`python -m sheeprl_tpu.eval` → evaluation CLI
+(reference console script `sheeprl-eval`)."""
+
+from sheeprl_tpu.cli import evaluation
+
+if __name__ == "__main__":
+    evaluation()

@@ -74,12 +74,23 @@ class MLflowLogger:
 
         _flatten(dict(cfg))
         # MLflow caps params per batch; log defensively.
+        import warnings as _warnings
+
         for i in range(0, len(flat), 90):
             chunk = dict(list(flat.items())[i : i + 90])
             try:
                 mlflow.log_params({k: str(v)[:250] for k, v in chunk.items()})
             except Exception:  # pragma: no cover - server-side validation
-                pass
+                # One bad key must not discard the whole chunk: retry each
+                # param alone and warn about the rejects.
+                bad = []
+                for k, v in chunk.items():
+                    try:
+                        mlflow.log_params({k: str(v)[:250]})
+                    except Exception:
+                        bad.append(k)
+                if bad:
+                    _warnings.warn(f"MLflow rejected hyperparameters: {bad}", UserWarning)
 
     def close(self) -> None:
         mlflow.end_run()

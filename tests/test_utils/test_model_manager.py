@@ -30,7 +30,7 @@ def test_mlflow_module_is_import_gated():
     "algo, expected",
     [
         ("ppo", {"agent"}),
-        ("sac_ae", {"agent", "encoder", "decoder"}),
+        ("sac_ae", {"agent"}),
         ("dreamer_v3", {"world_model", "actor", "critic", "target_critic", "moments"}),
         (
             "p2e_dv2_exploration",
