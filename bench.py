@@ -108,20 +108,43 @@ def bench_dreamer_v3():
         "checkpoint.save_last=False",
         "metric.log_level=0",
     ]
-    cfg = compose(
-        "config", common + [f"algo.total_steps={steps}", "algo.learning_starts=1024"]
-    )
-    check_configs(cfg)
     # Warmup compiles the player step AND the train step (learning must start
     # within the warmup horizon).
     warmup = compose(
         "config", common + ["algo.total_steps=1536", "algo.learning_starts=128"]
     )
+    check_configs(warmup)
     _run_silent(warmup)
-    start = time.perf_counter()
-    _run_silent(cfg)
-    elapsed = time.perf_counter() - start
-    sps = steps / elapsed
+
+    # Steady-state measurement, TIME-BOXED: run escalating step counts until
+    # one takes >= MIN_MEASURE_S or the full reference workload (16,384
+    # steps) completes. The metric is steps/sec either way, so a slow
+    # device link degrades the number, never the bench's ability to report.
+    MIN_MEASURE_S = 120.0
+    sps = None
+    measured_steps = 2048
+    while True:
+        # learning_starts scales with the workload (1/16, the reference
+        # recipe's 1024/16384 ratio) so every escalation level is a scaled
+        # replica of the full benchmark — the untrained prefix can never
+        # dominate a short run.
+        cfg = compose(
+            "config",
+            common
+            + [
+                f"algo.total_steps={measured_steps}",
+                f"algo.learning_starts={measured_steps // 16}",
+            ],
+        )
+        check_configs(cfg)
+        start = time.perf_counter()
+        _run_silent(cfg)
+        elapsed = time.perf_counter() - start
+        sps = measured_steps / elapsed
+        if elapsed >= MIN_MEASURE_S or measured_steps >= steps:
+            break
+        # Aim for ~2x MIN_MEASURE_S on the next run, capped at the full workload.
+        measured_steps = min(steps, max(measured_steps * 2, int(sps * MIN_MEASURE_S * 2)))
     return {
         "metric": "dreamer_v3_env_steps_per_sec",
         "value": round(sps, 2),

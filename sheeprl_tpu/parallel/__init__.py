@@ -1,0 +1,15 @@
+"""Context/sequence parallelism primitives (TPU-native extra).
+
+The reference framework has no attention and no sequence parallelism of any
+kind (SURVEY §5.7) — its temporal mixing is recurrent. These primitives are
+the long-context hooks the TPU design carries so transformer world models /
+long-sequence training can shard the sequence axis across the mesh:
+
+- :func:`ring_attention` — blockwise attention with K/V rotating around the
+  device ring (`shard_map` + `ppermute`), online-softmax accumulation.
+- :func:`seq_all_to_all` — Ulysses-style sequence<->heads exchange.
+"""
+
+from sheeprl_tpu.parallel.ring_attention import ring_attention, seq_all_to_all
+
+__all__ = ["ring_attention", "seq_all_to_all"]

@@ -72,7 +72,14 @@ def test_two_process_allgather_and_log_dir_broadcast(tmp_path):
         )
         for i in range(2)
     ]
-    outs = [p.communicate(timeout=220)[0].decode() for p in procs]
+    try:
+        outs = [p.communicate(timeout=220)[0].decode() for p in procs]
+    finally:
+        # A hung worker must not outlive the test (it holds the coordinator
+        # port and would collide with a re-run).
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out}"
         assert f"proc {i} OK" in out
