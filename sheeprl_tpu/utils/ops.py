@@ -64,6 +64,27 @@ def two_hot_decoder(x: jax.Array, support_range: int) -> jax.Array:
 
 
 # ------------------------------------------------------------------- gae
+def _gae_preamble(rewards, values, dones, next_value, gamma):
+    # fp32 island: return/advantage accumulation is never done in bf16
+    # (parity with the reference keeping these ops in fp32; SURVEY §7.2).
+    rewards = rewards.astype(jnp.float32)
+    values = values.astype(jnp.float32)
+    next_value = next_value.astype(jnp.float32)
+    not_dones = (1.0 - dones).astype(jnp.float32)
+    next_values = jnp.concatenate([values[1:], next_value[None]], axis=0)
+    deltas = rewards + gamma * not_dones * next_values - values
+    return values, deltas, not_dones
+
+
+def _lambda_preamble(rewards, values, continues, lmbda):
+    # fp32 island: TD(λ) accumulation stays out of bf16 whatever the policy.
+    rewards = rewards.astype(jnp.float32)
+    values = values.astype(jnp.float32)
+    continues = continues.astype(jnp.float32)
+    interm = rewards + continues * values * (1 - lmbda)
+    return values, interm, continues
+
+
 def gae(
     rewards: jax.Array,
     values: jax.Array,
@@ -79,14 +100,7 @@ def gae(
     next_value, and adv[t] = delta[t] + gamma * lambda * not_done[t] *
     adv[t+1] — here as one reverse `lax.scan`. Returns (returns, advantages).
     """
-    # fp32 island: return/advantage accumulation is never done in bf16
-    # (parity with the reference keeping these ops in fp32; SURVEY §7.2).
-    rewards = rewards.astype(jnp.float32)
-    values = values.astype(jnp.float32)
-    next_value = next_value.astype(jnp.float32)
-    not_dones = (1.0 - dones).astype(jnp.float32)
-    next_values = jnp.concatenate([values[1:], next_value[None]], axis=0)
-    deltas = rewards + gamma * not_dones * next_values - values
+    values, deltas, not_dones = _gae_preamble(rewards, values, dones, next_value, gamma)
 
     def step(carry, x):
         delta, nd = x
@@ -109,11 +123,7 @@ def compute_lambda_values(
     Reference reverse loop: sheeprl/algos/dreamer_v3/utils.py:66-77 —
     L[t] = r[t] + c[t] * ((1 - λ) * V[t] + λ * L[t+1]), seeded L[T] = V[T-1].
     """
-    # fp32 island: TD(λ) accumulation stays out of bf16 whatever the policy.
-    rewards = rewards.astype(jnp.float32)
-    values = values.astype(jnp.float32)
-    continues = continues.astype(jnp.float32)
-    interm = rewards + continues * values * (1 - lmbda)
+    values, interm, continues = _lambda_preamble(rewards, values, continues, lmbda)
 
     def step(nxt, x):
         i, c = x
@@ -122,6 +132,55 @@ def compute_lambda_values(
 
     _, out = jax.lax.scan(step, values[-1], (interm, continues), reverse=True)
     return out
+
+
+# ------------------------------------------- parallel-time formulations
+def _affine_suffix_scan(a: jax.Array, b: jax.Array, seed: jax.Array) -> jax.Array:
+    """Solve y[t] = b[t] + a[t] * y[t+1] (y[T] = seed) for all t in
+    O(log T) depth via `jax.lax.associative_scan` — the blockwise/parallel
+    alternative to the O(T) reverse `lax.scan` (SURVEY §5.7's long-sequence
+    hook). The pair (a, b) composes as an affine map y -> a*y + b.
+    """
+    # Fold the seed into the last element: y[T-1] = b[T-1] + a[T-1]*seed.
+    b = b.at[-1].add(a[-1] * seed)
+
+    def combine(later, earlier):
+        # earlier maps y_{t+k} -> y_t given later maps y_{t+k+m} -> y_{t+k}
+        a_l, b_l = later
+        a_e, b_e = earlier
+        return a_e * a_l, a_e * b_l + b_e
+
+    _, y = jax.lax.associative_scan(combine, (a, b), reverse=True)
+    return y
+
+
+def gae_associative(
+    rewards: jax.Array,
+    values: jax.Array,
+    dones: jax.Array,
+    next_value: jax.Array,
+    gamma: float,
+    gae_lambda: float,
+) -> Tuple[jax.Array, jax.Array]:
+    """`gae` with the time recurrence as an associative scan (O(log T)
+    depth) — mathematically equivalent (matches to fp32 tolerance; the
+    reassociated reduction rounds differently); preferable for very long
+    rollouts."""
+    values, deltas, not_dones = _gae_preamble(rewards, values, dones, next_value, gamma)
+    adv = _affine_suffix_scan(gamma * gae_lambda * not_dones, deltas, jnp.zeros_like(deltas[0]))
+    return adv + values, adv
+
+
+def compute_lambda_values_associative(
+    rewards: jax.Array,
+    values: jax.Array,
+    continues: jax.Array,
+    lmbda: float = 0.95,
+) -> jax.Array:
+    """`compute_lambda_values` with the recurrence as an associative scan
+    (mathematically equivalent; matches to fp32 tolerance)."""
+    values, interm, continues = _lambda_preamble(rewards, values, continues, lmbda)
+    return _affine_suffix_scan(continues * lmbda, interm, values[-1])
 
 
 # -------------------------------------------------------------- normalize

@@ -169,3 +169,41 @@ class TestMoments:
         f = jax.jit(update_moments)
         state, (low, inv) = f(init_moments(), jnp.ones(8))
         assert low.shape == ()
+
+
+class TestAssociativeScanFormulations:
+    """The O(log T)-depth associative-scan GAE / TD(lambda) must match the
+    reverse-scan versions exactly (same fp32 math, different schedule)."""
+
+    def test_gae_associative_matches_scan(self):
+        import jax
+        from sheeprl_tpu.utils.ops import gae, gae_associative
+
+        key = jax.random.PRNGKey(0)
+        ks = jax.random.split(key, 4)
+        T, N = 37, 5
+        rewards = jax.random.normal(ks[0], (T, N, 1))
+        values = jax.random.normal(ks[1], (T, N, 1))
+        dones = (jax.random.uniform(ks[2], (T, N, 1)) < 0.15).astype(jnp.float32)
+        next_value = jax.random.normal(ks[3], (N, 1))
+        ret_s, adv_s = gae(rewards, values, dones, next_value, 0.99, 0.95)
+        ret_a, adv_a = gae_associative(rewards, values, dones, next_value, 0.99, 0.95)
+        np.testing.assert_allclose(np.asarray(adv_a), np.asarray(adv_s), atol=1e-4, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(ret_a), np.asarray(ret_s), atol=1e-4, rtol=1e-5)
+
+    def test_lambda_values_associative_matches_scan(self):
+        import jax
+        from sheeprl_tpu.utils.ops import (
+            compute_lambda_values,
+            compute_lambda_values_associative,
+        )
+
+        key = jax.random.PRNGKey(1)
+        ks = jax.random.split(key, 3)
+        H, B = 16, 64
+        rewards = jax.random.normal(ks[0], (H, B, 1))
+        values = jax.random.normal(ks[1], (H, B, 1))
+        continues = (jax.random.uniform(ks[2], (H, B, 1)) < 0.9).astype(jnp.float32) * 0.997
+        out_s = compute_lambda_values(rewards, values, continues, 0.95)
+        out_a = compute_lambda_values_associative(rewards, values, continues, 0.95)
+        np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_s), atol=1e-4, rtol=1e-5)
