@@ -2,23 +2,27 @@
 {"metric", "value", "unit", "vs_baseline"}.
 
 Default workload: **DreamerV3** — the north-star metric (BASELINE.json) — on
-the reference benchmark recipe (configs/exp/dreamer_v3_benchmarks.yaml:1-41):
-16,384 policy steps, 1 env, micro world model (dense_units=8, discrete=4,
-stochastic=4, recurrent=8), learning_starts=1024, replay_ratio=0.0625,
-batch 16 × sequence 64. Reference wall-clock: 1589.30 s on 4 CPUs
-(README.md:168-176) → ~10.31 env-steps/sec.
+the reference benchmark recipe (configs/exp/dreamer_v3_benchmarks.yaml):
+16,384 policy steps, 1 env, micro world model, learning_starts=1024,
+replay_ratio=0.0625, batch 16 x sequence 64. Reference wall-clock: 1589.30 s
+on 4 CPUs (README.md:168-176) -> ~10.31 env-steps/sec.
 
-Divergence (documented): the reference benchmark steps MsPacman through ALE;
-ALE is not installed in this image, so the env is the deterministic dummy
-pixel env (64×64×3 uint8 — one channel MORE than the reference's grayscale
-Atari frames). The ALE emulator contributes only a few seconds of the
-reference's 1589 s (it runs at ~10k fps), so the comparison remains dominated
-by what the benchmark actually measures: the world-model/actor/critic
-training step and the per-step policy latency.
+Every workload is TIME-BOXED: escalating scaled replicas of the reference
+recipe run until one yields a >=120 s steady-state measurement (or the full
+workload completes), so a slow device link degrades the number, never the
+bench's ability to report. learning_starts scales with the measured steps at
+the reference's prefix ratio.
 
-Select the secondary workload with `python bench.py ppo`:
-PPO CartPole-v1, 16,384 steps vs the README PPO benchmark (65,536 steps in
-81.27 s, README.md:100-117).
+Divergence (documented): the reference Dreamer benchmarks step MsPacman
+through ALE; ALE is not installed in this image, so the env is the
+deterministic dummy pixel env (64x64x3 uint8 — one channel MORE than the
+reference's grayscale Atari frames). The ALE emulator contributes only a few
+seconds of the reference's wall-clock (it runs at ~10k fps), so the
+comparison stays dominated by what the benchmark measures: the
+world-model/actor/critic training step and the per-step policy latency.
+
+Workloads: `python bench.py [dreamer_v3|dreamer_v2|dreamer_v1|ppo|a2c|sac]`.
+Reference baselines from BASELINE.md (README.md:83-180).
 """
 
 import json
@@ -47,110 +51,100 @@ def _run_silent(cfg):
         run_algorithm(cfg)
 
 
-def bench_ppo():
+MIN_MEASURE_S = 120.0
+
+
+def _timeboxed(
+    metric: str,
+    exp: str,
+    total_steps: int,
+    baseline_sps: float,
+    *,
+    learning_starts_ratio: float = 0.0,
+    extra=(),
+    warmup_steps: int = 1536,
+    start_steps: int = 2048,
+):
     from sheeprl_tpu.cli import check_configs
     from sheeprl_tpu.config.loader import compose
 
-    steps = 16384
-    baseline_sps = 65536 / 81.27  # README.md:100-117
-    common = [
-        "exp=ppo_benchmarks",
-        "checkpoint.every=0",
-        "checkpoint.save_last=False",
-    ]
-    cfg = compose("config", common + [f"algo.total_steps={steps}"])
-    check_configs(cfg)
-    warmup = compose("config", common + ["algo.total_steps=256"])
-    _run_silent(warmup)
-    start = time.perf_counter()
-    _run_silent(cfg)
-    elapsed = time.perf_counter() - start
-    sps = steps / elapsed
-    return {
-        "metric": "ppo_cartpole_env_steps_per_sec",
-        "value": round(sps, 2),
-        "unit": "env-steps/sec",
-        "vs_baseline": round(sps / baseline_sps, 3),
-    }
+    common = [f"exp={exp}", "checkpoint.every=0", "checkpoint.save_last=False", *extra]
 
+    def overrides(steps):
+        out = common + [f"algo.total_steps={steps}"]
+        if learning_starts_ratio > 0:
+            out.append(f"algo.learning_starts={max(1, int(steps * learning_starts_ratio))}")
+        return out
 
-def bench_dreamer_v3():
-    from sheeprl_tpu.cli import check_configs
-    from sheeprl_tpu.config.loader import compose
-
-    steps = 16384
-    baseline_sps = 16384 / 1589.30  # README.md:168-176 (V100-class 4-CPU box)
-    common = [
-        "exp=dreamer_v3",
-        "env=dummy",
-        "env.num_envs=1",
-        "env.sync_env=True",
-        "env.capture_video=False",
-        "env.screen_size=64",
-        "algo.cnn_keys.encoder=[rgb]",
-        "algo.mlp_keys.encoder=[]",
-        "algo.mlp_keys.decoder=[]",
-        "algo.cnn_keys.decoder=[rgb]",
-        # micro world model, reference benchmark sizes
-        "algo.dense_units=8",
-        "algo.mlp_layers=1",
-        "algo.world_model.discrete_size=4",
-        "algo.world_model.stochastic_size=4",
-        "algo.world_model.encoder.cnn_channels_multiplier=2",
-        "algo.world_model.recurrent_model.recurrent_state_size=8",
-        "algo.world_model.transition_model.hidden_size=8",
-        "algo.world_model.representation_model.hidden_size=8",
-        "algo.replay_ratio=0.0625",
-        "algo.run_test=False",
-        "buffer.size=16384",
-        "buffer.memmap=False",
-        "checkpoint.every=0",
-        "checkpoint.save_last=False",
-        "metric.log_level=0",
-    ]
-    # Warmup compiles the player step AND the train step (learning must start
-    # within the warmup horizon).
-    warmup = compose(
-        "config", common + ["algo.total_steps=1536", "algo.learning_starts=128"]
-    )
+    warmup = compose("config", overrides(warmup_steps))
     check_configs(warmup)
     _run_silent(warmup)
 
-    # Steady-state measurement, TIME-BOXED: run escalating step counts until
-    # one takes >= MIN_MEASURE_S or the full reference workload (16,384
-    # steps) completes. The metric is steps/sec either way, so a slow
-    # device link degrades the number, never the bench's ability to report.
-    MIN_MEASURE_S = 120.0
-    sps = None
-    measured_steps = 2048
+    measured_steps = start_steps
     while True:
-        # learning_starts scales with the workload (1/16, the reference
-        # recipe's 1024/16384 ratio) so every escalation level is a scaled
-        # replica of the full benchmark — the untrained prefix can never
-        # dominate a short run.
-        cfg = compose(
-            "config",
-            common
-            + [
-                f"algo.total_steps={measured_steps}",
-                f"algo.learning_starts={measured_steps // 16}",
-            ],
-        )
+        cfg = compose("config", overrides(measured_steps))
         check_configs(cfg)
         start = time.perf_counter()
         _run_silent(cfg)
         elapsed = time.perf_counter() - start
         sps = measured_steps / elapsed
-        if elapsed >= MIN_MEASURE_S or measured_steps >= steps:
+        if elapsed >= MIN_MEASURE_S or measured_steps >= total_steps:
             break
-        # Aim for ~2x MIN_MEASURE_S on the next run, capped at the full workload.
-        measured_steps = min(steps, max(measured_steps * 2, int(sps * MIN_MEASURE_S * 2)))
+        measured_steps = min(
+            total_steps, max(measured_steps * 2, int(sps * MIN_MEASURE_S * 2))
+        )
     return {
-        "metric": "dreamer_v3_env_steps_per_sec",
+        "metric": metric,
         "value": round(sps, 2),
         "unit": "env-steps/sec",
         "vs_baseline": round(sps / baseline_sps, 3),
     }
+
+
+def bench_ppo():
+    # README.md:100-117 — 65,536 steps in 81.27 s
+    return _timeboxed(
+        "ppo_cartpole_env_steps_per_sec", "ppo_benchmarks", 65536, 65536 / 81.27,
+        warmup_steps=512, start_steps=16384,
+    )
+
+
+def bench_a2c():
+    # README.md:118-133 — 65,536 steps in 84.76 s
+    return _timeboxed(
+        "a2c_cartpole_env_steps_per_sec", "a2c_benchmarks", 65536, 65536 / 84.76,
+        warmup_steps=512, start_steps=16384,
+    )
+
+
+def bench_sac():
+    # README.md:139-140 — 65,536 steps in 320.21 s
+    return _timeboxed(
+        "sac_env_steps_per_sec", "sac_benchmarks", 65536, 65536 / 320.21,
+        learning_starts_ratio=100 / 65536, warmup_steps=1024, start_steps=4096,
+    )
+
+
+def _bench_dreamer(version: str, baseline_seconds: float):
+    return _timeboxed(
+        f"dreamer_v{version}_env_steps_per_sec",
+        f"dreamer_v{version}_benchmarks",
+        16384,
+        16384 / baseline_seconds,
+        learning_starts_ratio=1024 / 16384,
+    )
+
+
+def bench_dreamer_v1():
+    return _bench_dreamer("1", 2207.13)  # README.md:150-158
+
+
+def bench_dreamer_v2():
+    return _bench_dreamer("2", 906.42)  # README.md:159-167
+
+
+def bench_dreamer_v3():
+    return _bench_dreamer("3", 1589.30)  # README.md:168-176
 
 
 def main() -> None:
@@ -159,7 +153,14 @@ def main() -> None:
 
     sheeprl_tpu.register_all()
     which = sys.argv[1] if len(sys.argv) > 1 else "dreamer_v3"
-    result = {"dreamer_v3": bench_dreamer_v3, "ppo": bench_ppo}[which]()
+    result = {
+        "dreamer_v3": bench_dreamer_v3,
+        "dreamer_v2": bench_dreamer_v2,
+        "dreamer_v1": bench_dreamer_v1,
+        "ppo": bench_ppo,
+        "a2c": bench_a2c,
+        "sac": bench_sac,
+    }[which]()
     print(json.dumps(result))
 
 

@@ -172,8 +172,9 @@ class TestMoments:
 
 
 class TestAssociativeScanFormulations:
-    """The O(log T)-depth associative-scan GAE / TD(lambda) must match the
-    reverse-scan versions exactly (same fp32 math, different schedule)."""
+    """The O(log T)-depth associative-scan GAE / TD(lambda) match the
+    reverse-scan versions to fp32 tolerance (the reassociated reduction
+    rounds differently — bitwise equality is NOT the contract)."""
 
     def test_gae_associative_matches_scan(self):
         import jax
