@@ -1,0 +1,32 @@
+"""Skeleton for an EXTERNAL algorithm package (reference:
+examples/architecture_template.py; see howto/register_external_algorithm.md).
+
+Copy this layout into your own package, implement the pieces, point
+SHEEPRL_SEARCH_PATH at your configs, and import the module before calling
+`sheeprl_tpu.cli.run` — the registry treats it like a built-in.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from sheeprl_tpu.registry import register_algorithm, register_evaluation
+
+# Your utils module must expose these two contracts:
+AGGREGATOR_KEYS = {"Rewards/rew_avg", "Game/ep_len_avg", "Loss/policy_loss"}
+MODELS_TO_REGISTER = {"agent"}
+
+
+@register_algorithm(name="ext_sota")
+def main(runtime, cfg: Dict[str, Any]) -> None:
+    """The training loop: build envs with sheeprl_tpu.utils.env.make_env,
+    build your agent params, create ONE jitted donated train step sharded
+    over runtime.mesh, roll out on host, checkpoint with
+    sheeprl_tpu.utils.checkpoint.save_checkpoint."""
+    raise NotImplementedError("implement your training loop here")
+
+
+@register_evaluation(algorithms="ext_sota")
+def evaluate(runtime, cfg: Dict[str, Any], state: Dict[str, Any]) -> None:
+    """Rebuild the agent from `state` and play one greedy episode."""
+    raise NotImplementedError("implement your evaluation here")

@@ -1,0 +1,42 @@
+"""Print the observation space an agent would see for an env configuration
+(reference: examples/observation_space.py).
+
+Usage:
+    python examples/observation_space.py agent=dreamer_v3 env=dmc \
+        algo.cnn_keys.encoder=[rgb] algo.mlp_keys.encoder=[state]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import gymnasium as gym
+
+import sheeprl_tpu
+from sheeprl_tpu.config.loader import compose
+from sheeprl_tpu.registry import algorithm_registry
+from sheeprl_tpu.utils.env import make_env
+
+
+def main() -> None:
+    sheeprl_tpu.register_all()
+    cfg = compose("env_config", sys.argv[1:])
+    cfg.env.capture_video = False
+    # Any registered algorithm (incl. external ones) is valid; p2e family
+    # aliases resolve to their exploration phase.
+    known = set(algorithm_registry) | {n.rsplit("_", 1)[0] for n in algorithm_registry if "p2e" in n}
+    if cfg.agent not in known:
+        raise ValueError(
+            "Invalid selected agent: check the available agents with the command "
+            "`python -m sheeprl_tpu.available_agents`"
+        )
+    env: gym.Env = make_env(cfg, cfg.seed, 0)()
+    print()
+    print(f"Observation space of `{cfg.env.id}` environment for `{cfg.agent}` agent:")
+    print(env.observation_space)
+    env.close()
+
+
+if __name__ == "__main__":
+    main()
