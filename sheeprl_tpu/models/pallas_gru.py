@@ -108,7 +108,11 @@ def _pallas_ln_gru(inp, w, b, scale, ln_bias, h, *, interpret: bool = False):
     bp, dp = inp.shape
 
     b_tile = min(_B_TILE, bp)
+    # Adapt the D tile to the VMEM budget: wide hidden states (L/XL configs,
+    # 3H up to 12k) shrink the K-tile instead of losing the kernel.
     d_tile = min(_D_TILE, dp)
+    while d_tile > 128 and d_tile * h3 * 4 > _W_TILE_BUDGET:
+        d_tile //= 2
     grid = (pl.cdiv(bp, b_tile), pl.cdiv(dp, d_tile))
 
     out, z = pl.pallas_call(
@@ -140,8 +144,9 @@ def _eligible(inp, w, h) -> bool:
     hidden = h.shape[-1]
     if hidden % 128 != 0:
         return False
-    d_tile = min(_D_TILE, inp.shape[-1])
-    if d_tile * 3 * hidden * 4 > _W_TILE_BUDGET:
+    # The adaptive D-tiling floors at 128 lanes; beyond that the W tile
+    # cannot fit the budget.
+    if 128 * 3 * hidden * 4 > _W_TILE_BUDGET:
         return False
     return jax.default_backend() == "tpu"
 

@@ -90,3 +90,14 @@ class TestFusedLNGRU:
         monkeypatch.setenv("SHEEPRL_TPU_FUSED_GRU", "1")
         out_on = cell.apply(params, h, x)
         np.testing.assert_allclose(np.asarray(out_on), np.asarray(out_off), atol=1e-6)
+
+    def test_adaptive_d_tile_for_wide_hidden(self, monkeypatch):
+        """Wide hidden states shrink the K-tile instead of losing the kernel
+        (the L/XL eligibility path)."""
+        import sheeprl_tpu.models.pallas_gru as pg
+
+        monkeypatch.setattr(pg, "_W_TILE_BUDGET", 2 * 1024 * 1024)
+        args = _random_case(jax.random.PRNGKey(7), batch=8, d=512, hidden=512)
+        out_plain = _plain_ln_gru(*args)[0]
+        out_kernel = _pallas_ln_gru(*args, interpret=True)[0]
+        np.testing.assert_allclose(np.asarray(out_kernel), np.asarray(out_plain), atol=1e-4)
