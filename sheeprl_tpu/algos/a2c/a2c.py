@@ -26,6 +26,7 @@ from sheeprl_tpu.algos.ppo.agent import PPOAgent, actions_metadata, build_agent
 from sheeprl_tpu.algos.ppo.loss import entropy_loss
 from sheeprl_tpu.config.instantiate import instantiate, locate
 from sheeprl_tpu.core.mesh import DATA_AXIS
+from sheeprl_tpu.core.player import PlayerPlacement
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.registry import register_algorithm
 from sheeprl_tpu.utils.checkpoint import load_checkpoint, restore_opt_state, save_checkpoint
@@ -126,25 +127,30 @@ def main(runtime, cfg: Dict[str, Any]):
 
     actions_dim, is_continuous = actions_metadata(envs.single_action_space)
 
-    agent, params = build_agent(
-        runtime, actions_dim, is_continuous, cfg, observation_space,
-        state["agent"] if state is not None else None,
-    )
+    # Eager flax/optax init runs host-side (each eager dispatch pays the
+    # device-link round trip); the finished trees then move to the mesh.
+    with runtime.host_init():
+        agent, params = build_agent(
+            runtime, actions_dim, is_continuous, cfg, observation_space,
+            state["agent"] if state is not None else None,
+        )
 
-    optim_cfg = dict(cfg.algo.optimizer)
-    optim_target = optim_cfg.pop("_target_")
-    base_lr = float(optim_cfg.pop("lr"))
+        optim_cfg = dict(cfg.algo.optimizer)
+        optim_target = optim_cfg.pop("_target_")
+        base_lr = float(optim_cfg.pop("lr"))
 
-    def make_tx(lr):
-        inner = locate(optim_target)(lr=lr, **optim_cfg)
-        if cfg.algo.max_grad_norm > 0.0:
-            return optax.chain(optax.clip_by_global_norm(cfg.algo.max_grad_norm), inner)
-        return inner
+        def make_tx(lr):
+            inner = locate(optim_target)(lr=lr, **optim_cfg)
+            if cfg.algo.max_grad_norm > 0.0:
+                return optax.chain(optax.clip_by_global_norm(cfg.algo.max_grad_norm), inner)
+            return inner
 
-    tx = optax.inject_hyperparams(make_tx)(lr=base_lr)
-    opt_state = tx.init(params)
-    if state is not None:
-        opt_state = restore_opt_state(opt_state, state["optimizer"])
+        tx = optax.inject_hyperparams(make_tx)(lr=base_lr)
+        opt_state = tx.init(params)
+        if state is not None:
+            opt_state = restore_opt_state(opt_state, state["optimizer"])
+    params = runtime.shard_params(params)
+    opt_state = runtime.shard_params(opt_state)
 
     if runtime.is_global_zero:
         save_configs(cfg, log_dir)
@@ -200,7 +206,14 @@ def main(runtime, cfg: Dict[str, Any]):
     )
     train_fn = make_train_step(agent, tx, cfg, mesh)
 
+    # Latency-aware player placement (core/player.py); on-policy => fresh.
+    placement = PlayerPlacement.resolve(
+        cfg, mesh.devices.flat[0], params=params, force_fresh=True
+    )
+    placement.push(params)
+
     rollout_key, train_key = jax.random.split(jax.random.fold_in(runtime.root_key, rank))
+    rollout_key = placement.put(rollout_key)
 
     step_data = {}
     next_obs = envs.reset(seed=cfg.seed)[0]
@@ -212,13 +225,14 @@ def main(runtime, cfg: Dict[str, Any]):
             policy_step += cfg.env.num_envs * world_size
 
             with timer("Time/env_interaction_time"):
-                jnp_obs = prepare_obs(next_obs, mlp_keys=obs_keys, num_envs=cfg.env.num_envs)
-                rollout_key, sub = jax.random.split(rollout_key)
-                # Single host fetch for the whole step output (one
-                # device->host roundtrip instead of four).
-                actions, real_actions_np, logprobs, values = jax.device_get(
-                    player_step_fn(params, jnp_obs, sub)
-                )
+                with placement.ctx():
+                    jnp_obs = prepare_obs(next_obs, mlp_keys=obs_keys, num_envs=cfg.env.num_envs)
+                    rollout_key, sub = jax.random.split(rollout_key)
+                    # Single host fetch for the whole step output (one
+                    # device->host roundtrip instead of four).
+                    actions, real_actions_np, logprobs, values = jax.device_get(
+                        player_step_fn(placement.params(), jnp_obs, sub)
+                    )
 
                 obs, rewards, terminated, truncated, info = envs.step(
                     real_actions_np.reshape(envs.action_space.shape)
@@ -230,8 +244,9 @@ def main(runtime, cfg: Dict[str, Any]):
                         k: np.stack([np.asarray(final_obs[e][k], np.float32) for e in truncated_envs])
                         for k in obs_keys
                     }
-                    jnp_next = prepare_obs(real_next_obs, mlp_keys=obs_keys, num_envs=len(truncated_envs))
-                    vals = np.asarray(get_values_fn(params, jnp_next))
+                    with placement.ctx():
+                        jnp_next = prepare_obs(real_next_obs, mlp_keys=obs_keys, num_envs=len(truncated_envs))
+                        vals = np.asarray(get_values_fn(placement.params(), jnp_next))
                     rewards[truncated_envs] += cfg.algo.gamma * vals.reshape(rewards[truncated_envs].shape)
                 dones = np.logical_or(terminated, truncated).reshape(cfg.env.num_envs, -1).astype(np.uint8)
                 rewards = rewards.reshape(cfg.env.num_envs, -1).astype(np.float32)
@@ -264,14 +279,15 @@ def main(runtime, cfg: Dict[str, Any]):
                     runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
 
         local_data = rb.to_tensor()
-        jnp_obs = prepare_obs(next_obs, mlp_keys=obs_keys, num_envs=cfg.env.num_envs)
-        next_values = get_values_fn(params, jnp_obs)
-        returns, advantages = gae_fn(
-            jnp.asarray(np.asarray(local_data["rewards"]), jnp.float32),
-            jnp.asarray(np.asarray(local_data["values"]), jnp.float32),
-            jnp.asarray(np.asarray(local_data["dones"]), jnp.float32),
-            next_values,
-        )
+        with placement.ctx():
+            jnp_obs = prepare_obs(next_obs, mlp_keys=obs_keys, num_envs=cfg.env.num_envs)
+            next_values = get_values_fn(placement.params(), jnp_obs)
+            returns, advantages = gae_fn(
+                jnp.asarray(np.asarray(local_data["rewards"]), jnp.float32),
+                jnp.asarray(np.asarray(local_data["values"]), jnp.float32),
+                jnp.asarray(np.asarray(local_data["dones"]), jnp.float32),
+                next_values,
+            )
         local_data["returns"] = np.asarray(returns)
         local_data["advantages"] = np.asarray(advantages)
 
@@ -291,6 +307,7 @@ def main(runtime, cfg: Dict[str, Any]):
             # H2D infeed + train overlap the next env steps.
             if not timer.disabled:
                 jax.block_until_ready(params)
+        placement.push(params)
         train_step_count += world_size
 
         if aggregator and not aggregator.disabled:

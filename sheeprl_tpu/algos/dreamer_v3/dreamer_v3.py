@@ -41,6 +41,8 @@ from sheeprl_tpu.algos.dreamer_v3.utils import prepare_obs, test
 from sheeprl_tpu.algos.ppo.agent import actions_metadata
 from sheeprl_tpu.config.instantiate import instantiate, locate
 from sheeprl_tpu.core.mesh import DATA_AXIS
+from sheeprl_tpu.core.player import PlayerPlacement
+from sheeprl_tpu.data.infeed import ReplayInfeed
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.registry import register_algorithm
@@ -425,38 +427,40 @@ def main(runtime, cfg: Dict[str, Any]):
         runtime.print("Decoder MLP keys:", cfg.algo.mlp_keys.decoder)
     obs_keys = list(cfg.algo.cnn_keys.encoder) + list(cfg.algo.mlp_keys.encoder)
 
-    agent, agent_state = build_agent(
-        runtime,
-        actions_dim,
-        is_continuous,
-        cfg,
-        observation_space,
-        state_ckpt["world_model"] if state_ckpt is not None else None,
-        state_ckpt["actor"] if state_ckpt is not None else None,
-        state_ckpt["critic"] if state_ckpt is not None else None,
-        state_ckpt["target_critic"] if state_ckpt is not None else None,
-    )
+    # Eager flax/optax init runs host-side (each eager dispatch pays the device-link round trip); shard_params then moves the finished trees to the mesh.
+    with runtime.host_init():
+        agent, agent_state = build_agent(
+            runtime,
+            actions_dim,
+            is_continuous,
+            cfg,
+            observation_space,
+            state_ckpt["world_model"] if state_ckpt is not None else None,
+            state_ckpt["actor"] if state_ckpt is not None else None,
+            state_ckpt["critic"] if state_ckpt is not None else None,
+            state_ckpt["target_critic"] if state_ckpt is not None else None,
+        )
 
-    txs = {
-        "world_model": _make_optimizer(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients),
-        "actor": _make_optimizer(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients),
-        "critic": _make_optimizer(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients),
-    }
-    opt_states = {
-        "world_model": txs["world_model"].init(agent_state["world_model"]),
-        "actor": txs["actor"].init(agent_state["actor"]),
-        "critic": txs["critic"].init(agent_state["critic"]),
-    }
-    if state_ckpt is not None:
-        for name, ckpt_key in (
-            ("world_model", "world_optimizer"),
-            ("actor", "actor_optimizer"),
-            ("critic", "critic_optimizer"),
-        ):
-            opt_states[name] = restore_opt_state(opt_states[name], state_ckpt[ckpt_key])
+        txs = {
+            "world_model": _make_optimizer(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients),
+            "actor": _make_optimizer(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients),
+            "critic": _make_optimizer(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients),
+        }
+        opt_states = {
+            "world_model": txs["world_model"].init(agent_state["world_model"]),
+            "actor": txs["actor"].init(agent_state["actor"]),
+            "critic": txs["critic"].init(agent_state["critic"]),
+        }
+        if state_ckpt is not None:
+            for name, ckpt_key in (
+                ("world_model", "world_optimizer"),
+                ("actor", "actor_optimizer"),
+                ("critic", "critic_optimizer"),
+            ):
+                opt_states[name] = restore_opt_state(opt_states[name], state_ckpt[ckpt_key])
 
-    # Explicit mesh placement: replicated, or tensor-parallel over the model
-    # axis for the wide dense stacks when fabric.model_axis > 1.
+        # Explicit mesh placement: replicated, or tensor-parallel over the model
+        # axis for the wide dense stacks when fabric.model_axis > 1.
     agent_state = runtime.shard_params(agent_state)
     opt_states = runtime.shard_params(opt_states)
 
@@ -515,13 +519,36 @@ def main(runtime, cfg: Dict[str, Any]):
         )
 
     train_fn = make_train_step(agent, txs, cfg, mesh)
+
+    # Async infeed (data/infeed.py): the next train call's sampled batches
+    # are copied host->device by a worker thread while envs step, so the
+    # pixel-batch H2D never sits on the critical path.
+    infeed = ReplayInfeed(
+        rb,
+        cfg.algo.per_rank_batch_size,
+        cfg.algo.per_rank_sequence_length,
+        cfg.algo.cnn_keys.encoder,
+        enabled=cfg.buffer.get("prefetch", True),
+    )
+
     player_step_fn = jax.jit(
         lambda wm, a, s, o, k: agent.player_step(wm, a, s, o, k, greedy=False)
     )
     init_player_fn = jax.jit(agent.init_player_state, static_argnums=(1,))
     reset_player_fn = jax.jit(agent.reset_player_state)
 
+    # Latency-aware player placement (core/player.py): the encoder->GRU->
+    # posterior->actor per-step forward runs where dispatch is cheapest; the
+    # mirror refreshes world-model+actor after every train call. Off-policy:
+    # honors fabric.player_sync=async.
+    placement = PlayerPlacement.resolve(
+        cfg, mesh.devices.flat[0],
+        params={"world_model": agent_state["world_model"], "actor": agent_state["actor"]},
+    )
+    placement.push({"world_model": agent_state["world_model"], "actor": agent_state["actor"]})
+
     rollout_key, train_key = jax.random.split(jax.random.fold_in(runtime.root_key, rank))
+    rollout_key = placement.put(rollout_key)
 
     step_data = {}
     obs = envs.reset(seed=cfg.seed)[0]
@@ -531,7 +558,8 @@ def main(runtime, cfg: Dict[str, Any]):
     step_data["truncated"] = np.zeros((1, cfg.env.num_envs, 1), np.float32)
     step_data["terminated"] = np.zeros((1, cfg.env.num_envs, 1), np.float32)
     step_data["is_first"] = np.ones_like(step_data["terminated"])
-    player_state = init_player_fn(agent_state["world_model"], cfg.env.num_envs)
+    with placement.ctx():
+        player_state = init_player_fn(placement.params()["world_model"], cfg.env.num_envs)
 
     cumulative_per_rank_gradient_steps = 0
     for iter_num in range(start_iter, total_iters + 1):
@@ -549,11 +577,13 @@ def main(runtime, cfg: Dict[str, Any]):
                         axis=-1,
                     )
             else:
-                jnp_obs = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
-                rollout_key, sub = jax.random.split(rollout_key)
-                actions_cat, real_actions_j, player_state = player_step_fn(
-                    agent_state["world_model"], agent_state["actor"], player_state, jnp_obs, sub
-                )
+                with placement.ctx():
+                    jnp_obs = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
+                    rollout_key, sub = jax.random.split(rollout_key)
+                    pp = placement.params()
+                    actions_cat, real_actions_j, player_state = player_step_fn(
+                        pp["world_model"], pp["actor"], player_state, jnp_obs, sub
+                    )
                 # One host fetch for both arrays: each separate np.asarray
                 # is a full device->host roundtrip (painful over a tunneled
                 # chip); jax.device_get of the tuple costs one.
@@ -632,18 +662,17 @@ def main(runtime, cfg: Dict[str, Any]):
             step_data["is_first"][:, dones_idxes] = np.ones_like(step_data["is_first"][:, dones_idxes])
             reset_mask = np.zeros((cfg.env.num_envs,), np.float32)
             reset_mask[dones_idxes] = 1.0
-            player_state = reset_player_fn(agent_state["world_model"], player_state, jnp.asarray(reset_mask))
+            with placement.ctx():
+                player_state = reset_player_fn(
+                    placement.params()["world_model"], player_state, jnp.asarray(reset_mask)
+                )
 
         # ------------------------------------------------------- training
         if iter_num >= learning_starts:
             ratio_steps = policy_step - prefill_steps * policy_steps_per_iter
             per_rank_gradient_steps = ratio(ratio_steps / world_size)
             if per_rank_gradient_steps > 0:
-                local_data = rb.sample_tensors(
-                    cfg.algo.per_rank_batch_size,
-                    sequence_length=cfg.algo.per_rank_sequence_length,
-                    n_samples=per_rank_gradient_steps,
-                )
+                batches = infeed.take_or_sample(per_rank_gradient_steps)
                 per_step_metrics = []
                 with timer("Time/train_time"):
                     for i in range(per_rank_gradient_steps):
@@ -655,11 +684,7 @@ def main(runtime, cfg: Dict[str, Any]):
                             tau = 1.0 if cumulative_per_rank_gradient_steps == 0 else cfg.algo.critic.tau
                         else:
                             tau = 0.0
-                        batch = {
-                            k: jnp.asarray(np.asarray(v[i]), jnp.float32) if k not in cfg.algo.cnn_keys.encoder
-                            else jnp.asarray(np.asarray(v[i]))
-                            for k, v in local_data.items()
-                        }
+                        batch = batches[i]
                         train_key, sub = jax.random.split(train_key)
                         agent_state, opt_states, moments_state, train_metrics = train_fn(
                             agent_state, opt_states, moments_state, batch, sub, jnp.asarray(tau, jnp.float32)
@@ -671,7 +696,16 @@ def main(runtime, cfg: Dict[str, Any]):
                     # H2D infeed + train overlap the next env steps.
                     if not timer.disabled:
                         jax.block_until_ready(agent_state["world_model"])
+                    # One mirror refresh per train call (the player only acts
+                    # again after the whole gradient-step loop, so this is
+                    # exactly the reference's tied-weights freshness).
+                    placement.push(
+                        {"world_model": agent_state["world_model"], "actor": agent_state["actor"]}
+                    )
                     train_step_count += world_size
+                # Sample on the main thread (no buffer race); stage the device
+                # copies to overlap the next env-step phase.
+                infeed.stage(per_rank_gradient_steps)
 
                 # Feed EVERY gradient step's losses to the aggregator (the
                 # reference updates per step; only sampling the last one
@@ -742,6 +776,7 @@ def main(runtime, cfg: Dict[str, Any]):
             if runtime.is_global_zero:
                 save_checkpoint(ckpt_path, ckpt_state, keep_last=cfg.checkpoint.keep_last)
 
+    infeed.close()
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:
         test(agent, agent_state, runtime, cfg, log_dir, logger)

@@ -33,6 +33,8 @@ from sheeprl_tpu.algos.p2e_dv1.utils import prepare_obs, test
 from sheeprl_tpu.algos.ppo.agent import actions_metadata
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.core.mesh import DATA_AXIS
+from sheeprl_tpu.core.player import PlayerPlacement
+from sheeprl_tpu.data.infeed import ReplayInfeed
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.registry import register_algorithm
 from sheeprl_tpu.utils.checkpoint import load_checkpoint, restore_opt_state, save_checkpoint
@@ -366,46 +368,48 @@ def main(runtime, cfg: Dict[str, Any]):
         raise RuntimeError("The CNN keys or the MLP keys of the encoder and decoder must not be disjointed")
     obs_keys = list(cfg.algo.cnn_keys.encoder) + list(cfg.algo.mlp_keys.encoder)
 
-    agent, agent_state = build_agent(
-        runtime,
-        actions_dim,
-        is_continuous,
-        cfg,
-        observation_space,
-        state_ckpt["world_model"] if state_ckpt is not None else None,
-        state_ckpt["ensembles"] if state_ckpt is not None else None,
-        state_ckpt["actor_task"] if state_ckpt is not None else None,
-        state_ckpt["critic_task"] if state_ckpt is not None else None,
-        state_ckpt["actor_exploration"] if state_ckpt is not None else None,
-        state_ckpt["critic_exploration"] if state_ckpt is not None else None,
-    )
+    # Eager flax/optax init runs host-side (each eager dispatch pays the device-link round trip); shard_params then moves the finished trees to the mesh.
+    with runtime.host_init():
+        agent, agent_state = build_agent(
+            runtime,
+            actions_dim,
+            is_continuous,
+            cfg,
+            observation_space,
+            state_ckpt["world_model"] if state_ckpt is not None else None,
+            state_ckpt["ensembles"] if state_ckpt is not None else None,
+            state_ckpt["actor_task"] if state_ckpt is not None else None,
+            state_ckpt["critic_task"] if state_ckpt is not None else None,
+            state_ckpt["actor_exploration"] if state_ckpt is not None else None,
+            state_ckpt["critic_exploration"] if state_ckpt is not None else None,
+        )
 
-    txs = {
-        "world_model": _make_optimizer(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients),
-        "actor_task": _make_optimizer(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients),
-        "critic_task": _make_optimizer(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients),
-        "actor_exploration": _make_optimizer(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients),
-        "critic_exploration": _make_optimizer(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients),
-        "ensembles": _make_optimizer(cfg.algo.ensembles.optimizer, cfg.algo.ensembles.clip_gradients),
-    }
-    opt_states = {
-        "world_model": txs["world_model"].init(agent_state["world_model"]),
-        "actor_task": txs["actor_task"].init(agent_state["actor_task"]),
-        "critic_task": txs["critic_task"].init(agent_state["critic_task"]),
-        "actor_exploration": txs["actor_exploration"].init(agent_state["actor_exploration"]),
-        "critic_exploration": txs["critic_exploration"].init(agent_state["critic_exploration"]),
-        "ensembles": txs["ensembles"].init(agent_state["ensembles"]),
-    }
-    if state_ckpt is not None:
-        for name, ckpt_key in (
-            ("world_model", "world_optimizer"),
-            ("actor_task", "actor_task_optimizer"),
-            ("critic_task", "critic_task_optimizer"),
-            ("actor_exploration", "actor_exploration_optimizer"),
-            ("critic_exploration", "critic_exploration_optimizer"),
-            ("ensembles", "ensemble_optimizer"),
-        ):
-            opt_states[name] = restore_opt_state(opt_states[name], state_ckpt[ckpt_key])
+        txs = {
+            "world_model": _make_optimizer(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients),
+            "actor_task": _make_optimizer(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients),
+            "critic_task": _make_optimizer(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients),
+            "actor_exploration": _make_optimizer(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients),
+            "critic_exploration": _make_optimizer(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients),
+            "ensembles": _make_optimizer(cfg.algo.ensembles.optimizer, cfg.algo.ensembles.clip_gradients),
+        }
+        opt_states = {
+            "world_model": txs["world_model"].init(agent_state["world_model"]),
+            "actor_task": txs["actor_task"].init(agent_state["actor_task"]),
+            "critic_task": txs["critic_task"].init(agent_state["critic_task"]),
+            "actor_exploration": txs["actor_exploration"].init(agent_state["actor_exploration"]),
+            "critic_exploration": txs["critic_exploration"].init(agent_state["critic_exploration"]),
+            "ensembles": txs["ensembles"].init(agent_state["ensembles"]),
+        }
+        if state_ckpt is not None:
+            for name, ckpt_key in (
+                ("world_model", "world_optimizer"),
+                ("actor_task", "actor_task_optimizer"),
+                ("critic_task", "critic_task_optimizer"),
+                ("actor_exploration", "actor_exploration_optimizer"),
+                ("critic_exploration", "critic_exploration_optimizer"),
+                ("ensembles", "ensemble_optimizer"),
+            ):
+                opt_states[name] = restore_opt_state(opt_states[name], state_ckpt[ckpt_key])
 
     agent_state = runtime.shard_params(agent_state)
     opt_states = runtime.shard_params(opt_states)
@@ -473,7 +477,30 @@ def main(runtime, cfg: Dict[str, Any]):
         "actor_exploration" if cfg.algo.player.actor_type == "exploration" else "actor_task"
     )
 
+    # Latency-aware player placement (core/player.py); off-policy: honors
+    # fabric.player_sync=async. Mirror = world model + the player's actor.
+    placement = PlayerPlacement.resolve(
+        cfg, runtime.mesh.devices.flat[0],
+        params={"world_model": agent_state["world_model"], "actor": agent_state[player_actor_key]},
+    )
+    placement.push(
+        {"world_model": agent_state["world_model"], "actor": agent_state[player_actor_key]}
+    )
+
+
+    # Async infeed (data/infeed.py): the next train call's sampled batches
+    # are copied host->device by a worker thread while envs step, so the
+    # pixel-batch H2D never sits on the critical path.
+    infeed = ReplayInfeed(
+        rb,
+        cfg.algo.per_rank_batch_size,
+        cfg.algo.per_rank_sequence_length,
+        cfg.algo.cnn_keys.encoder,
+        enabled=cfg.buffer.get("prefetch", True),
+    )
+
     rollout_key, train_key = jax.random.split(jax.random.fold_in(runtime.root_key, rank))
+    rollout_key = placement.put(rollout_key)
 
     step_data = {}
     obs = envs.reset(seed=cfg.seed)[0]
@@ -484,7 +511,8 @@ def main(runtime, cfg: Dict[str, Any]):
     step_data["actions"] = np.zeros((1, cfg.env.num_envs, int(np.sum(actions_dim))), np.float32)
     step_data["rewards"] = np.zeros((1, cfg.env.num_envs, 1), np.float32)
     rb.add(step_data, validate_args=cfg.buffer.validate_args)
-    player_state = init_player_fn(agent_state["world_model"], cfg.env.num_envs)
+    with placement.ctx():
+        player_state = init_player_fn(placement.params()["world_model"], cfg.env.num_envs)
 
     cumulative_per_rank_gradient_steps = 0
     for iter_num in range(start_iter, total_iters + 1):
@@ -502,17 +530,19 @@ def main(runtime, cfg: Dict[str, Any]):
                         axis=-1,
                     )
             else:
-                jnp_obs = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
-                rollout_key, sub = jax.random.split(rollout_key)
-                amount = exploration_amount(agent.actor_spec, policy_step)
-                actions_cat, real_actions_j, player_state = player_step_fn(
-                    agent_state["world_model"],
-                    agent_state[player_actor_key],
-                    player_state,
-                    jnp_obs,
-                    sub,
-                    jnp.asarray(amount, jnp.float32),
-                )
+                with placement.ctx():
+                    jnp_obs = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
+                    rollout_key, sub = jax.random.split(rollout_key)
+                    amount = exploration_amount(agent.actor_spec, policy_step)
+                    pp = placement.params()
+                    actions_cat, real_actions_j, player_state = player_step_fn(
+                        pp["world_model"],
+                        pp["actor"],
+                        player_state,
+                        jnp_obs,
+                        sub,
+                        jnp.asarray(amount, jnp.float32),
+                    )
                 # One host fetch for both arrays: each separate np.asarray
                 # is a full device->host roundtrip (painful over a tunneled
                 # chip); jax.device_get of the tuple costs one.
@@ -569,26 +599,21 @@ def main(runtime, cfg: Dict[str, Any]):
                 step_data["truncated"][0, d] = np.zeros_like(step_data["truncated"][0, d])
             reset_mask = np.zeros((cfg.env.num_envs,), np.float32)
             reset_mask[dones_idxes] = 1.0
-            player_state = reset_player_fn(agent_state["world_model"], player_state, jnp.asarray(reset_mask))
+            with placement.ctx():
+                player_state = reset_player_fn(
+                    placement.params()["world_model"], player_state, jnp.asarray(reset_mask)
+                )
 
         # ------------------------------------------------------- training
         if iter_num >= learning_starts:
             ratio_steps = policy_step - prefill_steps * policy_steps_per_iter
             per_rank_gradient_steps = ratio(ratio_steps / world_size)
             if per_rank_gradient_steps > 0:
-                local_data = rb.sample_tensors(
-                    cfg.algo.per_rank_batch_size,
-                    sequence_length=cfg.algo.per_rank_sequence_length,
-                    n_samples=per_rank_gradient_steps,
-                )
+                batches = infeed.take_or_sample(per_rank_gradient_steps)
                 per_step_metrics = []
                 with timer("Time/train_time"):
                     for i in range(per_rank_gradient_steps):
-                        batch = {
-                            k: jnp.asarray(np.asarray(v[i]), jnp.float32) if k not in cfg.algo.cnn_keys.encoder
-                            else jnp.asarray(np.asarray(v[i]))
-                            for k, v in local_data.items()
-                        }
+                        batch = batches[i]
                         train_key, sub = jax.random.split(train_key)
                         agent_state, opt_states, train_metrics = train_fn(
                             agent_state, opt_states, batch, sub
@@ -600,7 +625,13 @@ def main(runtime, cfg: Dict[str, Any]):
                     # H2D infeed + train overlap the next env steps.
                     if not timer.disabled:
                         jax.block_until_ready(agent_state["world_model"])
+                    placement.push(
+                        {"world_model": agent_state["world_model"], "actor": agent_state[player_actor_key]}
+                    )
                     train_step_count += world_size
+                # Sample on the main thread (no buffer race); stage the device
+                # copies to overlap the next env-step phase.
+                infeed.stage(per_rank_gradient_steps)
 
                 if aggregator and not aggregator.disabled:
                     # One host fetch for every metric of every gradient step
@@ -672,6 +703,7 @@ def main(runtime, cfg: Dict[str, Any]):
             if runtime.is_global_zero:
                 save_checkpoint(ckpt_path, ckpt_state, keep_last=cfg.checkpoint.keep_last)
 
+    infeed.close()
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:
         test(

@@ -28,6 +28,8 @@ from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import _make_optimizer, make_train_
 from sheeprl_tpu.algos.p2e_dv3.utils import prepare_obs, test
 from sheeprl_tpu.algos.ppo.agent import actions_metadata
 from sheeprl_tpu.config.instantiate import instantiate
+from sheeprl_tpu.core.player import PlayerPlacement
+from sheeprl_tpu.data.infeed import ReplayInfeed
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.registry import register_algorithm
@@ -116,38 +118,40 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
     obs_keys = list(cfg.algo.cnn_keys.encoder) + list(cfg.algo.mlp_keys.encoder)
 
     # Task models drive the DV3 train step; the exploration actor only plays.
-    agent, agent_state = dv3_build_agent(
-        runtime,
-        actions_dim,
-        is_continuous,
-        cfg,
-        observation_space,
-        state_ckpt["world_model"],
-        state_ckpt["actor_task"],
-        state_ckpt["critic_task"],
-        state_ckpt["target_critic_task"],
-    )
-    actor_exploration_params = jax.tree_util.tree_map(
-        jnp.asarray, state_ckpt["actor_exploration"]
-    )
+    # Eager flax/optax init runs host-side (each eager dispatch pays the device-link round trip); shard_params then moves the finished trees to the mesh.
+    with runtime.host_init():
+        agent, agent_state = dv3_build_agent(
+            runtime,
+            actions_dim,
+            is_continuous,
+            cfg,
+            observation_space,
+            state_ckpt["world_model"],
+            state_ckpt["actor_task"],
+            state_ckpt["critic_task"],
+            state_ckpt["target_critic_task"],
+        )
+        actor_exploration_params = jax.tree_util.tree_map(
+            jnp.asarray, state_ckpt["actor_exploration"]
+        )
 
-    txs = {
-        "world_model": _make_optimizer(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients),
-        "actor": _make_optimizer(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients),
-        "critic": _make_optimizer(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients),
-    }
-    opt_states = {
-        "world_model": txs["world_model"].init(agent_state["world_model"]),
-        "actor": txs["actor"].init(agent_state["actor"]),
-        "critic": txs["critic"].init(agent_state["critic"]),
-    }
-    if resume_from_checkpoint:
-        for name, ckpt_key in (
-            ("world_model", "world_optimizer"),
-            ("actor", "actor_task_optimizer"),
-            ("critic", "critic_task_optimizer"),
-        ):
-            opt_states[name] = restore_opt_state(opt_states[name], state_ckpt[ckpt_key])
+        txs = {
+            "world_model": _make_optimizer(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients),
+            "actor": _make_optimizer(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients),
+            "critic": _make_optimizer(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients),
+        }
+        opt_states = {
+            "world_model": txs["world_model"].init(agent_state["world_model"]),
+            "actor": txs["actor"].init(agent_state["actor"]),
+            "critic": txs["critic"].init(agent_state["critic"]),
+        }
+        if resume_from_checkpoint:
+            for name, ckpt_key in (
+                ("world_model", "world_optimizer"),
+                ("actor", "actor_task_optimizer"),
+                ("critic", "critic_task_optimizer"),
+            ):
+                opt_states[name] = restore_opt_state(opt_states[name], state_ckpt[ckpt_key])
 
     agent_state = runtime.shard_params(agent_state)
     opt_states = runtime.shard_params(opt_states)
@@ -227,7 +231,30 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
     # takes over (reference: p2e_dv3_finetuning.py:350-353).
     player_actor_type = cfg.algo.player.actor_type
 
+    # Latency-aware player placement (core/player.py); off-policy: honors
+    # fabric.player_sync=async. The frozen exploration actor is mirrored once;
+    # the trained world model + task actor refresh after every train call.
+    placement = PlayerPlacement.resolve(
+        cfg, runtime.mesh.devices.flat[0],
+        params={"world_model": agent_state["world_model"], "actor": agent_state["actor"]},
+    )
+    placement.push({"world_model": agent_state["world_model"], "actor": agent_state["actor"]})
+    player_actor_exploration = placement.put(actor_exploration_params)
+
+
+    # Async infeed (data/infeed.py): the next train call's sampled batches
+    # are copied host->device by a worker thread while envs step, so the
+    # pixel-batch H2D never sits on the critical path.
+    infeed = ReplayInfeed(
+        rb,
+        cfg.algo.per_rank_batch_size,
+        cfg.algo.per_rank_sequence_length,
+        cfg.algo.cnn_keys.encoder,
+        enabled=cfg.buffer.get("prefetch", True),
+    )
+
     rollout_key, train_key = jax.random.split(jax.random.fold_in(runtime.root_key, rank))
+    rollout_key = placement.put(rollout_key)
 
     step_data = {}
     obs = envs.reset(seed=cfg.seed)[0]
@@ -237,21 +264,24 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
     step_data["truncated"] = np.zeros((1, cfg.env.num_envs, 1), np.float32)
     step_data["terminated"] = np.zeros((1, cfg.env.num_envs, 1), np.float32)
     step_data["is_first"] = np.ones_like(step_data["terminated"])
-    player_state = init_player_fn(agent_state["world_model"], cfg.env.num_envs)
+    with placement.ctx():
+        player_state = init_player_fn(placement.params()["world_model"], cfg.env.num_envs)
 
     cumulative_per_rank_gradient_steps = 0
     for iter_num in range(start_iter, total_iters + 1):
         policy_step += policy_steps_per_iter
 
         with timer("Time/env_interaction_time"):
-            player_actor = (
-                actor_exploration_params if player_actor_type == "exploration" else agent_state["actor"]
-            )
-            jnp_obs = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
-            rollout_key, sub = jax.random.split(rollout_key)
-            actions_cat, real_actions_j, player_state = player_step_fn(
-                agent_state["world_model"], player_actor, player_state, jnp_obs, sub
-            )
+            with placement.ctx():
+                pp = placement.params()
+                player_actor = (
+                    player_actor_exploration if player_actor_type == "exploration" else pp["actor"]
+                )
+                jnp_obs = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
+                rollout_key, sub = jax.random.split(rollout_key)
+                actions_cat, real_actions_j, player_state = player_step_fn(
+                    pp["world_model"], player_actor, player_state, jnp_obs, sub
+                )
             # One host fetch for both arrays (single roundtrip).
             actions, real_actions = jax.device_get((actions_cat, real_actions_j))
 
@@ -325,7 +355,10 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
             step_data["is_first"][:, dones_idxes] = np.ones_like(step_data["is_first"][:, dones_idxes])
             reset_mask = np.zeros((cfg.env.num_envs,), np.float32)
             reset_mask[dones_idxes] = 1.0
-            player_state = reset_player_fn(agent_state["world_model"], player_state, jnp.asarray(reset_mask))
+            with placement.ctx():
+                player_state = reset_player_fn(
+                    placement.params()["world_model"], player_state, jnp.asarray(reset_mask)
+                )
 
         # ------------------------------------------------------- training
         if iter_num >= learning_starts:
@@ -335,11 +368,7 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
             ratio_steps = policy_step - prefill_steps * policy_steps_per_iter
             per_rank_gradient_steps = ratio(ratio_steps / world_size)
             if per_rank_gradient_steps > 0:
-                local_data = rb.sample_tensors(
-                    cfg.algo.per_rank_batch_size,
-                    sequence_length=cfg.algo.per_rank_sequence_length,
-                    n_samples=per_rank_gradient_steps,
-                )
+                batches = infeed.take_or_sample(per_rank_gradient_steps)
                 per_step_metrics = []
                 with timer("Time/train_time"):
                     for i in range(per_rank_gradient_steps):
@@ -351,11 +380,7 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
                             tau = 1.0 if cumulative_per_rank_gradient_steps == 0 else cfg.algo.critic.tau
                         else:
                             tau = 0.0
-                        batch = {
-                            k: jnp.asarray(np.asarray(v[i]), jnp.float32) if k not in cfg.algo.cnn_keys.encoder
-                            else jnp.asarray(np.asarray(v[i]))
-                            for k, v in local_data.items()
-                        }
+                        batch = batches[i]
                         train_key, sub = jax.random.split(train_key)
                         agent_state, opt_states, moments_state, train_metrics = train_fn(
                             agent_state, opt_states, moments_state, batch, sub, jnp.asarray(tau, jnp.float32)
@@ -367,7 +392,13 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
                     # H2D infeed + train overlap the next env steps.
                     if not timer.disabled:
                         jax.block_until_ready(agent_state["world_model"])
+                    placement.push(
+                        {"world_model": agent_state["world_model"], "actor": agent_state["actor"]}
+                    )
                     train_step_count += world_size
+                # Sample on the main thread (no buffer race); stage the device
+                # copies to overlap the next env-step phase.
+                infeed.stage(per_rank_gradient_steps)
 
                 if aggregator and not aggregator.disabled:
                     # One host fetch for every metric of every gradient step
@@ -436,6 +467,7 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
             if runtime.is_global_zero:
                 save_checkpoint(ckpt_path, ckpt_state, keep_last=cfg.checkpoint.keep_last)
 
+    infeed.close()
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:
         test(agent, agent_state, runtime, cfg, log_dir, logger)

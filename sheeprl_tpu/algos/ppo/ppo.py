@@ -36,6 +36,7 @@ from sheeprl_tpu.algos.ppo.agent import PPOAgent, actions_metadata, build_agent
 from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
 from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
 from sheeprl_tpu.core.mesh import DATA_AXIS
+from sheeprl_tpu.core.player import PlayerPlacement
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.registry import register_algorithm
 from sheeprl_tpu.utils.checkpoint import load_checkpoint, restore_opt_state, save_checkpoint
@@ -162,28 +163,33 @@ def main(runtime, cfg: Dict[str, Any]):
     clip_rewards_fn = (lambda r: np.tanh(r)) if cfg.env.clip_rewards else (lambda r: r)
 
     # ---------------------------------------------------------------- agent
-    agent, params = build_agent(
-        runtime, actions_dim, is_continuous, cfg, observation_space,
-        state["agent"] if state is not None else None,
-    )
+    # Eager flax/optax init runs host-side (each eager dispatch pays the
+    # device-link round trip); the finished trees then move to the mesh.
+    with runtime.host_init():
+        agent, params = build_agent(
+            runtime, actions_dim, is_continuous, cfg, observation_space,
+            state["agent"] if state is not None else None,
+        )
 
-    # optimizer: inject lr so annealing is a hyperparam update, not a rebuild
-    optim_cfg = dict(cfg.algo.optimizer)
-    optim_target = optim_cfg.pop("_target_")
-    base_lr = float(optim_cfg.pop("lr"))
+        # optimizer: inject lr so annealing is a hyperparam update, not a rebuild
+        optim_cfg = dict(cfg.algo.optimizer)
+        optim_target = optim_cfg.pop("_target_")
+        base_lr = float(optim_cfg.pop("lr"))
 
-    def make_tx(lr):
-        from sheeprl_tpu.config.instantiate import locate
+        def make_tx(lr):
+            from sheeprl_tpu.config.instantiate import locate
 
-        inner = locate(optim_target)(lr=lr, **optim_cfg)
-        if cfg.algo.max_grad_norm > 0.0:
-            return optax.chain(optax.clip_by_global_norm(cfg.algo.max_grad_norm), inner)
-        return inner
+            inner = locate(optim_target)(lr=lr, **optim_cfg)
+            if cfg.algo.max_grad_norm > 0.0:
+                return optax.chain(optax.clip_by_global_norm(cfg.algo.max_grad_norm), inner)
+            return inner
 
-    tx = optax.inject_hyperparams(make_tx)(lr=base_lr)
-    opt_state = tx.init(params)
-    if state is not None:
-        opt_state = restore_opt_state(opt_state, state["optimizer"])
+        tx = optax.inject_hyperparams(make_tx)(lr=base_lr)
+        opt_state = tx.init(params)
+        if state is not None:
+            opt_state = restore_opt_state(opt_state, state["optimizer"])
+    params = runtime.shard_params(params)
+    opt_state = runtime.shard_params(opt_state)
 
     if runtime.is_global_zero:
         save_configs(cfg, log_dir)
@@ -251,7 +257,16 @@ def main(runtime, cfg: Dict[str, Any]):
     )
     train_fn = make_train_step(agent, tx, cfg, mesh)
 
+    # Latency-aware player placement: the per-step policy forward runs where
+    # dispatch is cheapest (core/player.py). On-policy => always-fresh mirror
+    # (the rollout must see the post-update weights).
+    placement = PlayerPlacement.resolve(
+        cfg, mesh.devices.flat[0], params=params, force_fresh=True
+    )
+    placement.push(params)
+
     rollout_key, train_key = jax.random.split(jax.random.fold_in(runtime.root_key, rank))
+    rollout_key = placement.put(rollout_key)
 
     # --------------------------------------------------------------- loop
     step_data = {}
@@ -264,13 +279,14 @@ def main(runtime, cfg: Dict[str, Any]):
             policy_step += cfg.env.num_envs * world_size
 
             with timer("Time/env_interaction_time"):
-                jnp_obs = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
-                rollout_key, sub = jax.random.split(rollout_key)
-                # Single host fetch for the whole step output (one
-                # device->host roundtrip instead of four).
-                actions, real_actions_np, logprobs, values = jax.device_get(
-                    player_step_fn(params, jnp_obs, sub)
-                )
+                with placement.ctx():
+                    jnp_obs = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
+                    rollout_key, sub = jax.random.split(rollout_key)
+                    # Single host fetch for the whole step output (one
+                    # device->host roundtrip instead of four).
+                    actions, real_actions_np, logprobs, values = jax.device_get(
+                        player_step_fn(placement.params(), jnp_obs, sub)
+                    )
 
                 obs, rewards, terminated, truncated, info = envs.step(
                     real_actions_np.reshape(envs.action_space.shape)
@@ -284,8 +300,9 @@ def main(runtime, cfg: Dict[str, Any]):
                         k: np.stack([np.asarray(final_obs[e][k], np.float32) for e in truncated_envs])
                         for k in obs_keys
                     }
-                    jnp_next = prepare_obs(real_next_obs, cnn_keys=cnn_keys, num_envs=len(truncated_envs))
-                    vals = np.asarray(get_values_fn(params, jnp_next))
+                    with placement.ctx():
+                        jnp_next = prepare_obs(real_next_obs, cnn_keys=cnn_keys, num_envs=len(truncated_envs))
+                        vals = np.asarray(get_values_fn(placement.params(), jnp_next))
                     rewards[truncated_envs] += cfg.algo.gamma * vals.reshape(rewards[truncated_envs].shape)
                 dones = np.logical_or(terminated, truncated).reshape(cfg.env.num_envs, -1).astype(np.uint8)
                 rewards = clip_rewards_fn(rewards).reshape(cfg.env.num_envs, -1).astype(np.float32)
@@ -319,14 +336,15 @@ def main(runtime, cfg: Dict[str, Any]):
 
         # ------------------------------------------------- GAE + flatten
         local_data = rb.to_tensor()
-        jnp_obs = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
-        next_values = get_values_fn(params, jnp_obs)
-        returns, advantages = gae_fn(
-            jnp.asarray(np.asarray(local_data["rewards"]), jnp.float32),
-            jnp.asarray(np.asarray(local_data["values"]), jnp.float32),
-            jnp.asarray(np.asarray(local_data["dones"]), jnp.float32),
-            next_values,
-        )
+        with placement.ctx():
+            jnp_obs = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
+            next_values = get_values_fn(placement.params(), jnp_obs)
+            returns, advantages = gae_fn(
+                jnp.asarray(np.asarray(local_data["rewards"]), jnp.float32),
+                jnp.asarray(np.asarray(local_data["values"]), jnp.float32),
+                jnp.asarray(np.asarray(local_data["dones"]), jnp.float32),
+                next_values,
+            )
         local_data["returns"] = np.asarray(returns)
         local_data["advantages"] = np.asarray(advantages)
 
@@ -360,6 +378,7 @@ def main(runtime, cfg: Dict[str, Any]):
             # H2D infeed + train overlap the next env steps.
             if not timer.disabled:
                 jax.block_until_ready(params)
+        placement.push(params)
         train_step_count += world_size
 
         if aggregator and not aggregator.disabled:

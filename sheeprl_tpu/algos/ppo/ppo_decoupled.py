@@ -27,6 +27,7 @@ import optax
 
 from sheeprl_tpu.algos.ppo.agent import actions_metadata, build_agent
 from sheeprl_tpu.algos.ppo.ppo import _current_lr, make_train_step
+from sheeprl_tpu.core.player import ParamMirror
 from sheeprl_tpu.algos.ppo.utils import prepare_obs, test
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.core import mesh as mesh_lib
@@ -44,7 +45,9 @@ from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
 
 @register_algorithm(decoupled=True)
 def main(runtime, cfg: Dict[str, Any]):
-    player_device, trainer_mesh = split_player_trainer(runtime.mesh)
+    player_device, trainer_mesh = split_player_trainer(
+        runtime.mesh, cfg.fabric.get("player_device", "auto") or "auto"
+    )
     n_trainers = int(trainer_mesh.shape[DATA_AXIS])
     rank = runtime.global_rank
 
@@ -96,33 +99,49 @@ def main(runtime, cfg: Dict[str, Any]):
     clip_rewards_fn = (lambda r: np.tanh(r)) if cfg.env.clip_rewards else (lambda r: r)
 
     # ---------------------------------------------------------------- agent
-    agent, params = build_agent(
-        runtime, actions_dim, is_continuous, cfg, observation_space,
-        state["agent"] if state is not None else None,
-    )
+    # Eager flax/optax init runs host-side (each eager dispatch pays the
+    # device-link round trip); replicate() then moves the trees to the mesh.
+    with runtime.host_init():
+        agent, params = build_agent(
+            runtime, actions_dim, is_continuous, cfg, observation_space,
+            state["agent"] if state is not None else None,
+        )
 
-    optim_cfg = dict(cfg.algo.optimizer)
-    optim_target = optim_cfg.pop("_target_")
-    base_lr = float(optim_cfg.pop("lr"))
+        optim_cfg = dict(cfg.algo.optimizer)
+        optim_target = optim_cfg.pop("_target_")
+        base_lr = float(optim_cfg.pop("lr"))
 
-    def make_tx(lr):
-        from sheeprl_tpu.config.instantiate import locate
+        def make_tx(lr):
+            from sheeprl_tpu.config.instantiate import locate
 
-        inner = locate(optim_target)(lr=lr, **optim_cfg)
-        if cfg.algo.max_grad_norm > 0.0:
-            return optax.chain(optax.clip_by_global_norm(cfg.algo.max_grad_norm), inner)
-        return inner
+            inner = locate(optim_target)(lr=lr, **optim_cfg)
+            if cfg.algo.max_grad_norm > 0.0:
+                return optax.chain(optax.clip_by_global_norm(cfg.algo.max_grad_norm), inner)
+            return inner
 
-    tx = optax.inject_hyperparams(make_tx)(lr=base_lr)
-    opt_state = tx.init(params)
-    if state is not None:
-        opt_state = restore_opt_state(opt_state, state["optimizer"])
+        tx = optax.inject_hyperparams(make_tx)(lr=base_lr)
+        opt_state = tx.init(params)
+        if state is not None:
+            opt_state = restore_opt_state(opt_state, state["optimizer"])
 
-    # Trainer copy on the trainer mesh, player copy on the player device
-    # (the reference's "first weights" broadcast, ppo_decoupled.py:124-127).
+        # Trainer copy on the trainer mesh, player copy on the player device
+        # (the reference's "first weights" broadcast, ppo_decoupled.py:124-127).
     params = mesh_lib.replicate(params, trainer_mesh)
     opt_state = mesh_lib.replicate(opt_state, trainer_mesh)
-    params_player = jax.device_put(params, player_device)
+    # Trainer->player weight broadcast as a packed single-transfer mirror
+    # (core/player.py). On-policy: always fresh — the next rollout must see
+    # the post-update weights, exactly like the reference's blocking
+    # broadcast (ppo_decoupled.py:302).
+    params_mirror = ParamMirror(
+        # Same-silicon passthrough only for a single-device trainer partition
+        # (see sac_decoupled.py: multi-device-replicated params can't be
+        # shared with the player's single-device inputs inside jit).
+        None
+        if trainer_mesh.devices.size == 1 and player_device == trainer_mesh.devices.flat[0]
+        else player_device,
+        sync="fresh",
+    )
+    params_mirror.push(params)
 
     if runtime.is_global_zero:
         save_configs(cfg, log_dir)
@@ -198,6 +217,7 @@ def main(runtime, cfg: Dict[str, Any]):
     batch_sharding = mesh_lib.batch_sharding(trainer_mesh)
 
     rollout_key, train_key = jax.random.split(jax.random.fold_in(runtime.root_key, rank))
+    rollout_key = jax.device_put(rollout_key, player_device)
 
     # --------------------------------------------------------------- loop
     step_data = {}
@@ -210,14 +230,13 @@ def main(runtime, cfg: Dict[str, Any]):
             policy_step += cfg.env.num_envs
 
             with timer("Time/env_interaction_time"):
-                jnp_obs = jax.device_put(
-                    prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs), player_device
-                )
-                rollout_key, sub = jax.random.split(rollout_key)
+                with jax.default_device(player_device):
+                    jnp_obs = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
+                    rollout_key, sub = jax.random.split(rollout_key)
                 # Single host fetch for the whole step output (one
                 # device->host roundtrip instead of four).
                 actions, real_actions_np, logprobs, values = jax.device_get(
-                    player_step_fn(params_player, jnp_obs, sub)
+                    player_step_fn(params_mirror.get(), jnp_obs, sub)
                 )
 
                 obs, rewards, terminated, truncated, info = envs.step(
@@ -230,11 +249,9 @@ def main(runtime, cfg: Dict[str, Any]):
                         k: np.stack([np.asarray(final_obs[e][k], np.float32) for e in truncated_envs])
                         for k in obs_keys
                     }
-                    jnp_next = jax.device_put(
-                        prepare_obs(real_next_obs, cnn_keys=cnn_keys, num_envs=len(truncated_envs)),
-                        player_device,
-                    )
-                    vals = np.asarray(get_values_fn(params_player, jnp_next))
+                    with jax.default_device(player_device):
+                        jnp_next = prepare_obs(real_next_obs, cnn_keys=cnn_keys, num_envs=len(truncated_envs))
+                        vals = np.asarray(get_values_fn(params_mirror.get(), jnp_next))
                     rewards[truncated_envs] += cfg.algo.gamma * vals.reshape(rewards[truncated_envs].shape)
                 dones = np.logical_or(terminated, truncated).reshape(cfg.env.num_envs, -1).astype(np.uint8)
                 rewards = clip_rewards_fn(rewards).reshape(cfg.env.num_envs, -1).astype(np.float32)
@@ -268,16 +285,15 @@ def main(runtime, cfg: Dict[str, Any]):
 
         # --------------------------------------- GAE (player device) + ship
         local_data = rb.to_tensor()
-        jnp_obs = jax.device_put(
-            prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs), player_device
-        )
-        next_values = get_values_fn(params_player, jnp_obs)
-        returns, advantages = gae_fn(
-            jax.device_put(np.asarray(local_data["rewards"], np.float32), player_device),
-            jax.device_put(np.asarray(local_data["values"], np.float32), player_device),
-            jax.device_put(np.asarray(local_data["dones"], np.float32), player_device),
-            next_values,
-        )
+        with jax.default_device(player_device):
+            jnp_obs = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
+            next_values = get_values_fn(params_mirror.get(), jnp_obs)
+            returns, advantages = gae_fn(
+                jnp.asarray(np.asarray(local_data["rewards"], np.float32)),
+                jnp.asarray(np.asarray(local_data["values"], np.float32)),
+                jnp.asarray(np.asarray(local_data["dones"], np.float32)),
+                next_values,
+            )
         local_data["returns"] = np.asarray(returns)
         local_data["advantages"] = np.asarray(advantages)
 
@@ -303,11 +319,11 @@ def main(runtime, cfg: Dict[str, Any]):
                 jnp.asarray(cfg.algo.ent_coef, jnp.float32),
             )
             # The broadcast back: the player's next rollout waits on this copy.
-            params_player = jax.device_put(params, player_device)
+            params_mirror.push(params)
             # PPO is lockstep anyway (the next rollout waits on this copy);
             # block only when the timer needs an accurate stop.
             if not timer.disabled:
-                jax.block_until_ready(params_player)
+                jax.block_until_ready(params_mirror.get())
         train_step_count += n_trainers
 
         if aggregator and not aggregator.disabled:
@@ -378,7 +394,7 @@ def main(runtime, cfg: Dict[str, Any]):
 
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:
-        test(agent, params_player, runtime, cfg, log_dir, logger)
+        test(agent, params_mirror.get(), runtime, cfg, log_dir, logger)
 
     if logger is not None:
         logger.close()

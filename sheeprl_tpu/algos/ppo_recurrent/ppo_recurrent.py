@@ -35,6 +35,7 @@ from sheeprl_tpu.algos.ppo_recurrent.agent import RecurrentPPOAgent, build_agent
 from sheeprl_tpu.algos.ppo_recurrent.utils import test
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.core.mesh import DATA_AXIS
+from sheeprl_tpu.core.player import PlayerPlacement
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.registry import register_algorithm
 from sheeprl_tpu.utils.checkpoint import load_checkpoint, restore_opt_state, save_checkpoint
@@ -188,27 +189,32 @@ def main(runtime, cfg: Dict[str, Any]):
     actions_dim, is_continuous = actions_metadata(envs.single_action_space)
     clip_rewards_fn = (lambda r: np.tanh(r)) if cfg.env.clip_rewards else (lambda r: r)
 
-    agent, params = build_agent(
-        runtime, actions_dim, is_continuous, cfg, observation_space,
-        state["agent"] if state is not None else None,
-    )
+    # Eager flax/optax init runs host-side (each eager dispatch pays the
+    # device-link round trip); the finished trees then move to the mesh.
+    with runtime.host_init():
+        agent, params = build_agent(
+            runtime, actions_dim, is_continuous, cfg, observation_space,
+            state["agent"] if state is not None else None,
+        )
 
-    optim_cfg = dict(cfg.algo.optimizer)
-    optim_target = optim_cfg.pop("_target_")
-    base_lr = float(optim_cfg.pop("lr"))
+        optim_cfg = dict(cfg.algo.optimizer)
+        optim_target = optim_cfg.pop("_target_")
+        base_lr = float(optim_cfg.pop("lr"))
 
-    def make_tx(lr):
-        from sheeprl_tpu.config.instantiate import locate
+        def make_tx(lr):
+            from sheeprl_tpu.config.instantiate import locate
 
-        inner = locate(optim_target)(lr=lr, **optim_cfg)
-        if cfg.algo.max_grad_norm > 0.0:
-            return optax.chain(optax.clip_by_global_norm(cfg.algo.max_grad_norm), inner)
-        return inner
+            inner = locate(optim_target)(lr=lr, **optim_cfg)
+            if cfg.algo.max_grad_norm > 0.0:
+                return optax.chain(optax.clip_by_global_norm(cfg.algo.max_grad_norm), inner)
+            return inner
 
-    tx = optax.inject_hyperparams(make_tx)(lr=base_lr)
-    opt_state = tx.init(params)
-    if state is not None:
-        opt_state = restore_opt_state(opt_state, state["optimizer"])
+        tx = optax.inject_hyperparams(make_tx)(lr=base_lr)
+        opt_state = tx.init(params)
+        if state is not None:
+            opt_state = restore_opt_state(opt_state, state["optimizer"])
+    params = runtime.shard_params(params)
+    opt_state = runtime.shard_params(opt_state)
 
     if runtime.is_global_zero:
         save_configs(cfg, log_dir)
@@ -264,14 +270,22 @@ def main(runtime, cfg: Dict[str, Any]):
     )
     train_fn = make_train_step(agent, tx, cfg, mesh)
 
+    # Latency-aware player placement (core/player.py); on-policy => fresh.
+    placement = PlayerPlacement.resolve(
+        cfg, mesh.devices.flat[0], params=params, force_fresh=True
+    )
+    placement.push(params)
+
     rollout_key, train_key = jax.random.split(jax.random.fold_in(runtime.root_key, rank))
+    rollout_key = placement.put(rollout_key)
 
     # ----------------------------------------------------------------- loop
     step_data = {}
     next_obs = envs.reset(seed=cfg.seed)[0]
     for k in obs_keys:
         step_data[k] = next_obs[k][np.newaxis]
-    carry = agent.initial_states(cfg.env.num_envs)
+    with placement.ctx():
+        carry = agent.initial_states(cfg.env.num_envs)
     prev_actions = np.zeros((cfg.env.num_envs, int(np.sum(actions_dim))), np.float32)
 
     for iter_num in range(start_iter, total_iters + 1):
@@ -279,12 +293,13 @@ def main(runtime, cfg: Dict[str, Any]):
             policy_step += cfg.env.num_envs * world_size
 
             with timer("Time/env_interaction_time"):
-                jnp_obs = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
-                rollout_key, sub = jax.random.split(rollout_key)
-                prev_carry = carry
-                actions_j, real_actions_j, logprobs_j, values_j, carry = player_step_fn(
-                    params, jnp_obs, jnp.asarray(prev_actions), carry, sub
-                )
+                with placement.ctx():
+                    jnp_obs = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
+                    rollout_key, sub = jax.random.split(rollout_key)
+                    prev_carry = carry
+                    actions_j, real_actions_j, logprobs_j, values_j, carry = player_step_fn(
+                        placement.params(), jnp_obs, jnp.asarray(prev_actions), carry, sub
+                    )
                 # Single host fetch for the step outputs AND the pre-step
                 # carry snapshot the buffer stores (the post-step carry stays
                 # on device) — one device->host roundtrip instead of six.
@@ -304,16 +319,17 @@ def main(runtime, cfg: Dict[str, Any]):
                         k: np.stack([np.asarray(final_obs[e][k], np.float32) for e in truncated_envs])
                         for k in obs_keys
                     }
-                    jnp_next = prepare_obs(real_next_obs, cnn_keys=cnn_keys, num_envs=len(truncated_envs))
-                    trunc_carry = tuple(s[truncated_envs] for s in carry)
-                    vals = np.asarray(
-                        get_values_fn(
-                            params,
-                            jnp_next,
-                            jnp.asarray(actions[truncated_envs]),
-                            trunc_carry,
+                    with placement.ctx():
+                        jnp_next = prepare_obs(real_next_obs, cnn_keys=cnn_keys, num_envs=len(truncated_envs))
+                        trunc_carry = tuple(s[truncated_envs] for s in carry)
+                        vals = np.asarray(
+                            get_values_fn(
+                                placement.params(),
+                                jnp_next,
+                                jnp.asarray(actions[truncated_envs]),
+                                trunc_carry,
+                            )
                         )
-                    )
                     rewards[truncated_envs] += cfg.algo.gamma * vals.reshape(rewards[truncated_envs].shape)
                 dones = np.logical_or(terminated, truncated).reshape(cfg.env.num_envs, -1).astype(np.float32)
                 rewards = clip_rewards_fn(rewards).reshape(cfg.env.num_envs, -1).astype(np.float32)
@@ -336,7 +352,8 @@ def main(runtime, cfg: Dict[str, Any]):
             # (reference: ppo_recurrent.py:357-372).
             prev_actions = ((1 - dones) * actions).astype(np.float32)
             if cfg.algo.reset_recurrent_state_on_done:
-                carry = reset_states_fn(carry, jnp.asarray(dones))
+                with placement.ctx():
+                    carry = reset_states_fn(carry, jnp.asarray(dones))
 
             next_obs = {}
             for k in obs_keys:
@@ -356,14 +373,15 @@ def main(runtime, cfg: Dict[str, Any]):
 
         # ------------------------------------------------- GAE + chunking
         local_data = rb.to_tensor()
-        jnp_obs = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
-        next_values = get_values_fn(params, jnp_obs, jnp.asarray(prev_actions), carry)
-        returns, advantages = gae_fn(
-            jnp.asarray(np.asarray(local_data["rewards"]), jnp.float32),
-            jnp.asarray(np.asarray(local_data["values"]), jnp.float32),
-            jnp.asarray(np.asarray(local_data["dones"]), jnp.float32),
-            next_values,
-        )
+        with placement.ctx():
+            jnp_obs = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
+            next_values = get_values_fn(placement.params(), jnp_obs, jnp.asarray(prev_actions), carry)
+            returns, advantages = gae_fn(
+                jnp.asarray(np.asarray(local_data["rewards"]), jnp.float32),
+                jnp.asarray(np.asarray(local_data["values"]), jnp.float32),
+                jnp.asarray(np.asarray(local_data["dones"]), jnp.float32),
+                next_values,
+            )
         local_data["returns"] = np.asarray(returns)
         local_data["advantages"] = np.asarray(advantages)
 
@@ -417,6 +435,7 @@ def main(runtime, cfg: Dict[str, Any]):
             # H2D infeed + train overlap the next env steps.
             if not timer.disabled:
                 jax.block_until_ready(params)
+        placement.push(params)
         train_step_count += world_size
 
         if aggregator and not aggregator.disabled:

@@ -33,6 +33,7 @@ from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.config.instantiate import instantiate, locate
 from sheeprl_tpu.core.mesh import DATA_AXIS
+from sheeprl_tpu.core.player import PlayerPlacement
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.registry import register_algorithm
 from sheeprl_tpu.utils.checkpoint import load_checkpoint, restore_opt_state, save_checkpoint
@@ -168,24 +169,29 @@ def main(runtime, cfg: Dict[str, Any]):
         runtime.print("Encoder MLP keys:", cfg.algo.mlp_keys.encoder)
     mlp_keys = list(cfg.algo.mlp_keys.encoder)
 
-    agent, agent_state = build_agent(
-        runtime, cfg, observation_space, action_space,
-        state_ckpt["agent"] if state_ckpt is not None else None,
-    )
+    # Eager flax/optax init runs host-side (each eager dispatch pays the
+    # device-link round trip); the finished trees then move to the mesh.
+    with runtime.host_init():
+        agent, agent_state = build_agent(
+            runtime, cfg, observation_space, action_space,
+            state_ckpt["agent"] if state_ckpt is not None else None,
+        )
 
-    txs = {
-        "qf": _make_optimizer(cfg.algo.critic.optimizer),
-        "actor": _make_optimizer(cfg.algo.actor.optimizer),
-        "alpha": _make_optimizer(cfg.algo.alpha.optimizer),
-    }
-    opt_states = {
-        "qf": txs["qf"].init(agent_state["qfs"]),
-        "actor": txs["actor"].init(agent_state["actor"]),
-        "alpha": txs["alpha"].init(agent_state["log_alpha"]),
-    }
-    if state_ckpt is not None:
-        for name, ckpt_key in (("qf", "qf_optimizer"), ("actor", "actor_optimizer"), ("alpha", "alpha_optimizer")):
-            opt_states[name] = restore_opt_state(opt_states[name], state_ckpt[ckpt_key])
+        txs = {
+            "qf": _make_optimizer(cfg.algo.critic.optimizer),
+            "actor": _make_optimizer(cfg.algo.actor.optimizer),
+            "alpha": _make_optimizer(cfg.algo.alpha.optimizer),
+        }
+        opt_states = {
+            "qf": txs["qf"].init(agent_state["qfs"]),
+            "actor": txs["actor"].init(agent_state["actor"]),
+            "alpha": txs["alpha"].init(agent_state["log_alpha"]),
+        }
+        if state_ckpt is not None:
+            for name, ckpt_key in (("qf", "qf_optimizer"), ("actor", "actor_optimizer"), ("alpha", "alpha_optimizer")):
+                opt_states[name] = restore_opt_state(opt_states[name], state_ckpt[ckpt_key])
+    agent_state = runtime.shard_params(agent_state)
+    opt_states = runtime.shard_params(opt_states)
 
     if runtime.is_global_zero:
         save_configs(cfg, log_dir)
@@ -240,7 +246,14 @@ def main(runtime, cfg: Dict[str, Any]):
     train_fn = make_train_step(agent, txs, cfg, mesh)
     target_freq_iters = cfg.algo.critic.target_network_frequency // policy_steps_per_iter + 1
 
+    # Latency-aware player placement (core/player.py). Off-policy: honors
+    # fabric.player_sync=async (the player may act on weights one update
+    # stale, never blocking the interaction loop on the mirror transfer).
+    placement = PlayerPlacement.resolve(cfg, mesh.devices.flat[0], params=agent_state["actor"])
+    placement.push(agent_state["actor"])
+
     rollout_key, train_key = jax.random.split(jax.random.fold_in(runtime.root_key, rank))
+    rollout_key = placement.put(rollout_key)
 
     step_data = {}
     obs = envs.reset(seed=cfg.seed)[0]
@@ -253,9 +266,10 @@ def main(runtime, cfg: Dict[str, Any]):
             if iter_num <= learning_starts:
                 actions = envs.action_space.sample()
             else:
-                jnp_obs = prepare_obs(obs, mlp_keys=mlp_keys, num_envs=cfg.env.num_envs)
-                rollout_key, sub = jax.random.split(rollout_key)
-                actions = np.asarray(player_fn(agent_state["actor"], jnp_obs, sub))
+                with placement.ctx():
+                    jnp_obs = prepare_obs(obs, mlp_keys=mlp_keys, num_envs=cfg.env.num_envs)
+                    rollout_key, sub = jax.random.split(rollout_key)
+                    actions = np.asarray(player_fn(placement.params(), jnp_obs, sub))
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 actions.reshape(envs.action_space.shape)
             )
@@ -322,6 +336,7 @@ def main(runtime, cfg: Dict[str, Any]):
                     # H2D infeed + train overlap the next env steps.
                     if not timer.disabled:
                         jax.block_until_ready(agent_state["actor"])
+                    placement.push(agent_state["actor"])
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                 train_step_count += world_size
 

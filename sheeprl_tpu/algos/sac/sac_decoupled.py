@@ -38,6 +38,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from sheeprl_tpu.algos.sac.agent import build_agent
 from sheeprl_tpu.algos.sac.sac import _make_optimizer, make_train_step
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
+from sheeprl_tpu.core.player import ParamMirror
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.core import mesh as mesh_lib
 from sheeprl_tpu.core.mesh import DATA_AXIS, split_player_trainer
@@ -53,7 +54,9 @@ from sheeprl_tpu.utils.utils import Ratio, save_configs
 
 @register_algorithm(decoupled=True)
 def main(runtime, cfg: Dict[str, Any]):
-    player_device, trainer_mesh = split_player_trainer(runtime.mesh)
+    player_device, trainer_mesh = split_player_trainer(
+        runtime.mesh, cfg.fabric.get("player_device", "auto") or "auto"
+    )
     n_trainers = int(trainer_mesh.shape[DATA_AXIS])
     rank = runtime.global_rank
 
@@ -108,31 +111,50 @@ def main(runtime, cfg: Dict[str, Any]):
     mlp_keys = list(cfg.algo.mlp_keys.encoder)
 
     # ------------------------------------------------------- agent + optimizers
-    agent, agent_state = build_agent(
-        runtime, cfg, observation_space, action_space,
-        state_ckpt["agent"] if state_ckpt is not None else None,
-    )
+    # Eager flax/optax init runs host-side (each eager dispatch pays the
+    # device-link round trip); replicate() then moves the trees to the mesh.
+    with runtime.host_init():
+        agent, agent_state = build_agent(
+            runtime, cfg, observation_space, action_space,
+            state_ckpt["agent"] if state_ckpt is not None else None,
+        )
 
-    txs = {
-        "qf": _make_optimizer(cfg.algo.critic.optimizer),
-        "actor": _make_optimizer(cfg.algo.actor.optimizer),
-        "alpha": _make_optimizer(cfg.algo.alpha.optimizer),
-    }
-    opt_states = {
-        "qf": txs["qf"].init(agent_state["qfs"]),
-        "actor": txs["actor"].init(agent_state["actor"]),
-        "alpha": txs["alpha"].init(agent_state["log_alpha"]),
-    }
-    if state_ckpt is not None:
-        for name, ckpt_key in (("qf", "qf_optimizer"), ("actor", "actor_optimizer"), ("alpha", "alpha_optimizer")):
-            opt_states[name] = restore_opt_state(opt_states[name], state_ckpt[ckpt_key])
+        txs = {
+            "qf": _make_optimizer(cfg.algo.critic.optimizer),
+            "actor": _make_optimizer(cfg.algo.actor.optimizer),
+            "alpha": _make_optimizer(cfg.algo.alpha.optimizer),
+        }
+        opt_states = {
+            "qf": txs["qf"].init(agent_state["qfs"]),
+            "actor": txs["actor"].init(agent_state["actor"]),
+            "alpha": txs["alpha"].init(agent_state["log_alpha"]),
+        }
+        if state_ckpt is not None:
+            for name, ckpt_key in (("qf", "qf_optimizer"), ("actor", "actor_optimizer"), ("alpha", "alpha_optimizer")):
+                opt_states[name] = restore_opt_state(opt_states[name], state_ckpt[ckpt_key])
 
-    # Trainer state lives replicated on the trainer mesh; the player keeps its
-    # own committed copy of the actor params on the player device (the
-    # "first weights" broadcast of the reference, sac_decoupled.py:227-230).
+        # Trainer state lives replicated on the trainer mesh; the player keeps its
+        # own committed copy of the actor params on the player device (the
+        # "first weights" broadcast of the reference, sac_decoupled.py:227-230).
     agent_state = mesh_lib.replicate(agent_state, trainer_mesh)
     opt_states = mesh_lib.replicate(opt_states, trainer_mesh)
-    actor_player = jax.device_put(agent_state["actor"], player_device)
+    # The trainer->player weight broadcast as a packed single-transfer mirror
+    # (core/player.py): honors fabric.player_sync — "fresh" makes the next
+    # inference wait for the post-update actor, "async" serves the newest
+    # snapshot whose transfer finished (the reference's non-blocking
+    # broadcast, sac_decoupled.py:260-263).
+    actor_mirror = ParamMirror(
+        # Same-silicon passthrough ONLY when the trainer partition is that
+        # single device: with more trainer devices the params are replicated
+        # over a multi-device mesh and the player needs its own committed
+        # copy (a shared multi-device array clashes with the player's
+        # single-device inputs inside jit).
+        None
+        if trainer_mesh.devices.size == 1 and player_device == trainer_mesh.devices.flat[0]
+        else player_device,
+        sync=str(cfg.fabric.get("player_sync", "fresh") or "fresh"),
+    )
+    actor_mirror.push(agent_state["actor"])
 
     if runtime.is_global_zero:
         save_configs(cfg, log_dir)
@@ -194,6 +216,7 @@ def main(runtime, cfg: Dict[str, Any]):
     target_freq_iters = cfg.algo.critic.target_network_frequency // policy_steps_per_iter + 1
 
     rollout_key, train_key = jax.random.split(jax.random.fold_in(runtime.root_key, rank))
+    rollout_key = jax.device_put(rollout_key, player_device)
 
     step_data = {}
     obs = envs.reset(seed=cfg.seed)[0]
@@ -206,11 +229,10 @@ def main(runtime, cfg: Dict[str, Any]):
             if iter_num <= learning_starts:
                 actions = envs.action_space.sample()
             else:
-                jnp_obs = jax.device_put(
-                    prepare_obs(obs, mlp_keys=mlp_keys, num_envs=cfg.env.num_envs), player_device
-                )
-                rollout_key, sub = jax.random.split(rollout_key)
-                actions = np.asarray(player_fn(actor_player, jnp_obs, sub))
+                with jax.default_device(player_device):
+                    jnp_obs = prepare_obs(obs, mlp_keys=mlp_keys, num_envs=cfg.env.num_envs)
+                    rollout_key, sub = jax.random.split(rollout_key)
+                actions = np.asarray(player_fn(actor_mirror.get(), jnp_obs, sub))
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 actions.reshape(envs.action_space.shape)
             )
@@ -279,10 +301,9 @@ def main(runtime, cfg: Dict[str, Any]):
                         sub,
                         jnp.asarray(agent.tau if do_ema else 0.0, jnp.float32),
                     )
-                    # The broadcast back: enqueue the weight copy and return to
-                    # env stepping without blocking — the player's next
-                    # inference syncs on this copy alone.
-                    actor_player = jax.device_put(agent_state["actor"], player_device)
+                    # The broadcast back: enqueue the packed weight copy and
+                    # return to env stepping.
+                    actor_mirror.push(agent_state["actor"])
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                     if aggregator and not aggregator.disabled:
                         # np.asarray blocks on the train step, making
@@ -363,7 +384,8 @@ def main(runtime, cfg: Dict[str, Any]):
 
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:
-        test(agent, {"actor": actor_player}, runtime, cfg, log_dir, logger)
+        # flush: serve the final trained weights, not a stale async snapshot
+        test(agent, {"actor": actor_mirror.flush()}, runtime, cfg, log_dir, logger)
 
     if logger is not None:
         logger.close()

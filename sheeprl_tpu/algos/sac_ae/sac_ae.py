@@ -36,6 +36,7 @@ from sheeprl_tpu.algos.sac_ae.agent import SACAEAgent, build_agent
 from sheeprl_tpu.algos.sac_ae.utils import normalize_pixels, prepare_obs, preprocess_obs, test
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.core.mesh import DATA_AXIS
+from sheeprl_tpu.core.player import PlayerPlacement
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.registry import register_algorithm
 from sheeprl_tpu.utils.checkpoint import load_checkpoint, restore_opt_state, save_checkpoint
@@ -239,34 +240,39 @@ def main(runtime, cfg: Dict[str, Any]):
     mlp_keys = list(cfg.algo.mlp_keys.encoder)
     obs_keys = cnn_keys + mlp_keys
 
-    agent, agent_state = build_agent(
-        runtime, cfg, observation_space, action_space,
-        state_ckpt["agent"] if state_ckpt is not None else None,
-    )
+    # Eager flax/optax init runs host-side (each eager dispatch pays the
+    # device-link round trip); the finished trees then move to the mesh.
+    with runtime.host_init():
+        agent, agent_state = build_agent(
+            runtime, cfg, observation_space, action_space,
+            state_ckpt["agent"] if state_ckpt is not None else None,
+        )
 
-    txs = {
-        "qf": _make_optimizer(cfg.algo.critic.optimizer),
-        "actor": _make_optimizer(cfg.algo.actor.optimizer),
-        "alpha": _make_optimizer(cfg.algo.alpha.optimizer),
-        "encoder": _make_optimizer(cfg.algo.encoder.optimizer),
-        "decoder": _make_optimizer(cfg.algo.decoder.optimizer),
-    }
-    opt_states = {
-        "qf": txs["qf"].init({"encoder": agent_state["encoder"], "qfs": agent_state["qfs"]}),
-        "actor": txs["actor"].init(agent_state["actor"]),
-        "alpha": txs["alpha"].init(agent_state["log_alpha"]),
-        "encoder": txs["encoder"].init(agent_state["encoder"]),
-        "decoder": txs["decoder"].init(agent_state["decoder"]),
-    }
-    if state_ckpt is not None:
-        for name, ckpt_key in (
-            ("qf", "qf_optimizer"),
-            ("actor", "actor_optimizer"),
-            ("alpha", "alpha_optimizer"),
-            ("encoder", "encoder_optimizer"),
-            ("decoder", "decoder_optimizer"),
-        ):
-            opt_states[name] = restore_opt_state(opt_states[name], state_ckpt[ckpt_key])
+        txs = {
+            "qf": _make_optimizer(cfg.algo.critic.optimizer),
+            "actor": _make_optimizer(cfg.algo.actor.optimizer),
+            "alpha": _make_optimizer(cfg.algo.alpha.optimizer),
+            "encoder": _make_optimizer(cfg.algo.encoder.optimizer),
+            "decoder": _make_optimizer(cfg.algo.decoder.optimizer),
+        }
+        opt_states = {
+            "qf": txs["qf"].init({"encoder": agent_state["encoder"], "qfs": agent_state["qfs"]}),
+            "actor": txs["actor"].init(agent_state["actor"]),
+            "alpha": txs["alpha"].init(agent_state["log_alpha"]),
+            "encoder": txs["encoder"].init(agent_state["encoder"]),
+            "decoder": txs["decoder"].init(agent_state["decoder"]),
+        }
+        if state_ckpt is not None:
+            for name, ckpt_key in (
+                ("qf", "qf_optimizer"),
+                ("actor", "actor_optimizer"),
+                ("alpha", "alpha_optimizer"),
+                ("encoder", "encoder_optimizer"),
+                ("decoder", "decoder_optimizer"),
+            ):
+                opt_states[name] = restore_opt_state(opt_states[name], state_ckpt[ckpt_key])
+    agent_state = runtime.shard_params(agent_state)
+    opt_states = runtime.shard_params(opt_states)
 
     if runtime.is_global_zero:
         save_configs(cfg, log_dir)
@@ -323,7 +329,19 @@ def main(runtime, cfg: Dict[str, Any]):
     )
     train_fn = make_train_step(agent, txs, cfg, mesh)
 
+    # Latency-aware player placement (core/player.py); off-policy: honors
+    # fabric.player_sync=async. get_actions reads only encoder+actor, so
+    # only that sub-tree is mirrored (critics/decoder never cross the link).
+    def _player_view(state):
+        return {"encoder": state["encoder"], "actor": state["actor"]}
+
+    placement = PlayerPlacement.resolve(
+        cfg, mesh.devices.flat[0], params=_player_view(agent_state)
+    )
+    placement.push(_player_view(agent_state))
+
     rollout_key, train_key = jax.random.split(jax.random.fold_in(runtime.root_key, rank))
+    rollout_key = placement.put(rollout_key)
 
     step_data = {}
     obs = envs.reset(seed=cfg.seed)[0]
@@ -336,9 +354,10 @@ def main(runtime, cfg: Dict[str, Any]):
             if iter_num <= learning_starts:
                 actions = envs.action_space.sample()
             else:
-                jnp_obs = prepare_obs(obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=cfg.env.num_envs)
-                rollout_key, sub = jax.random.split(rollout_key)
-                actions = np.asarray(player_fn(agent_state, jnp_obs, sub))
+                with placement.ctx():
+                    jnp_obs = prepare_obs(obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=cfg.env.num_envs)
+                    rollout_key, sub = jax.random.split(rollout_key)
+                    actions = np.asarray(player_fn(placement.params(), jnp_obs, sub))
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 actions.reshape(envs.action_space.shape)
             )
@@ -418,6 +437,7 @@ def main(runtime, cfg: Dict[str, Any]):
                     # H2D infeed + train overlap the next env steps.
                     if not timer.disabled:
                         jax.block_until_ready(agent_state["actor"])
+                    placement.push(_player_view(agent_state))
                 train_step_count += world_size
 
                 # Only feed losses whose update actually ran this step — the

@@ -87,11 +87,27 @@ def check_configs(cfg: dotdict) -> None:
     accelerator = str(cfg.fabric.get("accelerator", "auto")).lower()
     if accelerator not in ("auto", "cpu", "tpu", "axon"):
         raise ValueError(f"Unknown fabric.accelerator '{accelerator}'. Valid: auto | cpu | tpu | axon")
+    player_device = str(cfg.fabric.get("player_device", "auto") or "auto").lower()
+    if player_device not in ("auto", "host", "mesh"):
+        raise ValueError(f"Unknown fabric.player_device '{player_device}'. Valid: auto | host | mesh")
+    player_sync = str(cfg.fabric.get("player_sync", "fresh") or "fresh").lower()
+    if player_sync not in ("fresh", "async"):
+        raise ValueError(f"Unknown fabric.player_sync '{player_sync}'. Valid: fresh | async")
     entry = algorithm_registry[cfg.algo.name]
-    if entry.decoupled and int(os.environ.get("SHEEPRL_NUM_PROCS", "1")) < 2 and cfg.fabric.get("devices", 1) in (1, "1"):
+    if (
+        entry.decoupled
+        and player_device == "mesh"
+        and int(os.environ.get("SHEEPRL_NUM_PROCS", "1")) < 2
+        and cfg.fabric.get("devices", 1) in (1, "1")
+    ):
+        # player_device=host always works on one device (the full mesh
+        # trains); =auto is resolved at runtime and may pick host, so only
+        # the explicit on-mesh split is rejected here — auto that resolves
+        # to mesh fails later in split_player_trainer with the same message.
         raise RuntimeError(
             f"The decoupled algorithm '{cfg.algo.name}' requires at least 2 devices/processes "
-            "(one player + at least one trainer)."
+            "(one player + at least one trainer), or fabric.player_device=host to run the "
+            "player on the host CPU and train on every device."
         )
 
 

@@ -50,24 +50,40 @@ def build_mesh(
     return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
 
 
-def split_player_trainer(mesh: Mesh) -> tuple:
+def split_player_trainer(mesh: Mesh, player_mode: str = "mesh") -> tuple:
     """Partition a mesh's devices into (player device, trainer mesh).
 
     The substrate for decoupled player/trainer algorithms — the analog of the
     reference's rank-0 / optimization process-group split
-    (sac_decoupled.py:563-584): device 0 plays, the rest train. Requires at
-    least 2 devices.
+    (sac_decoupled.py:563-584).
+
+    ``player_mode`` is ``fabric.player_device`` (auto | host | mesh):
+
+    - on-mesh (the classic split): device 0 plays, the rest train —
+      requires at least 2 devices;
+    - host (explicit, or auto over a high-latency link, core/player.py): the
+      player runs on the host CPU backend and the trainer mesh keeps EVERY
+      accelerator — decoupled training then works on a single chip, with no
+      device sacrificed to latency-bound inference.
     """
     if int(mesh.shape[MODEL_AXIS]) > 1:
         raise RuntimeError(
             "Decoupled training does not compose with fabric.model_axis > 1 yet: "
             "the trainer partition is pure data-parallel. Set fabric.model_axis=1."
         )
+    from sheeprl_tpu.core.player import resolve_player_device
+
+    mesh_dev = mesh.devices.flat[0]
+    player_mode = str(player_mode).lower()
+    player = resolve_player_device(player_mode, mesh_dev)
+    if player.platform == "cpu" and (player_mode == "host" or mesh_dev.platform != "cpu"):
+        return player, mesh
     devices = list(mesh.devices.flat)
     if len(devices) < 2:
         raise RuntimeError(
-            "Decoupled training needs at least 2 devices (one player + at least "
-            "one trainer); run with fabric.devices>=2."
+            "The decoupled on-mesh split needs at least 2 devices (one player + at least "
+            "one trainer); run with fabric.devices>=2, or put the player on the "
+            "host with fabric.player_device=host to train on every device."
         )
     trainer_mesh = build_mesh(devices=devices[1:], model_axis_size=1)
     return devices[0], trainer_mesh

@@ -1,0 +1,319 @@
+"""Latency-aware placement of the per-env-step player.
+
+Training on the mesh is throughput-bound: big batched matmuls that want the
+MXU. The per-env-step policy forward is the opposite regime — a tiny
+computation whose wall-clock cost is dominated by dispatch + fetch latency
+between the host (where the env lives) and the accelerator. On a directly
+attached chip that latency is ~100 us and the mesh device wins. Behind a
+remote/tunneled chip it can exceed 100 ms per call, turning a microsecond
+matmul into a 10 Hz interaction loop while the chip idles.
+
+This module makes the placement explicit and configurable
+(``fabric.player_device``):
+
+- ``mesh``  — player runs on the first mesh device (classic coupled layout;
+  the analog of the reference's single-device player fabric,
+  sheeprl/utils/fabric.py:8-35).
+- ``host``  — player runs on the host CPU backend; a :class:`ParamMirror`
+  keeps a copy of the training parameters on the host, refreshed after every
+  optimizer step (the analog of the reference's decoupled mode, where the
+  trainer broadcasts a flattened parameter vector back to the player,
+  sheeprl/algos/sac/sac_decoupled.py:260-263 — here it is a device-to-host
+  array copy, no flatten/unflatten dance).
+- ``auto``  — measure the mesh dispatch latency once and pick ``host`` when
+  the round trip is slower than :data:`AUTO_LATENCY_THRESHOLD_S` (and the
+  player parameters are small enough for the copy to be cheap).
+
+Parameter-sync semantics (``fabric.player_sync``):
+
+- ``fresh`` — the mirror copy is enqueued immediately after each update and
+  the player's next step waits for it: the player always acts with the
+  current weights, matching the reference's coupled tied-weights behavior.
+- ``async`` — the copy is enqueued but never waited on; the player keeps
+  acting with the newest snapshot that has *finished* transferring. Under
+  link backpressure intermediate snapshots are skipped (newest wins), so the
+  interaction loop never blocks on the weight link. On-policy algorithms
+  (PPO/A2C) ignore this setting: their update happens between rollouts, and
+  correctness requires the rollout to run on the post-update weights.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+AUTO_LATENCY_THRESHOLD_S = 2e-3
+# Above this the host copy of the player parameters costs more than the
+# dispatch latency it saves (and compiles slowly on CPU): stay on the mesh.
+AUTO_MAX_PARAM_BYTES = 64 * 1024 * 1024
+
+_latency_cache: dict[Any, float] = {}
+
+
+def host_device() -> jax.Device:
+    """The host CPU backend device (always present alongside TPU/GPU)."""
+    return jax.devices("cpu")[0]
+
+
+def dispatch_latency(device: jax.Device, *, samples: int = 5) -> float:
+    """Median round-trip seconds of a tiny jitted call on ``device``.
+
+    Measures dispatch + completion + host fetch — the fixed cost every
+    per-env-step player call pays regardless of model size.
+    """
+    if device in _latency_cache:
+        return _latency_cache[device]
+    f = jax.jit(lambda x: x + 1.0)
+    x = jax.device_put(jnp.zeros((8,), jnp.float32), device)
+    jax.device_get(f(x))  # compile + warm path
+    times = []
+    for _ in range(samples):
+        t0 = time.perf_counter()
+        jax.device_get(f(x))
+        times.append(time.perf_counter() - t0)
+    lat = sorted(times)[len(times) // 2]
+    _latency_cache[device] = lat
+    return lat
+
+
+def param_bytes(tree: Any) -> int:
+    """Total bytes of all array leaves in a pytree."""
+    return sum(
+        leaf.size * leaf.dtype.itemsize
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if hasattr(leaf, "dtype")
+    )
+
+
+def resolve_player_device(mode: str, mesh_device: jax.Device, *, params: Any = None) -> jax.Device:
+    """Pick the device the player runs on. ``mode``: auto | host | mesh."""
+    mode = str(mode).lower()
+    if mode not in ("auto", "host", "mesh"):
+        raise ValueError(f"fabric.player_device must be one of auto|host|mesh, got {mode!r}")
+    host = host_device()
+    if mode == "host":
+        return host
+    if mode == "mesh" or mesh_device.platform == "cpu":
+        # On the CPU platform (tests, multichip dry runs) host and mesh are
+        # the same silicon — nothing to win.
+        return mesh_device
+    if params is not None and param_bytes(params) > AUTO_MAX_PARAM_BYTES:
+        return mesh_device
+    # Probe a device THIS process can address: on a multi-host mesh the
+    # global first device may belong to another process, and device_put onto
+    # a non-addressable device raises.
+    probe = next(
+        (d for d in jax.local_devices() if d.platform == mesh_device.platform), None
+    )
+    if probe is None:
+        return mesh_device
+    return host if dispatch_latency(probe) > AUTO_LATENCY_THRESHOLD_S else mesh_device
+
+
+def _all_ready(tree: Any) -> bool:
+    for leaf in jax.tree_util.tree_leaves(tree):
+        ready = getattr(leaf, "is_ready", None)
+        if ready is not None and not ready():
+            return False
+    return True
+
+
+class ParamMirror:
+    """Keeps the player's copy of the training parameters on one device.
+
+    ``push(params)`` is called after every optimizer step with the freshly
+    updated (mesh-resident) parameters; ``get()`` is what the player reads.
+    When the player device *is* the training device, both are pass-throughs.
+
+    The copy travels PACKED: a jitted packer concatenates every leaf into one
+    contiguous vector per dtype on the training device, so the device-to-host
+    hop is one transfer instead of one per leaf — over a high-latency link a
+    per-leaf ``device_put`` pays the full round trip ~#leaves times. (This is
+    the role of the reference's ``parameters_to_vector`` broadcast,
+    sac_decoupled.py:260-263.) Unpacking happens lazily on the player device
+    at ``get()`` time: in ``async`` mode a pending packed snapshot is only
+    unpacked once its transfer finished, so neither push nor get blocks.
+
+    The push enqueues the pack + copy immediately — never stashing the source
+    arrays — because train steps donate their inputs: holding a reference for
+    a deferred copy would read a deleted buffer.
+    """
+
+    def __init__(self, device: Optional[jax.Device], *, sync: str = "fresh") -> None:
+        sync = str(sync).lower()
+        if sync not in ("fresh", "async"):
+            raise ValueError(f"fabric.player_sync must be fresh|async, got {sync!r}")
+        self.device = device
+        self.sync = sync
+        self._current: Any = None
+        self._pending_packed: Any = None
+        # Newest packed snapshot waiting behind an in-flight transfer
+        # (async backpressure): at most one transfer in flight plus one
+        # waiting snapshot, and the waiting slot always holds the NEWEST.
+        self._next_packed: Any = None
+        self._treedef = None
+        self._shapes: Any = None
+        self._dtypes: Any = None
+        self._pack_fn = None
+        self._unpack_fn = None
+        self.pushes = 0
+        self.skipped = 0
+
+    # ------------------------------------------------------------- packing
+    def _build_codec(self, params: Any) -> None:
+        leaves, self._treedef = jax.tree_util.tree_flatten(params)
+        self._shapes = [l.shape for l in leaves]
+        self._dtypes = [jnp.dtype(l.dtype) for l in leaves]
+        dtype_order = sorted({d.name for d in self._dtypes})
+
+        def pack(tree):
+            ls = jax.tree_util.tree_leaves(tree)
+            out = {}
+            for dname in dtype_order:
+                out[dname] = jnp.concatenate(
+                    [l.ravel() for l, d in zip(ls, self._dtypes) if d.name == dname]
+                )
+            return out
+
+        def unpack(packed):
+            offsets = {dname: 0 for dname in dtype_order}
+            ls = []
+            for shape, d in zip(self._shapes, self._dtypes):
+                n = 1
+                for dim in shape:
+                    n *= int(dim)
+                start = offsets[d.name]
+                ls.append(packed[d.name][start : start + n].reshape(shape))
+                offsets[d.name] = start + n
+            return jax.tree_util.tree_unflatten(self._treedef, ls)
+
+        self._pack_fn = jax.jit(pack)
+        self._unpack_fn = jax.jit(unpack)
+
+    def _unpack_on_device(self, packed: Any) -> Any:
+        with jax.default_device(self.device):
+            return self._unpack_fn(packed)
+
+    # -------------------------------------------------------------- public
+    def _promote(self) -> None:
+        """Advance the pipeline: finished transfer -> current; waiting
+        snapshot -> in-flight."""
+        if self._pending_packed is not None and (
+            self._current is None or _all_ready(self._pending_packed)
+        ):
+            self._current = self._unpack_on_device(self._pending_packed)
+            self._pending_packed = None
+        if self._pending_packed is None and self._next_packed is not None:
+            self._pending_packed = jax.device_put(self._next_packed, self.device)
+            self._next_packed = None
+
+    def push(self, params: Any) -> None:
+        self.pushes += 1
+        if self.device is None:  # player on the training device: share arrays
+            self._current = params
+            return
+        if self._pack_fn is None:
+            self._build_codec(params)
+        packed = self._pack_fn(params)
+        if self.sync == "fresh" or self._pending_packed is None:
+            self._pending_packed = jax.device_put(packed, self.device)
+            self._next_packed = None
+            return
+        if not _all_ready(self._pending_packed):
+            # Backpressure: keep the in-flight transfer, park THIS (newest)
+            # snapshot in the waiting slot — older waiting snapshots are the
+            # ones dropped, so the newest always lands eventually.
+            if self._next_packed is not None:
+                self.skipped += 1
+            self._next_packed = packed
+            return
+        self._promote()
+        self._pending_packed = jax.device_put(packed, self.device)
+
+    def get(self) -> Any:
+        if self.device is not None:
+            if self.sync == "fresh":
+                if self._pending_packed is not None:
+                    self._current = self._unpack_on_device(self._pending_packed)
+                    self._pending_packed = None
+            else:
+                self._promote()
+        return self._current
+
+    def flush(self) -> Any:
+        """Block until the newest pushed snapshot is the served one.
+
+        Call before final evaluation/checkpointing in async mode so results
+        are reported for the trained weights, not a stale mirror.
+        """
+        if self.device is not None:
+            while self._pending_packed is not None or self._next_packed is not None:
+                if self._pending_packed is not None:
+                    jax.block_until_ready(self._pending_packed)
+                self._promote()
+        return self._current
+
+
+class PlayerPlacement:
+    """Bundle of (player device, parameter mirror, default-device context).
+
+    Usage in an algorithm loop::
+
+        placement = PlayerPlacement.resolve(cfg, mesh_device, params=actor_params)
+        placement.push(actor_params)                  # initial mirror
+        ...
+        with placement.ctx():                         # per env step
+            obs = prepare_obs(...)                    # arrays land player-side
+            key, sub = jax.random.split(key)
+            out = player_step_fn(placement.params(), obs, sub)
+        ...
+        placement.push(new_params)                    # after each train step
+    """
+
+    def __init__(self, device: jax.Device, mesh_device: jax.Device, sync: str) -> None:
+        self.device = device
+        self.on_mesh = device == mesh_device
+        self.mirror = ParamMirror(None if self.on_mesh else device, sync=sync)
+
+    @classmethod
+    def resolve(
+        cls,
+        cfg: Any,
+        mesh_device: jax.Device,
+        *,
+        params: Any = None,
+        force_fresh: bool = False,
+    ) -> "PlayerPlacement":
+        fabric = cfg.get("fabric") if hasattr(cfg, "get") else getattr(cfg, "fabric", None)
+        mode = (fabric.get("player_device") or "auto") if fabric is not None else "auto"
+        sync = (fabric.get("player_sync") or "fresh") if fabric is not None else "fresh"
+        if force_fresh:
+            sync = "fresh"
+        device = resolve_player_device(mode, mesh_device, params=params)
+        return cls(device, mesh_device, sync)
+
+    def ctx(self):
+        """Context manager placing new arrays (obs, PRNG keys) player-side.
+
+        On-mesh this is a no-op: inputs stay uncommitted so jit resolves
+        their placement from the (possibly multi-device) parameter sharding.
+        """
+        if self.on_mesh:
+            return contextlib.nullcontext()
+        return jax.default_device(self.device)
+
+    def put(self, tree: Any) -> Any:
+        """Commit a pytree (e.g. the rollout PRNG key) to the player device."""
+        if self.on_mesh:
+            return tree
+        return jax.device_put(tree, self.device)
+
+    def push(self, params: Any) -> None:
+        self.mirror.push(params)
+
+    def params(self) -> Any:
+        return self.mirror.get()

@@ -40,6 +40,8 @@ class Runtime:
         accelerator: str = "auto",
         precision: str = "32-true",
         model_axis: int = 1,
+        player_device: str = "auto",
+        player_sync: str = "fresh",
     ) -> None:
         self.requested_devices = devices
         self.num_nodes = num_nodes
@@ -47,6 +49,10 @@ class Runtime:
         self.accelerator = accelerator
         self.precision: Precision = resolve_precision(precision)
         self.model_axis = int(model_axis)
+        # Consumed by PlayerPlacement.resolve via cfg.fabric (core/player.py);
+        # mirrored here so `instantiate(cfg.fabric)` accepts the keys.
+        self.player_device = str(player_device)
+        self.player_sync = str(player_sync)
         self._mesh: Optional[mesh_lib.Mesh] = None
         self._launched = False
         self.seed: Optional[int] = None
@@ -72,6 +78,23 @@ class Runtime:
                 msg = str(e).lower()
                 if "already" not in msg and "once" not in msg:
                     raise
+        # Persistent XLA compilation cache: a fresh process re-lowers every
+        # jit closure, and on a remote backend each compile (or even each
+        # cache-hit load) pays the link; persisting compiled executables makes
+        # restarts and repeated short runs cheap. Opt out by pointing
+        # JAX_COMPILATION_CACHE_DIR at "" or your own location.
+        if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+            import getpass
+            import tempfile
+
+            try:
+                user = getpass.getuser()
+            except Exception:
+                user = str(os.getuid()) if hasattr(os, "getuid") else "default"
+            cache_dir = os.path.join(tempfile.gettempdir(), f"sheeprl_tpu_jax_cache_{user}")
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            if "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS" not in os.environ:
+                jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         self._mesh = mesh_lib.build_mesh(
             devices=self._select_devices(),
             data_axis_size=None,
@@ -149,6 +172,19 @@ class Runtime:
 
     def replicate(self, tree: Any) -> Any:
         return mesh_lib.replicate(tree, self.mesh)
+
+    def host_init(self):
+        """Context manager: run eager parameter/optimizer initialization on
+        the host CPU backend.
+
+        Flax ``.init`` and optax ``.init`` dispatch eagerly, one primitive at
+        a time; on a remote accelerator every one of those dispatches pays the
+        link round trip (minutes for a Dreamer-sized agent behind a tunneled
+        chip, microseconds on the host). Initialize host-side, then move the
+        finished pytrees to the mesh in one pass with :meth:`shard_params`
+        (host-to-device transfers are bulk and cheap).
+        """
+        return jax.default_device(jax.devices("cpu")[0])
 
     def shard_params(self, tree: Any, min_dim: int = 1024) -> Any:
         """Place params/opt-state on the mesh: wide leaves tensor-parallel over

@@ -265,6 +265,23 @@ class TestPPO:
         args.append("env.wrapper.id=multidiscrete_dummy")
         run(args)
 
+    @pytest.mark.parametrize("player_device", ["host", "mesh"])
+    def test_dry_run_player_placement(self, tmp_path, player_device):
+        run(
+            ppo_overrides(
+                tmp_path,
+                **{
+                    "fabric.accelerator": "cpu",
+                    "fabric.player_device": player_device,
+                    "fabric.player_sync": "async",  # on-policy forces fresh
+                },
+            )
+        )
+
+    def test_invalid_player_device_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="player_device"):
+            run(ppo_overrides(tmp_path, **{"fabric.player_device": "gpu"}))
+
     def test_checkpoint_and_eval_roundtrip(self, tmp_path):
         args = ppo_overrides(tmp_path, **{"fabric.accelerator": "cpu"})
         args = [a for a in args if not a.startswith("checkpoint.every")]
@@ -424,6 +441,18 @@ class TestSACDecoupled:
         # a decoupled run on a single device must error out.
         with pytest.raises(RuntimeError, match="decoupled"):
             run(sac_decoupled_overrides(**{"fabric.devices": 1}))
+
+    @pytest.mark.parametrize("devices", [1, 2])
+    def test_host_player_keeps_full_trainer_mesh(self, tmp_path, devices):
+        # A host-side player frees every mesh device for the trainer
+        # partition: decoupled training works on a single device, and with
+        # more devices the weight mirror must hand the player a committed
+        # copy (not the trainer-mesh-replicated arrays).
+        run(
+            sac_decoupled_overrides(
+                **{"fabric.devices": devices, "fabric.player_device": "host"}
+            )
+        )
 
     def test_checkpoint_eval_resume_roundtrip(self, tmp_path):
         checkpoint_eval_resume_roundtrip(

@@ -1,0 +1,163 @@
+"""Unit tests for latency-aware player placement (core/player.py).
+
+On the CPU test platform host and mesh share silicon, so placement resolves
+to pass-through; the mirror paths are exercised directly against a second
+virtual CPU device (cpu:1) from the 8-device test platform.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.core.player import (
+    ParamMirror,
+    PlayerPlacement,
+    host_device,
+    param_bytes,
+    resolve_player_device,
+)
+from sheeprl_tpu.utils.utils import dotdict
+
+
+def _second_cpu_device():
+    devices = jax.devices("cpu")
+    assert len(devices) >= 2, "test platform must expose >= 2 virtual CPU devices"
+    return devices[1]
+
+
+class TestResolve:
+    def test_invalid_mode_raises(self):
+        with pytest.raises(ValueError, match="player_device"):
+            resolve_player_device("gpu", jax.devices()[0])
+
+    def test_host_mode_returns_cpu(self):
+        dev = resolve_player_device("host", jax.devices()[0])
+        assert dev == host_device()
+
+    def test_mesh_mode_returns_mesh_device(self):
+        mesh_dev = _second_cpu_device()
+        assert resolve_player_device("mesh", mesh_dev) == mesh_dev
+
+    def test_auto_on_cpu_platform_short_circuits_to_mesh(self):
+        mesh_dev = _second_cpu_device()
+        assert resolve_player_device("auto", mesh_dev) == mesh_dev
+
+    def test_param_bytes(self):
+        tree = {"a": jnp.zeros((4, 4), jnp.float32), "b": jnp.zeros((8,), jnp.bfloat16)}
+        assert param_bytes(tree) == 4 * 4 * 4 + 8 * 2
+
+
+class TestParamMirror:
+    def test_passthrough_shares_objects(self):
+        mirror = ParamMirror(None)
+        params = {"w": jnp.ones((2, 2))}
+        mirror.push(params)
+        assert mirror.get() is params
+
+    def test_invalid_sync_raises(self):
+        with pytest.raises(ValueError, match="player_sync"):
+            ParamMirror(host_device(), sync="eventually")
+
+    def test_fresh_copies_to_device(self):
+        dev = _second_cpu_device()
+        mirror = ParamMirror(dev, sync="fresh")
+        mirror.push({"w": jnp.ones((2, 2))})
+        out = mirror.get()
+        assert next(iter(out["w"].devices())) == dev
+        np.testing.assert_array_equal(np.asarray(out["w"]), np.ones((2, 2)))
+
+    def test_fresh_tracks_latest_push(self):
+        dev = _second_cpu_device()
+        mirror = ParamMirror(dev, sync="fresh")
+        for i in range(3):
+            mirror.push({"w": jnp.full((2,), float(i))})
+        np.testing.assert_array_equal(np.asarray(mirror.get()["w"]), np.full((2,), 2.0))
+
+    def test_async_serves_a_complete_snapshot(self):
+        dev = _second_cpu_device()
+        mirror = ParamMirror(dev, sync="async")
+        mirror.push({"w": jnp.zeros((2,))})
+        first = mirror.get()
+        assert first is not None
+        mirror.push({"w": jnp.ones((2,))})
+        jax.block_until_ready(mirror._pending_packed)
+        np.testing.assert_array_equal(np.asarray(mirror.get()["w"]), np.ones((2,)))
+        assert mirror.pushes == 2
+
+    def test_async_never_blocks_on_none(self):
+        mirror = ParamMirror(_second_cpu_device(), sync="async")
+        assert mirror.get() is None
+
+
+class TestPlayerPlacement:
+    def _cfg(self, device="auto", sync="fresh"):
+        return dotdict({"fabric": dotdict({"player_device": device, "player_sync": sync})})
+
+    def test_on_mesh_is_passthrough(self):
+        mesh_dev = jax.devices("cpu")[0]
+        placement = PlayerPlacement.resolve(self._cfg("mesh"), mesh_dev)
+        params = {"w": jnp.ones((2,))}
+        placement.push(params)
+        assert placement.params() is params
+        tree = {"k": jnp.zeros((2,))}
+        assert placement.put(tree) is tree
+        # ctx is a no-op: new arrays stay uncommitted
+        with placement.ctx():
+            x = jnp.zeros((2,))
+        assert not x.committed
+
+    def test_off_mesh_ctx_commits_player_side(self):
+        mesh_dev = jax.devices("cpu")[0]
+        player_dev = _second_cpu_device()
+        placement = PlayerPlacement(player_dev, mesh_dev, "fresh")
+        assert not placement.on_mesh
+        with placement.ctx():
+            x = jnp.zeros((4,))
+        assert next(iter(x.devices())) == player_dev
+        key = placement.put(jax.random.PRNGKey(0))
+        assert next(iter(key.devices())) == player_dev
+
+    def test_off_mesh_step_runs_on_player_device(self):
+        mesh_dev = jax.devices("cpu")[0]
+        player_dev = _second_cpu_device()
+        placement = PlayerPlacement(player_dev, mesh_dev, "fresh")
+        step = jax.jit(lambda p, o: o @ p["w"])
+        placement.push({"w": jnp.eye(3)})
+        with placement.ctx():
+            obs = jnp.arange(3.0).reshape(1, 3)
+            out = step(placement.params(), obs)
+        assert next(iter(out.devices())) == player_dev
+        np.testing.assert_array_equal(np.asarray(out), [[0.0, 1.0, 2.0]])
+
+    def test_force_fresh_overrides_async(self):
+        mesh_dev = jax.devices("cpu")[0]
+        placement = PlayerPlacement.resolve(
+            self._cfg("mesh", sync="async"), mesh_dev, force_fresh=True
+        )
+        assert placement.mirror.sync == "fresh"
+
+
+class TestAsyncNewestWins:
+    def test_waiting_slot_holds_newest(self):
+        dev = jax.devices("cpu")[1]
+        mirror = ParamMirror(dev, sync="async")
+        for i in range(5):
+            mirror.push({"w": jnp.full((2,), float(i))})
+        # Whatever was skipped, flushing must land the NEWEST push.
+        out = mirror.flush()
+        np.testing.assert_array_equal(np.asarray(out["w"]), np.full((2,), 4.0))
+
+    def test_flush_is_idempotent_and_passthrough_safe(self):
+        passthrough = ParamMirror(None)
+        params = {"w": jnp.ones((2,))}
+        passthrough.push(params)
+        assert passthrough.flush() is params
+        assert passthrough.flush() is params
+
+    def test_fresh_flush_serves_last_push(self):
+        dev = jax.devices("cpu")[1]
+        mirror = ParamMirror(dev, sync="fresh")
+        mirror.push({"w": jnp.zeros((2,))})
+        mirror.push({"w": jnp.ones((2,))})
+        np.testing.assert_array_equal(np.asarray(mirror.flush()["w"]), np.ones((2,)))

@@ -1,0 +1,67 @@
+"""Unit tests for the async host->device infeed (data/infeed.py)."""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.infeed import AsyncInfeed
+
+
+def _put(host_batch):
+    return {k: jnp.asarray(v) for k, v in host_batch.items()}
+
+
+class TestAsyncInfeed:
+    def test_take_without_stage_is_none(self):
+        infeed = AsyncInfeed(_put)
+        assert infeed.take(2) is None
+        assert infeed.misses == 1
+        infeed.close()
+
+    def test_stage_then_take_returns_device_batches(self):
+        infeed = AsyncInfeed(_put)
+        host = [{"x": np.full((2, 2), float(i))} for i in range(3)]
+        infeed.stage(host)
+        out = infeed.take(3)
+        assert out is not None and len(out) == 3
+        for i, b in enumerate(out):
+            np.testing.assert_array_equal(np.asarray(b["x"]), np.full((2, 2), float(i)))
+        assert infeed.hits == 1
+        infeed.close()
+
+    def test_count_mismatch_falls_back(self):
+        infeed = AsyncInfeed(_put)
+        infeed.stage([{"x": np.zeros((1,))}])
+        assert infeed.take(2) is None
+        assert infeed.misses == 1
+        infeed.close()
+
+    def test_take_consumes_the_stage(self):
+        infeed = AsyncInfeed(_put)
+        infeed.stage([{"x": np.zeros((1,))}])
+        assert infeed.take(1) is not None
+        assert infeed.take(1) is None
+        infeed.close()
+
+    def test_restaging_drops_previous(self):
+        infeed = AsyncInfeed(_put)
+        infeed.stage([{"x": np.zeros((1,))}])
+        infeed.stage([{"x": np.ones((1,))}, {"x": np.ones((1,))}])
+        out = infeed.take(2)
+        assert out is not None and len(out) == 2
+        infeed.close()
+
+    def test_worker_copies_by_value_not_by_reference(self):
+        # Mutating the source after stage() must not corrupt staged batches:
+        # the worker may still be copying. stage() must snapshot-safe the
+        # list, and the put_fn's jnp.asarray copies the data.
+        infeed = AsyncInfeed(_put)
+        src = np.zeros((64, 64))
+        infeed.stage([{"x": src}])
+        time.sleep(0.05)  # let the worker finish its device_put
+        src[:] = 1.0
+        out = infeed.take(1)
+        np.testing.assert_array_equal(np.asarray(out[0]["x"]), np.zeros((64, 64)))
+        infeed.close()
