@@ -66,6 +66,7 @@ def make_train_step(agent: PPOAgent, tx: optax.GradientTransformation, cfg: Dict
     @partial(jax.jit, donate_argnums=(0, 1))
     def train_step(params, opt_state, data, key):
         n = data["actions"].shape[0]
+        next_key, key = jax.random.split(key)
         num_mb = max(1, -(-n // mb_size))
         perm = jax.random.permutation(key, n)
         idx = perm[jnp.arange(num_mb * mb_size) % n].reshape(num_mb, mb_size)
@@ -81,7 +82,7 @@ def make_train_step(agent: PPOAgent, tx: optax.GradientTransformation, cfg: Dict
         updates, opt_state = tx.update(grads_sum, opt_state, params)
         params = optax.apply_updates(params, updates)
         m = metrics.mean(0)
-        return params, opt_state, {"policy_loss": m[0], "value_loss": m[1]}
+        return params, opt_state, {"policy_loss": m[0], "value_loss": m[1]}, next_key
 
     return train_step
 
@@ -226,13 +227,13 @@ def main(runtime, cfg: Dict[str, Any]):
 
             with timer("Time/env_interaction_time"):
                 with placement.ctx():
-                    jnp_obs = prepare_obs(next_obs, mlp_keys=obs_keys, num_envs=cfg.env.num_envs)
-                    rollout_key, sub = jax.random.split(rollout_key)
-                    # Single host fetch for the whole step output (one
-                    # device->host roundtrip instead of four).
-                    actions, real_actions_np, logprobs, values = jax.device_get(
-                        player_step_fn(placement.params(), jnp_obs, sub)
+                    # prepare_obs is numpy; PRNG split runs inside the jit —
+                    # one dispatch, one host fetch per step.
+                    np_obs = prepare_obs(next_obs, mlp_keys=obs_keys, num_envs=cfg.env.num_envs)
+                    *step_out, rollout_key = player_step_fn(
+                        placement.params(), np_obs, rollout_key
                     )
+                    actions, real_actions_np, logprobs, values = jax.device_get(step_out)
 
                 obs, rewards, terminated, truncated, info = envs.step(
                     real_actions_np.reshape(envs.action_space.shape)
@@ -300,8 +301,9 @@ def main(runtime, cfg: Dict[str, Any]):
         sharded = runtime.shard_batch(flat)
 
         with timer("Time/train_time"):
-            train_key, sub = jax.random.split(train_key)
-            params, opt_state, train_metrics = train_fn(params, opt_state, sharded, sub)
+            params, opt_state, train_metrics, train_key = train_fn(
+                params, opt_state, sharded, train_key
+            )
             # Block only when the train timer needs an accurate stop;
             # with metrics off the dispatch stays fully async, so the
             # H2D infeed + train overlap the next env steps.

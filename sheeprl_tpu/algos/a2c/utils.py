@@ -16,9 +16,11 @@ MODELS_TO_REGISTER = {"agent"}
 
 def prepare_obs(
     obs: Dict[str, np.ndarray], *, mlp_keys: Sequence[str] = (), num_envs: int = 1, **kwargs: Any
-) -> Dict[str, jax.Array]:
-    """Vector-only obs → float device arrays (reference: utils.py:16-20)."""
-    return {k: jnp.asarray(obs[k]).reshape(num_envs, -1).astype(jnp.float32) for k in mlp_keys}
+) -> Dict[str, np.ndarray]:
+    """Vector-only obs → float32 numpy arrays ready to be jit inputs
+    (reference: utils.py:16-20). Numpy on purpose: eager jnp ops here would
+    each be a device dispatch per env step."""
+    return {k: np.asarray(obs[k]).reshape(num_envs, -1).astype(np.float32) for k in mlp_keys}
 
 
 def test(agent, params, runtime, cfg: Dict[str, Any], log_dir: str, logger=None) -> float:

@@ -5,7 +5,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from sheeprl_tpu.algos.dreamer_v3.utils import prepare_obs, test  # noqa: F401 (re-export)
+from sheeprl_tpu.algos.dreamer_v3.utils import normalize_player_obs, prepare_obs, test  # noqa: F401 (re-export)
 
 AGGREGATOR_KEYS = {
     "Rewards/rew_avg",

@@ -33,7 +33,7 @@ from sheeprl_tpu.algos.dreamer_v2.agent import (
     dv2_actor_forward,
 )
 from sheeprl_tpu.algos.dreamer_v2.loss import reconstruction_loss
-from sheeprl_tpu.algos.dreamer_v2.utils import compute_lambda_values, prepare_obs, test
+from sheeprl_tpu.algos.dreamer_v2.utils import compute_lambda_values, normalize_player_obs, prepare_obs, test
 from sheeprl_tpu.algos.ppo.agent import actions_metadata
 from sheeprl_tpu.config.instantiate import instantiate, locate
 from sheeprl_tpu.core.mesh import DATA_AXIS
@@ -148,6 +148,7 @@ def make_train_step(agent: DV2Agent, txs: Dict[str, optax.GradientTransformation
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def train_step(state, opt_states, data, key):
+        next_key, key = jax.random.split(key)
         T, B = data["rewards"].shape[:2]
         data = jax.lax.with_sharding_constraint(data, {k: batch_sharding for k in data})
         batch_obs = {k: data[k] / 255.0 - 0.5 for k in cnn_keys}
@@ -293,7 +294,7 @@ def make_train_step(agent: DV2Agent, txs: Dict[str, optax.GradientTransformation
             "Grads/actor": optax.global_norm(actor_grads),
             "Grads/critic": optax.global_norm(critic_grads),
         }
-        return state, opt_states, metrics
+        return state, opt_states, metrics, next_key
 
     return train_step
 
@@ -447,9 +448,17 @@ def main(runtime, cfg: Dict[str, Any]):
         )
 
     train_fn = make_train_step(agent, txs, cfg, runtime.mesh)
-    player_step_fn = jax.jit(
-        lambda wm, a, s, o, k: agent.player_step(wm, a, s, o, k, greedy=False)
-    )
+    player_cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+
+    def _player_step(wm, a, s, o, k):
+        # PRNG split + obs normalization in-graph: ONE dispatch per env step.
+        next_k, sub = jax.random.split(k)
+        out = agent.player_step(
+            wm, a, s, normalize_player_obs(o, player_cnn_keys), sub, greedy=False
+        )
+        return (*out, next_k)
+
+    player_step_fn = jax.jit(_player_step)
     init_player_fn = jax.jit(agent.init_player_state, static_argnums=(1,))
     reset_player_fn = jax.jit(agent.reset_player_state)
 
@@ -509,11 +518,10 @@ def main(runtime, cfg: Dict[str, Any]):
                     )
             else:
                 with placement.ctx():
-                    jnp_obs = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
-                    rollout_key, sub = jax.random.split(rollout_key)
+                    np_obs = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
                     pp = placement.params()
-                    actions_cat, real_actions_j, player_state = player_step_fn(
-                        pp["world_model"], pp["actor"], player_state, jnp_obs, sub
+                    actions_cat, real_actions_j, player_state, rollout_key = player_step_fn(
+                        pp["world_model"], pp["actor"], player_state, np_obs, rollout_key
                     )
                 # One host fetch for both arrays: each separate np.asarray
                 # is a full device->host roundtrip (painful over a tunneled
@@ -603,9 +611,8 @@ def main(runtime, cfg: Dict[str, Any]):
                                 jnp.copy, agent_state["critic"]
                             )
                         batch = batches[i]
-                        train_key, sub = jax.random.split(train_key)
-                        agent_state, opt_states, train_metrics = train_fn(
-                            agent_state, opt_states, batch, sub
+                        agent_state, opt_states, train_metrics, train_key = train_fn(
+                            agent_state, opt_states, batch, train_key
                         )
                         per_step_metrics.append(train_metrics)
                         cumulative_per_rank_gradient_steps += 1

@@ -7,7 +7,7 @@ import jax.numpy as jnp
 
 # prepare_obs and the greedy test episode are identical to DreamerV3's (both
 # players expose the same functional player_step API).
-from sheeprl_tpu.algos.dreamer_v3.utils import prepare_obs, test  # noqa: F401 (re-export)
+from sheeprl_tpu.algos.dreamer_v3.utils import normalize_player_obs, prepare_obs, test  # noqa: F401 (re-export)
 
 AGGREGATOR_KEYS = {
     "Rewards/rew_avg",

@@ -37,7 +37,7 @@ from sheeprl_tpu.algos.dreamer_v3.agent import (
     continuous_log_prob_and_entropy,
 )
 from sheeprl_tpu.algos.dreamer_v3.loss import reconstruction_loss
-from sheeprl_tpu.algos.dreamer_v3.utils import prepare_obs, test
+from sheeprl_tpu.algos.dreamer_v3.utils import normalize_player_obs, prepare_obs, test
 from sheeprl_tpu.algos.ppo.agent import actions_metadata
 from sheeprl_tpu.config.instantiate import instantiate, locate
 from sheeprl_tpu.core.mesh import DATA_AXIS
@@ -193,6 +193,7 @@ def make_train_step(agent: DV3Agent, txs: Dict[str, optax.GradientTransformation
 
     @partial(jax.jit, donate_argnums=(0, 1, 2))
     def train_step(state, opt_states, moments_state, data, key, tau):
+        next_key, key = jax.random.split(key)
         T, B = data["rewards"].shape[:2]
         data = jax.lax.with_sharding_constraint(data, {k: batch_sharding for k in data})
         batch_obs = {k: data[k] / 255.0 - 0.5 for k in cnn_keys}
@@ -354,7 +355,7 @@ def make_train_step(agent: DV3Agent, txs: Dict[str, optax.GradientTransformation
             "Grads/actor": optax.global_norm(actor_grads),
             "Grads/critic": optax.global_norm(critic_grads),
         }
-        return state, opt_states, img_aux["moments"], metrics
+        return state, opt_states, img_aux["moments"], metrics, next_key
 
     return train_step
 
@@ -531,9 +532,17 @@ def main(runtime, cfg: Dict[str, Any]):
         enabled=cfg.buffer.get("prefetch", True),
     )
 
-    player_step_fn = jax.jit(
-        lambda wm, a, s, o, k: agent.player_step(wm, a, s, o, k, greedy=False)
-    )
+    player_cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+
+    def _player_step(wm, a, s, o, k):
+        # PRNG split + obs normalization in-graph: ONE dispatch per env step.
+        next_k, sub = jax.random.split(k)
+        out = agent.player_step(
+            wm, a, s, normalize_player_obs(o, player_cnn_keys), sub, greedy=False
+        )
+        return (*out, next_k)
+
+    player_step_fn = jax.jit(_player_step)
     init_player_fn = jax.jit(agent.init_player_state, static_argnums=(1,))
     reset_player_fn = jax.jit(agent.reset_player_state)
 
@@ -578,11 +587,10 @@ def main(runtime, cfg: Dict[str, Any]):
                     )
             else:
                 with placement.ctx():
-                    jnp_obs = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
-                    rollout_key, sub = jax.random.split(rollout_key)
+                    np_obs = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
                     pp = placement.params()
-                    actions_cat, real_actions_j, player_state = player_step_fn(
-                        pp["world_model"], pp["actor"], player_state, jnp_obs, sub
+                    actions_cat, real_actions_j, player_state, rollout_key = player_step_fn(
+                        pp["world_model"], pp["actor"], player_state, np_obs, rollout_key
                     )
                 # One host fetch for both arrays: each separate np.asarray
                 # is a full device->host roundtrip (painful over a tunneled
@@ -685,9 +693,9 @@ def main(runtime, cfg: Dict[str, Any]):
                         else:
                             tau = 0.0
                         batch = batches[i]
-                        train_key, sub = jax.random.split(train_key)
-                        agent_state, opt_states, moments_state, train_metrics = train_fn(
-                            agent_state, opt_states, moments_state, batch, sub, jnp.asarray(tau, jnp.float32)
+                        agent_state, opt_states, moments_state, train_metrics, train_key = train_fn(
+                            agent_state, opt_states, moments_state, batch, train_key,
+                            np.asarray(tau, np.float32),
                         )
                         per_step_metrics.append(train_metrics)
                         cumulative_per_rank_gradient_steps += 1

@@ -33,18 +33,29 @@ MODELS_TO_REGISTER = {"world_model", "actor", "critic", "target_critic", "moment
 
 def prepare_obs(
     obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), num_envs: int = 1, **kwargs: Any
-) -> Dict[str, jax.Array]:
-    """Host obs → float device arrays [num_envs, ...]; pixels → [-0.5, 0.5]
-    (reference: utils.py:80-91, without the CHW reshape — HWC layout)."""
-    out: Dict[str, jax.Array] = {}
+) -> Dict[str, np.ndarray]:
+    """Host obs → numpy arrays [num_envs, ...] ready to be jit inputs
+    (reference: utils.py:80-91, without the CHW reshape — HWC layout).
+
+    Pure numpy on purpose: each eager jnp op here would be a separate device
+    dispatch per env step. Pixels stay uint8 and cross host→device packed;
+    `normalize_player_obs` applies the [-0.5, 0.5] scaling in-graph."""
+    out: Dict[str, np.ndarray] = {}
     for k, v in obs.items():
-        arr = jnp.asarray(v)
+        arr = np.asarray(v)
         if k in cnn_keys:
-            arr = arr.reshape(num_envs, *arr.shape[-3:]).astype(jnp.float32) / 255.0 - 0.5
+            arr = arr.reshape(num_envs, *arr.shape[-3:])
         else:
-            arr = arr.reshape(num_envs, -1).astype(jnp.float32)
+            arr = arr.reshape(num_envs, -1).astype(np.float32)
         out[k] = arr
     return out
+
+
+def normalize_player_obs(obs: Dict[str, jax.Array], cnn_keys: Sequence[str]) -> Dict[str, jax.Array]:
+    """Pixel keys → [-0.5, 0.5] floats; called INSIDE the player jits."""
+    return {
+        k: v.astype(jnp.float32) / 255.0 - 0.5 if k in cnn_keys else v for k, v in obs.items()
+    }
 
 
 def test(agent, state, runtime, cfg: Dict[str, Any], log_dir: str, logger=None, sample_actions: bool = False) -> float:
@@ -54,8 +65,11 @@ def test(agent, state, runtime, cfg: Dict[str, Any], log_dir: str, logger=None, 
     done = False
     cumulative_rew = 0.0
     obs = env.reset(seed=cfg.seed)[0]
+    test_cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
     player_step = jax.jit(
-        lambda wm, a, s, o, k: agent.player_step(wm, a, s, o, k, greedy=not sample_actions)
+        lambda wm, a, s, o, k: agent.player_step(
+            wm, a, s, normalize_player_obs(o, test_cnn_keys), k, greedy=not sample_actions
+        )
     )
     player_state = jax.jit(agent.init_player_state, static_argnums=(1,))(state["world_model"], 1)
     key = jax.random.PRNGKey(cfg.seed if cfg.seed is not None else 0)

@@ -52,6 +52,7 @@ def make_train_step(agent: DROQAgent, txs: Dict[str, optax.GradientTransformatio
     @partial(jax.jit, donate_argnums=(0, 1))
     def train_step(state, opt_states, critic_data, actor_data, key):
         """critic_data: dict of [G, B, ...]; actor_data: dict of [B, ...]."""
+        next_key, key = jax.random.split(key)
 
         def critic_step(carry, batch):
             state, qf_opt = carry
@@ -119,7 +120,7 @@ def make_train_step(agent: DROQAgent, txs: Dict[str, optax.GradientTransformatio
             "value_loss": qf_losses.mean(),
             "policy_loss": actor_l,
             "alpha_loss": alpha_l,
-        }
+        }, next_key
 
     return train_step
 
@@ -259,7 +260,11 @@ def main(runtime, cfg: Dict[str, Any]):
             "the checkpoint will be saved at the nearest greater multiple of the policy_steps_per_iter value."
         )
 
-    player_fn = jax.jit(lambda p, o, k: agent.get_actions(p, o, k, greedy=False))
+    def _player(p, o, k):
+        next_k, sub = jax.random.split(k)
+        return agent.get_actions(p, o, sub, greedy=False), next_k
+
+    player_fn = jax.jit(_player)
     train_fn = make_train_step(agent, txs, cfg, mesh)
 
     # Latency-aware player placement (core/player.py); off-policy: honors
@@ -282,9 +287,9 @@ def main(runtime, cfg: Dict[str, Any]):
                 actions = envs.action_space.sample()
             else:
                 with placement.ctx():
-                    jnp_obs = prepare_obs(obs, mlp_keys=mlp_keys, num_envs=cfg.env.num_envs)
-                    rollout_key, sub = jax.random.split(rollout_key)
-                    actions = np.asarray(player_fn(placement.params(), jnp_obs, sub))
+                    np_obs = prepare_obs(obs, mlp_keys=mlp_keys, num_envs=cfg.env.num_envs)
+                    actions_j, rollout_key = player_fn(placement.params(), np_obs, rollout_key)
+                    actions = np.asarray(actions_j)
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 actions.reshape(envs.action_space.shape)
             )
@@ -345,9 +350,8 @@ def main(runtime, cfg: Dict[str, Any]):
                     for k, v in actor_sample.items()
                 }
                 with timer("Time/train_time"):
-                    train_key, sub = jax.random.split(train_key)
-                    agent_state, opt_states, train_metrics = train_fn(
-                        agent_state, opt_states, critic_data, actor_data, sub
+                    agent_state, opt_states, train_metrics, train_key = train_fn(
+                        agent_state, opt_states, critic_data, actor_data, train_key
                     )
                     # Block only when the train timer needs an accurate stop;
                     # with metrics off the dispatch stays fully async, so the

@@ -22,7 +22,7 @@ import numpy as np
 from sheeprl_tpu.algos.dreamer_v1.agent import build_agent as dv1_build_agent
 from sheeprl_tpu.algos.dreamer_v1.dreamer_v1 import make_train_step
 from sheeprl_tpu.algos.dreamer_v2.dreamer_v2 import _make_optimizer
-from sheeprl_tpu.algos.p2e_dv1.utils import exploration_amount, prepare_obs, test
+from sheeprl_tpu.algos.p2e_dv1.utils import exploration_amount, normalize_player_obs, prepare_obs, test
 from sheeprl_tpu.algos.ppo.agent import actions_metadata
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.core.player import PlayerPlacement
@@ -201,10 +201,17 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
         )
 
     train_fn = make_train_step(agent, txs, cfg, runtime.mesh)
-    player_step_fn = jax.jit(
-        lambda wm, a, s, o, k, amount: agent.player_step(
-            wm, a, s, o, k, greedy=False, expl_amount=amount
+    player_cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+
+    def _player_step(wm, a, s, o, k, amount):
+        # PRNG split + obs normalization in-graph: ONE dispatch per env step.
+        next_k, sub = jax.random.split(k)
+        out = agent.player_step(
+            wm, a, s, normalize_player_obs(o, player_cnn_keys), sub, greedy=False, expl_amount=amount
         )
+        return (*out, next_k)
+
+    player_step_fn = jax.jit(_player_step
     )
     init_player_fn = jax.jit(agent.init_player_state, static_argnums=(1,))
     reset_player_fn = jax.jit(agent.reset_player_state)
@@ -257,16 +264,15 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
                 player_actor = (
                     player_actor_exploration if player_actor_type == "exploration" else pp["actor"]
                 )
-                jnp_obs = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
-                rollout_key, sub = jax.random.split(rollout_key)
+                np_obs = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
                 amount = exploration_amount(agent.actor_spec, policy_step)
-                actions_cat, real_actions_j, player_state = player_step_fn(
+                actions_cat, real_actions_j, player_state, rollout_key = player_step_fn(
                     pp["world_model"],
                     player_actor,
                     player_state,
-                    jnp_obs,
-                    sub,
-                    jnp.asarray(amount, jnp.float32),
+                    np_obs,
+                    rollout_key,
+                    np.asarray(amount, np.float32),
                 )
             # One host fetch for both arrays (single roundtrip).
             actions, real_actions = jax.device_get((actions_cat, real_actions_j))
@@ -339,9 +345,8 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
                 with timer("Time/train_time"):
                     for i in range(per_rank_gradient_steps):
                         batch = batches[i]
-                        train_key, sub = jax.random.split(train_key)
-                        agent_state, opt_states, train_metrics = train_fn(
-                            agent_state, opt_states, batch, sub
+                        agent_state, opt_states, train_metrics, train_key = train_fn(
+                            agent_state, opt_states, batch, train_key
                         )
                         per_step_metrics.append(train_metrics)
                         cumulative_per_rank_gradient_steps += 1

@@ -6,6 +6,7 @@ from sheeprl_tpu.algos.dreamer_v1.utils import (  # noqa: F401 (re-export)
     AGGREGATOR_KEYS as AGGREGATOR_KEYS_DV1,
     compute_lambda_values,
     exploration_amount,
+    normalize_player_obs,
     prepare_obs,
     test,
 )

@@ -28,7 +28,7 @@ from sheeprl_tpu.algos.dreamer_v2.dreamer_v2 import _make_optimizer
 from sheeprl_tpu.algos.dreamer_v2.loss import reconstruction_loss
 from sheeprl_tpu.algos.dreamer_v2.utils import compute_lambda_values
 from sheeprl_tpu.algos.p2e_dv2.agent import P2EDV2Agent, build_agent
-from sheeprl_tpu.algos.p2e_dv2.utils import prepare_obs, test
+from sheeprl_tpu.algos.p2e_dv2.utils import normalize_player_obs, prepare_obs, test
 from sheeprl_tpu.algos.ppo.agent import actions_metadata
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.core.mesh import DATA_AXIS
@@ -188,6 +188,7 @@ def make_train_step(agent: P2EDV2Agent, txs: Dict[str, Any], cfg: Dict[str, Any]
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def train_step(state, opt_states, data, key):
+        next_key, key = jax.random.split(key)
         T, B = data["rewards"].shape[:2]
         data = jax.lax.with_sharding_constraint(data, {k: batch_sharding for k in data})
         batch_obs = {k: data[k] / 255.0 - 0.5 for k in cnn_keys}
@@ -379,7 +380,7 @@ def make_train_step(agent: P2EDV2Agent, txs: Dict[str, Any], cfg: Dict[str, Any]
             "Grads/critic_exploration": optax.global_norm(ce_grads),
             "Grads/ensemble": optax.global_norm(ens_grads),
         }
-        return state, opt_states, metrics
+        return state, opt_states, metrics, next_key
 
     return train_step
 
@@ -546,9 +547,17 @@ def main(runtime, cfg: Dict[str, Any]):
         )
 
     train_fn = make_train_step(agent, txs, cfg, runtime.mesh)
-    player_step_fn = jax.jit(
-        lambda wm, a, s, o, k: agent.dv2.player_step(wm, a, s, o, k, greedy=False)
-    )
+    player_cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+
+    def _player_step(wm, a, s, o, k):
+        # PRNG split + obs normalization in-graph: ONE dispatch per env step.
+        next_k, sub = jax.random.split(k)
+        out = agent.dv2.player_step(
+            wm, a, s, normalize_player_obs(o, player_cnn_keys), sub, greedy=False
+        )
+        return (*out, next_k)
+
+    player_step_fn = jax.jit(_player_step)
     init_player_fn = jax.jit(agent.dv2.init_player_state, static_argnums=(1,))
     reset_player_fn = jax.jit(agent.dv2.reset_player_state)
     player_actor_key = (
@@ -613,11 +622,10 @@ def main(runtime, cfg: Dict[str, Any]):
                     )
             else:
                 with placement.ctx():
-                    jnp_obs = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
-                    rollout_key, sub = jax.random.split(rollout_key)
+                    np_obs = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
                     pp = placement.params()
-                    actions_cat, real_actions_j, player_state = player_step_fn(
-                        pp["world_model"], pp["actor"], player_state, jnp_obs, sub
+                    actions_cat, real_actions_j, player_state, rollout_key = player_step_fn(
+                        pp["world_model"], pp["actor"], player_state, np_obs, rollout_key
                     )
                 # One host fetch for both arrays: each separate np.asarray
                 # is a full device->host roundtrip (painful over a tunneled
@@ -710,9 +718,8 @@ def main(runtime, cfg: Dict[str, Any]):
                                 jnp.copy, agent_state["critic_exploration"]
                             )
                         batch = batches[i]
-                        train_key, sub = jax.random.split(train_key)
-                        agent_state, opt_states, train_metrics = train_fn(
-                            agent_state, opt_states, batch, sub
+                        agent_state, opt_states, train_metrics, train_key = train_fn(
+                            agent_state, opt_states, batch, train_key
                         )
                         per_step_metrics.append(train_metrics)
                         cumulative_per_rank_gradient_steps += 1

@@ -21,7 +21,7 @@ import numpy as np
 
 from sheeprl_tpu.algos.dreamer_v2.agent import build_agent as dv2_build_agent
 from sheeprl_tpu.algos.dreamer_v2.dreamer_v2 import _make_optimizer, make_train_step
-from sheeprl_tpu.algos.p2e_dv2.utils import prepare_obs, test
+from sheeprl_tpu.algos.p2e_dv2.utils import normalize_player_obs, prepare_obs, test
 from sheeprl_tpu.algos.ppo.agent import actions_metadata
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.core.player import PlayerPlacement
@@ -216,9 +216,17 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
         )
 
     train_fn = make_train_step(agent, txs, cfg, runtime.mesh)
-    player_step_fn = jax.jit(
-        lambda wm, a, s, o, k: agent.player_step(wm, a, s, o, k, greedy=False)
-    )
+    player_cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+
+    def _player_step(wm, a, s, o, k):
+        # PRNG split + obs normalization in-graph: ONE dispatch per env step.
+        next_k, sub = jax.random.split(k)
+        out = agent.player_step(
+            wm, a, s, normalize_player_obs(o, player_cnn_keys), sub, greedy=False
+        )
+        return (*out, next_k)
+
+    player_step_fn = jax.jit(_player_step)
     init_player_fn = jax.jit(agent.init_player_state, static_argnums=(1,))
     reset_player_fn = jax.jit(agent.reset_player_state)
     player_actor_type = cfg.algo.player.actor_type
@@ -274,10 +282,9 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
                 player_actor = (
                     player_actor_exploration if player_actor_type == "exploration" else pp["actor"]
                 )
-                jnp_obs = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
-                rollout_key, sub = jax.random.split(rollout_key)
-                actions_cat, real_actions_j, player_state = player_step_fn(
-                    pp["world_model"], player_actor, player_state, jnp_obs, sub
+                np_obs = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
+                actions_cat, real_actions_j, player_state, rollout_key = player_step_fn(
+                    pp["world_model"], player_actor, player_state, np_obs, rollout_key
                 )
             # One host fetch for both arrays (single roundtrip).
             actions, real_actions = jax.device_get((actions_cat, real_actions_j))
@@ -365,9 +372,8 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
                                 jnp.copy, agent_state["critic"]
                             )
                         batch = batches[i]
-                        train_key, sub = jax.random.split(train_key)
-                        agent_state, opt_states, train_metrics = train_fn(
-                            agent_state, opt_states, batch, sub
+                        agent_state, opt_states, train_metrics, train_key = train_fn(
+                            agent_state, opt_states, batch, train_key
                         )
                         per_step_metrics.append(train_metrics)
                         cumulative_per_rank_gradient_steps += 1

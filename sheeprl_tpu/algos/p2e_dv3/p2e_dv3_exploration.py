@@ -36,7 +36,7 @@ import optax
 from sheeprl_tpu.algos.dreamer_v3.agent import WorldModel, actor_forward, continuous_log_prob_and_entropy
 from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import _make_optimizer
 from sheeprl_tpu.algos.p2e_dv3.agent import P2EDV3Agent, build_agent
-from sheeprl_tpu.algos.p2e_dv3.utils import prepare_obs, test
+from sheeprl_tpu.algos.p2e_dv3.utils import normalize_player_obs, prepare_obs, test
 from sheeprl_tpu.algos.ppo.agent import actions_metadata
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.core.mesh import DATA_AXIS
@@ -207,6 +207,7 @@ def make_train_step(agent: P2EDV3Agent, txs: Dict[str, Any], cfg: Dict[str, Any]
 
     @partial(jax.jit, donate_argnums=(0, 1, 2))
     def train_step(state, opt_states, moments, data, key, tau):
+        next_key, key = jax.random.split(key)
         T, B = data["rewards"].shape[:2]
         data = jax.lax.with_sharding_constraint(data, {k: batch_sharding for k in data})
         batch_obs = {k: data[k] / 255.0 - 0.5 for k in cnn_keys}
@@ -467,7 +468,7 @@ def make_train_step(agent: P2EDV3Agent, txs: Dict[str, Any], cfg: Dict[str, Any]
             "Grads/ensemble": optax.global_norm(ens_grads),
             **critic_metrics,
         }
-        return state, opt_states, moments, metrics
+        return state, opt_states, moments, metrics, next_key
 
     return train_step
 
@@ -648,9 +649,17 @@ def main(runtime, cfg: Dict[str, Any]):
         )
 
     train_fn = make_train_step(agent, txs, cfg, mesh)
-    player_step_fn = jax.jit(
-        lambda wm, a, s, o, k: agent.dv3.player_step(wm, a, s, o, k, greedy=False)
-    )
+    player_cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+
+    def _player_step(wm, a, s, o, k):
+        # PRNG split + obs normalization in-graph: ONE dispatch per env step.
+        next_k, sub = jax.random.split(k)
+        out = agent.dv3.player_step(
+            wm, a, s, normalize_player_obs(o, player_cnn_keys), sub, greedy=False
+        )
+        return (*out, next_k)
+
+    player_step_fn = jax.jit(_player_step)
     init_player_fn = jax.jit(agent.dv3.init_player_state, static_argnums=(1,))
     reset_player_fn = jax.jit(agent.dv3.reset_player_state)
     # The player follows the configured actor (reference: agent.py:213-218).
@@ -711,11 +720,10 @@ def main(runtime, cfg: Dict[str, Any]):
                     )
             else:
                 with placement.ctx():
-                    jnp_obs = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
-                    rollout_key, sub = jax.random.split(rollout_key)
+                    np_obs = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
                     pp = placement.params()
-                    actions_cat, real_actions_j, player_state = player_step_fn(
-                        pp["world_model"], pp["actor"], player_state, jnp_obs, sub
+                    actions_cat, real_actions_j, player_state, rollout_key = player_step_fn(
+                        pp["world_model"], pp["actor"], player_state, np_obs, rollout_key
                     )
                 # One host fetch for both arrays: each separate np.asarray
                 # is a full device->host roundtrip (painful over a tunneled
@@ -815,9 +823,9 @@ def main(runtime, cfg: Dict[str, Any]):
                         else:
                             tau = 0.0
                         batch = batches[i]
-                        train_key, sub = jax.random.split(train_key)
-                        agent_state, opt_states, moments, train_metrics = train_fn(
-                            agent_state, opt_states, moments, batch, sub, jnp.asarray(tau, jnp.float32)
+                        agent_state, opt_states, moments, train_metrics, train_key = train_fn(
+                            agent_state, opt_states, moments, batch, train_key,
+                            np.asarray(tau, np.float32),
                         )
                         per_step_metrics.append(train_metrics)
                         cumulative_per_rank_gradient_steps += 1

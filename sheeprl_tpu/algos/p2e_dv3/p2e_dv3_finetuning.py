@@ -25,7 +25,7 @@ import numpy as np
 
 from sheeprl_tpu.algos.dreamer_v3.agent import build_agent as dv3_build_agent
 from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import _make_optimizer, make_train_step
-from sheeprl_tpu.algos.p2e_dv3.utils import prepare_obs, test
+from sheeprl_tpu.algos.p2e_dv3.utils import normalize_player_obs, prepare_obs, test
 from sheeprl_tpu.algos.ppo.agent import actions_metadata
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.core.player import PlayerPlacement
@@ -222,9 +222,17 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
         )
 
     train_fn = make_train_step(agent, txs, cfg, mesh)
-    player_step_fn = jax.jit(
-        lambda wm, a, s, o, k: agent.player_step(wm, a, s, o, k, greedy=False)
-    )
+    player_cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+
+    def _player_step(wm, a, s, o, k):
+        # PRNG split + obs normalization in-graph: ONE dispatch per env step.
+        next_k, sub = jax.random.split(k)
+        out = agent.player_step(
+            wm, a, s, normalize_player_obs(o, player_cnn_keys), sub, greedy=False
+        )
+        return (*out, next_k)
+
+    player_step_fn = jax.jit(_player_step)
     init_player_fn = jax.jit(agent.init_player_state, static_argnums=(1,))
     reset_player_fn = jax.jit(agent.reset_player_state)
     # Exploration actor plays until training starts, then the task actor
@@ -277,10 +285,9 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
                 player_actor = (
                     player_actor_exploration if player_actor_type == "exploration" else pp["actor"]
                 )
-                jnp_obs = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
-                rollout_key, sub = jax.random.split(rollout_key)
-                actions_cat, real_actions_j, player_state = player_step_fn(
-                    pp["world_model"], player_actor, player_state, jnp_obs, sub
+                np_obs = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
+                actions_cat, real_actions_j, player_state, rollout_key = player_step_fn(
+                    pp["world_model"], player_actor, player_state, np_obs, rollout_key
                 )
             # One host fetch for both arrays (single roundtrip).
             actions, real_actions = jax.device_get((actions_cat, real_actions_j))
@@ -381,9 +388,9 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
                         else:
                             tau = 0.0
                         batch = batches[i]
-                        train_key, sub = jax.random.split(train_key)
-                        agent_state, opt_states, moments_state, train_metrics = train_fn(
-                            agent_state, opt_states, moments_state, batch, sub, jnp.asarray(tau, jnp.float32)
+                        agent_state, opt_states, moments_state, train_metrics, train_key = train_fn(
+                            agent_state, opt_states, moments_state, batch, train_key,
+                            np.asarray(tau, np.float32),
                         )
                         per_step_metrics.append(train_metrics)
                         cumulative_per_rank_gradient_steps += 1

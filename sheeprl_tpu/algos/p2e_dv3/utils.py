@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from sheeprl_tpu.algos.dreamer_v3.utils import (  # noqa: F401 (re-export)
     AGGREGATOR_KEYS as AGGREGATOR_KEYS_DV3,
+    normalize_player_obs,
     prepare_obs,
     test,
 )

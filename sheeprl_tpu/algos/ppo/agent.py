@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
 
+from sheeprl_tpu.algos.ppo.utils import normalize_obs
 from sheeprl_tpu.models import MLP, MultiEncoder, NatureCNN
 from sheeprl_tpu.utils.distribution import Independent, Normal, OneHotCategorical
 from sheeprl_tpu.utils.ops import safeatanh, safetanh
@@ -177,6 +178,7 @@ class PPOAgent:
     actions_dim: Tuple[int, ...]
     is_continuous: bool
     distribution: str  # "normal" | "tanh_normal" | "discrete"
+    cnn_keys: Tuple[str, ...] = ()
 
     # ----------------------------------------------------------- training
     def evaluate_actions(
@@ -213,11 +215,14 @@ class PPOAgent:
     # ------------------------------------------------------------- player
     def player_step(
         self, params: Any, obs: Dict[str, jax.Array], key: jax.Array
-    ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
         """Sample actions for the rollout: (actions_cat, real_actions,
-        logprobs[B,1], values[B,1]); real_actions is what the env consumes
-        (indices for discrete, raw for continuous) — reference PPOPlayer
-        (agent.py:271-293)."""
+        logprobs[B,1], values[B,1], next_key); real_actions is what the env
+        consumes (indices for discrete, raw for continuous) — reference
+        PPOPlayer (agent.py:271-293). Obs normalization and the PRNG split
+        happen in-graph so one jitted call is the step's ONLY dispatch."""
+        obs = normalize_obs(obs, self.cnn_keys, list(obs.keys()))
+        next_key, key = jax.random.split(key)
         actor_out, values = self.module.apply(params, obs)
         if self.is_continuous:
             mean, log_std = jnp.split(actor_out[0], 2, axis=-1)
@@ -229,7 +234,7 @@ class PPOAgent:
                 actions = tanh_actions
             else:
                 logprob = dist.log_prob(actions)
-            return actions, actions, logprob[..., None], values
+            return actions, actions, logprob[..., None], values, next_key
         actions = []
         real_actions = []
         logprobs = []
@@ -245,9 +250,11 @@ class PPOAgent:
             jnp.stack(real_actions, -1),
             jnp.stack(logprobs, -1).sum(-1, keepdims=True),
             values,
+            next_key,
         )
 
     def get_values(self, params: Any, obs: Dict[str, jax.Array]) -> jax.Array:
+        obs = normalize_obs(obs, self.cnn_keys, list(obs.keys()))
         _, values = self.module.apply(params, obs)
         return values
 
@@ -256,6 +263,7 @@ class PPOAgent:
     ) -> jax.Array:
         """Env-facing actions only (test/eval path) — reference
         PPOPlayer.get_actions (agent.py:299-322)."""
+        obs = normalize_obs(obs, self.cnn_keys, list(obs.keys()))
         actor_out, _ = self.module.apply(params, obs)
         if self.is_continuous:
             mean, log_std = jnp.split(actor_out[0], 2, axis=-1)
@@ -327,6 +335,7 @@ def build_agent(
         actions_dim=tuple(int(d) for d in actions_dim),
         is_continuous=is_continuous,
         distribution=distribution,
+        cnn_keys=tuple(cfg.algo.cnn_keys.encoder),
     )
     if agent_state is not None:
         params = jax.tree_util.tree_map(jnp.asarray, agent_state)

@@ -79,6 +79,7 @@ def make_train_step(agent: PPOAgent, tx: optax.GradientTransformation, cfg: Dict
     @partial(jax.jit, donate_argnums=(0, 1))
     def train_step(params, opt_state, data, key, clip_coef, ent_coef):
         n = data["actions"].shape[0]
+        next_key, key = jax.random.split(key)
         num_mb = max(1, -(-n // mb_size))  # ceil
 
         def epoch_body(carry, epoch_key):
@@ -107,7 +108,7 @@ def make_train_step(agent: PPOAgent, tx: optax.GradientTransformation, cfg: Dict
         keys = jax.random.split(key, update_epochs)
         (params, opt_state), metrics = jax.lax.scan(epoch_body, (params, opt_state), keys)
         m = metrics.mean(0)
-        return params, opt_state, {"policy_loss": m[0], "value_loss": m[1], "entropy_loss": m[2]}
+        return params, opt_state, {"policy_loss": m[0], "value_loss": m[1], "entropy_loss": m[2]}, next_key
 
     return train_step
 
@@ -280,13 +281,15 @@ def main(runtime, cfg: Dict[str, Any]):
 
             with timer("Time/env_interaction_time"):
                 with placement.ctx():
-                    jnp_obs = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
-                    rollout_key, sub = jax.random.split(rollout_key)
-                    # Single host fetch for the whole step output (one
-                    # device->host roundtrip instead of four).
-                    actions, real_actions_np, logprobs, values = jax.device_get(
-                        player_step_fn(placement.params(), jnp_obs, sub)
+                    # prepare_obs is pure numpy and the PRNG split + pixel
+                    # normalization live inside player_step: the jitted call
+                    # is the step's only device dispatch, and ONE host fetch
+                    # collects all outputs.
+                    np_obs = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
+                    *step_out, rollout_key = player_step_fn(
+                        placement.params(), np_obs, rollout_key
                     )
+                    actions, real_actions_np, logprobs, values = jax.device_get(step_out)
 
                 obs, rewards, terminated, truncated, info = envs.step(
                     real_actions_np.reshape(envs.action_space.shape)
@@ -364,14 +367,15 @@ def main(runtime, cfg: Dict[str, Any]):
         sharded = runtime.shard_batch(flat)
 
         with timer("Time/train_time"):
-            train_key, sub = jax.random.split(train_key)
-            params, opt_state, train_metrics = train_fn(
+            # PRNG split runs inside the jit (an eager split on a remote
+            # device blocks the host); coefs travel as numpy.
+            params, opt_state, train_metrics, train_key = train_fn(
                 params,
                 opt_state,
                 sharded,
-                sub,
-                jnp.asarray(cfg.algo.clip_coef, jnp.float32),
-                jnp.asarray(cfg.algo.ent_coef, jnp.float32),
+                train_key,
+                np.asarray(cfg.algo.clip_coef, np.float32),
+                np.asarray(cfg.algo.ent_coef, np.float32),
             )
             # Block only when the train timer needs an accurate stop;
             # with metrics off the dispatch stays fully async, so the

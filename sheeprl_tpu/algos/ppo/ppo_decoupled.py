@@ -231,13 +231,13 @@ def main(runtime, cfg: Dict[str, Any]):
 
             with timer("Time/env_interaction_time"):
                 with jax.default_device(player_device):
-                    jnp_obs = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
-                    rollout_key, sub = jax.random.split(rollout_key)
-                # Single host fetch for the whole step output (one
-                # device->host roundtrip instead of four).
-                actions, real_actions_np, logprobs, values = jax.device_get(
-                    player_step_fn(params_mirror.get(), jnp_obs, sub)
-                )
+                    # prepare_obs is numpy; PRNG split + normalization run
+                    # inside the jit — one dispatch, one host fetch per step.
+                    np_obs = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
+                    *step_out, rollout_key = player_step_fn(
+                        params_mirror.get(), np_obs, rollout_key
+                    )
+                actions, real_actions_np, logprobs, values = jax.device_get(step_out)
 
                 obs, rewards, terminated, truncated, info = envs.step(
                     real_actions_np.reshape(envs.action_space.shape)
@@ -309,14 +309,13 @@ def main(runtime, cfg: Dict[str, Any]):
         }
 
         with timer("Time/train_time"):
-            train_key, sub = jax.random.split(train_key)
-            params, opt_state, train_metrics = train_fn(
+            params, opt_state, train_metrics, train_key = train_fn(
                 params,
                 opt_state,
                 flat,
-                sub,
-                jnp.asarray(cfg.algo.clip_coef, jnp.float32),
-                jnp.asarray(cfg.algo.ent_coef, jnp.float32),
+                train_key,
+                np.asarray(cfg.algo.clip_coef, np.float32),
+                np.asarray(cfg.algo.ent_coef, np.float32),
             )
             # The broadcast back: the player's next rollout waits on this copy.
             params_mirror.push(params)

@@ -28,18 +28,22 @@ def normalize_obs(
 
 def prepare_obs(
     obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), num_envs: int = 1, **kwargs: Any
-) -> Dict[str, jax.Array]:
-    """Host obs dict → float device arrays [num_envs, ...] with pixel scaling
-    (reference: utils.py:25-35; no CHW reshape — pixels are already HWC)."""
-    jnp_obs = {}
+) -> Dict[str, np.ndarray]:
+    """Host obs dict → numpy arrays [num_envs, ...] ready to be jit inputs
+    (reference: utils.py:25-35; no CHW reshape — pixels are already HWC).
+
+    Pure numpy on purpose: each eager jnp op here would be a separate device
+    dispatch per env step. Pixels stay uint8 (normalize_obs runs INSIDE the
+    player/train jits); vector keys become float32."""
+    np_obs = {}
     for k, v in obs.items():
-        arr = jnp.asarray(v)
+        arr = np.asarray(v)
         if k not in cnn_keys:
-            arr = arr.reshape(num_envs, -1)
+            arr = arr.reshape(num_envs, -1).astype(np.float32)
         else:
             arr = arr.reshape(num_envs, *arr.shape[-3:])
-        jnp_obs[k] = arr.astype(jnp.float32)
-    return normalize_obs(jnp_obs, cnn_keys, list(jnp_obs.keys()))
+        np_obs[k] = arr
+    return np_obs
 
 
 def test(agent, params, runtime, cfg: Dict[str, Any], log_dir: str, logger=None) -> float:

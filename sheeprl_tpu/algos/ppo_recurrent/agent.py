@@ -27,6 +27,7 @@ from sheeprl_tpu.algos.ppo.agent import (
     PPOActor,
     _tanh_correction,
 )
+from sheeprl_tpu.algos.ppo.utils import normalize_obs
 from sheeprl_tpu.models import MLP, MultiEncoder
 from sheeprl_tpu.utils.distribution import Independent, Normal, OneHotCategorical
 from sheeprl_tpu.utils.ops import safeatanh, safetanh
@@ -163,6 +164,7 @@ class RecurrentPPOAgent:
     is_continuous: bool
     distribution: str
     rnn_hidden_size: int
+    cnn_keys: Tuple[str, ...] = ()
 
     def initial_states(self, n_envs: int) -> Tuple[jax.Array, jax.Array]:
         z = jnp.zeros((n_envs, self.rnn_hidden_size), jnp.float32)
@@ -182,7 +184,9 @@ class RecurrentPPOAgent:
         key: jax.Array,
     ):
         """One env step = a length-1 sequence: (actions_cat, real_actions,
-        logprobs[B,1], values[B,1], new_carry)."""
+        logprobs[B,1], values[B,1], new_carry). Obs normalization happens
+        in-graph (prepare_obs hands raw numpy)."""
+        obs = normalize_obs(obs, self.cnn_keys, list(obs.keys()))
         obs = {k: v[None] for k, v in obs.items()}
         zeros = jnp.zeros((1, prev_actions.shape[0], 1), jnp.float32)
         actor_out, values, carry = self.module.apply(params, obs, prev_actions[None], carry, zeros)
@@ -218,6 +222,7 @@ class RecurrentPPOAgent:
         )
 
     def get_values(self, params: Any, obs: Dict[str, jax.Array], prev_actions: jax.Array, carry) -> jax.Array:
+        obs = normalize_obs(obs, self.cnn_keys, list(obs.keys()))
         obs = {k: v[None] for k, v in obs.items()}
         zeros = jnp.zeros((1, prev_actions.shape[0], 1), jnp.float32)
         _, values, _ = self.module.apply(params, obs, prev_actions[None], carry, zeros)
@@ -233,6 +238,7 @@ class RecurrentPPOAgent:
         greedy: bool = False,
     ):
         """Env-facing actions + carry (test/eval path)."""
+        obs = normalize_obs(obs, self.cnn_keys, list(obs.keys()))
         obs = {k: v[None] for k, v in obs.items()}
         zeros = jnp.zeros((1, prev_actions.shape[0], 1), jnp.float32)
         actor_out, _, carry = self.module.apply(params, obs, prev_actions[None], carry, zeros)
@@ -335,6 +341,7 @@ def build_agent(
         is_continuous=is_continuous,
         distribution=distribution,
         rnn_hidden_size=int(cfg.algo.rnn.lstm.hidden_size),
+        cnn_keys=tuple(cfg.algo.cnn_keys.encoder),
     )
     if agent_state is not None:
         params = jax.tree_util.tree_map(jnp.asarray, agent_state)

@@ -81,6 +81,7 @@ def make_train_step(agent: RecurrentPPOAgent, tx: optax.GradientTransformation, 
     @partial(jax.jit, donate_argnums=(0, 1))
     def train_step(params, opt_state, data, key, clip_coef, ent_coef):
         """data: dict of [S, ...] arrays — sequence-major; hx0/cx0 are [S, H]."""
+        next_key, key = jax.random.split(key)
         n = data["actions"].shape[0]
         mb_size = max(1, n // num_batches)
         num_mb = max(1, -(-n // mb_size))
@@ -113,7 +114,7 @@ def make_train_step(agent: RecurrentPPOAgent, tx: optax.GradientTransformation, 
         keys = jax.random.split(key, update_epochs)
         (params, opt_state), metrics = jax.lax.scan(epoch_body, (params, opt_state), keys)
         m = metrics.mean(0)
-        return params, opt_state, {"policy_loss": m[0], "value_loss": m[1], "entropy_loss": m[2]}
+        return params, opt_state, {"policy_loss": m[0], "value_loss": m[1], "entropy_loss": m[2]}, next_key
 
     return train_step
 
@@ -421,14 +422,13 @@ def main(runtime, cfg: Dict[str, Any]):
         seq_data["cx0"] = cx[:, 0].reshape(chunks * n_envs, -1)
 
         with timer("Time/train_time"):
-            train_key, sub = jax.random.split(train_key)
-            params, opt_state, train_metrics = train_fn(
+            params, opt_state, train_metrics, train_key = train_fn(
                 params,
                 opt_state,
                 seq_data,
-                sub,
-                jnp.asarray(cfg.algo.clip_coef, jnp.float32),
-                jnp.asarray(cfg.algo.ent_coef, jnp.float32),
+                train_key,
+                np.asarray(cfg.algo.clip_coef, np.float32),
+                np.asarray(cfg.algo.ent_coef, np.float32),
             )
             # Block only when the train timer needs an accurate stop;
             # with metrics off the dispatch stays fully async, so the

@@ -59,7 +59,10 @@ def make_train_step(agent: SACAgent, txs: Dict[str, optax.GradientTransformation
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def train_step(state, opt_states, data, key, tau_eff):
-        """data: dict of [G, B, ...] minibatches; tau_eff: tau or 0."""
+        """data: dict of [G, B, ...] minibatches; tau_eff: tau or 0.
+        Returns the split-off next key so the caller never runs an eager
+        (host-blocking) split between calls — the key stays device-resident."""
+        next_key, key = jax.random.split(key)
 
         def gradient_step(carry, batch):
             state, opt_states = carry
@@ -110,7 +113,7 @@ def make_train_step(agent: SACAgent, txs: Dict[str, optax.GradientTransformation
         data = dict(data, _key=keys)
         (state, opt_states), metrics = jax.lax.scan(gradient_step, (state, opt_states), data)
         m = metrics.mean(0)
-        return state, opt_states, {"value_loss": m[0], "policy_loss": m[1], "alpha_loss": m[2]}
+        return state, opt_states, {"value_loss": m[0], "policy_loss": m[1], "alpha_loss": m[2]}, next_key
 
     return train_step
 
@@ -242,7 +245,12 @@ def main(runtime, cfg: Dict[str, Any]):
             "the checkpoint will be saved at the nearest greater multiple of the policy_steps_per_iter value."
         )
 
-    player_fn = jax.jit(lambda p, o, k: agent.get_actions(p, o, k, greedy=False))
+    def _player(p, o, k):
+        # PRNG split in-graph: the jitted call is the step's only dispatch.
+        next_k, sub = jax.random.split(k)
+        return agent.get_actions(p, o, sub, greedy=False), next_k
+
+    player_fn = jax.jit(_player)
     train_fn = make_train_step(agent, txs, cfg, mesh)
     target_freq_iters = cfg.algo.critic.target_network_frequency // policy_steps_per_iter + 1
 
@@ -267,9 +275,9 @@ def main(runtime, cfg: Dict[str, Any]):
                 actions = envs.action_space.sample()
             else:
                 with placement.ctx():
-                    jnp_obs = prepare_obs(obs, mlp_keys=mlp_keys, num_envs=cfg.env.num_envs)
-                    rollout_key, sub = jax.random.split(rollout_key)
-                    actions = np.asarray(player_fn(placement.params(), jnp_obs, sub))
+                    np_obs = prepare_obs(obs, mlp_keys=mlp_keys, num_envs=cfg.env.num_envs)
+                    actions_j, rollout_key = player_fn(placement.params(), np_obs, rollout_key)
+                    actions = np.asarray(actions_j)
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 actions.reshape(envs.action_space.shape)
             )
@@ -322,14 +330,15 @@ def main(runtime, cfg: Dict[str, Any]):
                     for k, v in sample.items()
                 }
                 with timer("Time/train_time"):
-                    train_key, sub = jax.random.split(train_key)
                     do_ema = iter_num % target_freq_iters == 0
-                    agent_state, opt_states, train_metrics = train_fn(
+                    # tau as numpy (an eager jnp.asarray would dispatch);
+                    # the PRNG split happens inside the jit.
+                    agent_state, opt_states, train_metrics, train_key = train_fn(
                         agent_state,
                         opt_states,
                         data,
-                        sub,
-                        jnp.asarray(agent.tau if do_ema else 0.0, jnp.float32),
+                        train_key,
+                        np.asarray(agent.tau if do_ema else 0.0, np.float32),
                     )
                     # Block only when the train timer needs an accurate stop;
                     # with metrics off the dispatch stays fully async, so the

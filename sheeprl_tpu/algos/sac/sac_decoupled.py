@@ -211,7 +211,11 @@ def main(runtime, cfg: Dict[str, Any]):
     # The same jitted G-step scan as coupled SAC, compiled over the trainer
     # mesh only (its `data` axis is the trainer partition).
     train_fn = make_train_step(agent, txs, cfg, trainer_mesh)
-    player_fn = jax.jit(lambda p, o, k: agent.get_actions(p, o, k, greedy=False))
+    def _player(p, o, k):
+        next_k, sub = jax.random.split(k)
+        return agent.get_actions(p, o, sub, greedy=False), next_k
+
+    player_fn = jax.jit(_player)
     batch_sharding = NamedSharding(trainer_mesh, P(None, DATA_AXIS))
     target_freq_iters = cfg.algo.critic.target_network_frequency // policy_steps_per_iter + 1
 
@@ -230,9 +234,9 @@ def main(runtime, cfg: Dict[str, Any]):
                 actions = envs.action_space.sample()
             else:
                 with jax.default_device(player_device):
-                    jnp_obs = prepare_obs(obs, mlp_keys=mlp_keys, num_envs=cfg.env.num_envs)
-                    rollout_key, sub = jax.random.split(rollout_key)
-                actions = np.asarray(player_fn(actor_mirror.get(), jnp_obs, sub))
+                    np_obs = prepare_obs(obs, mlp_keys=mlp_keys, num_envs=cfg.env.num_envs)
+                    actions_j, rollout_key = player_fn(actor_mirror.get(), np_obs, rollout_key)
+                actions = np.asarray(actions_j)
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 actions.reshape(envs.action_space.shape)
             )
@@ -292,14 +296,13 @@ def main(runtime, cfg: Dict[str, Any]):
                     for k, v in sample.items()
                 }
                 with timer("Time/train_time"):
-                    train_key, sub = jax.random.split(train_key)
                     do_ema = iter_num % target_freq_iters == 0
-                    agent_state, opt_states, train_metrics = train_fn(
+                    agent_state, opt_states, train_metrics, train_key = train_fn(
                         agent_state,
                         opt_states,
                         data,
-                        sub,
-                        jnp.asarray(agent.tau if do_ema else 0.0, jnp.float32),
+                        train_key,
+                        np.asarray(agent.tau if do_ema else 0.0, np.float32),
                     )
                     # The broadcast back: enqueue the packed weight copy and
                     # return to env stepping.

@@ -22,11 +22,12 @@ MODELS_TO_REGISTER = {"agent"}
 
 def prepare_obs(
     obs: Dict[str, np.ndarray], *, mlp_keys: Sequence[str] = (), num_envs: int = 1, **kwargs: Any
-) -> jax.Array:
-    """Vector obs → single concatenated float array [num_envs, D]
-    (reference: utils.py:31-36)."""
-    return jnp.concatenate(
-        [jnp.asarray(obs[k], jnp.float32) for k in mlp_keys], axis=-1
+) -> np.ndarray:
+    """Vector obs → single concatenated float32 numpy array [num_envs, D]
+    (reference: utils.py:31-36). Numpy on purpose: eager jnp ops here would
+    each be a device dispatch per env step."""
+    return np.concatenate(
+        [np.asarray(obs[k], np.float32) for k in mlp_keys], axis=-1
     ).reshape(num_envs, -1)
 
 

@@ -61,6 +61,7 @@ def make_train_step(agent: SACAEAgent, txs: Dict[str, optax.GradientTransformati
 
     @partial(jax.jit, donate_argnums=(0, 1), static_argnums=(4, 5, 6))
     def train_step(state, opt_states, batch, key, update_actor, update_ema, update_decoder):
+        next_key, key = jax.random.split(key)
         batch = jax.lax.with_sharding_constraint(batch, {k: flat_sharding for k in batch})
         obs = normalize_pixels({k: batch[k] for k in cnn_keys + mlp_keys}, cnn_keys)
         next_obs = normalize_pixels(
@@ -163,7 +164,7 @@ def make_train_step(agent: SACAEAgent, txs: Dict[str, optax.GradientTransformati
             opt_states = dict(opt_states, encoder=enc_opt, decoder=dec_opt)
             metrics["reconstruction_loss"] = rec_l
 
-        return state, opt_states, metrics
+        return state, opt_states, metrics, next_key
 
     return train_step
 
@@ -324,9 +325,12 @@ def main(runtime, cfg: Dict[str, Any]):
             "the checkpoint will be saved at the nearest greater multiple of the policy_steps_per_iter value."
         )
 
-    player_fn = jax.jit(
-        lambda s, o, k: agent.get_actions(s, normalize_pixels(o, cnn_keys), k, greedy=False)
-    )
+    def _player(s, o, k):
+        # PRNG split + pixel normalization in-graph: ONE dispatch per step.
+        next_k, sub = jax.random.split(k)
+        return agent.get_actions(s, normalize_pixels(o, cnn_keys), sub, greedy=False), next_k
+
+    player_fn = jax.jit(_player)
     train_fn = make_train_step(agent, txs, cfg, mesh)
 
     # Latency-aware player placement (core/player.py); off-policy: honors
@@ -355,9 +359,9 @@ def main(runtime, cfg: Dict[str, Any]):
                 actions = envs.action_space.sample()
             else:
                 with placement.ctx():
-                    jnp_obs = prepare_obs(obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=cfg.env.num_envs)
-                    rollout_key, sub = jax.random.split(rollout_key)
-                    actions = np.asarray(player_fn(placement.params(), jnp_obs, sub))
+                    np_obs = prepare_obs(obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=cfg.env.num_envs)
+                    actions_j, rollout_key = player_fn(placement.params(), np_obs, rollout_key)
+                    actions = np.asarray(actions_j)
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 actions.reshape(envs.action_space.shape)
             )
@@ -415,7 +419,6 @@ def main(runtime, cfg: Dict[str, Any]):
                 with timer("Time/train_time"):
                     for i in range(per_rank_gradient_steps):
                         batch = {k: jnp.asarray(v[i]) for k, v in data.items()}
-                        train_key, sub = jax.random.split(train_key)
                         update_actor = (
                             cumulative_per_rank_gradient_steps % cfg.algo.actor.per_rank_update_freq == 0
                         )
@@ -427,8 +430,8 @@ def main(runtime, cfg: Dict[str, Any]):
                         update_decoder = (
                             cumulative_per_rank_gradient_steps % cfg.algo.decoder.per_rank_update_freq == 0
                         )
-                        agent_state, opt_states, train_metrics = train_fn(
-                            agent_state, opt_states, batch, sub, update_actor, update_ema, update_decoder
+                        agent_state, opt_states, train_metrics, train_key = train_fn(
+                            agent_state, opt_states, batch, train_key, update_actor, update_ema, update_decoder
                         )
                         per_step_metrics.append((train_metrics, update_actor, update_decoder))
                         cumulative_per_rank_gradient_steps += 1

@@ -40,9 +40,10 @@ def prepare_obs(
     """Host obs dict -> device dict; pixels stay uint8 (normalized in-graph)."""
     out = {}
     for k in cnn_keys:
-        out[k] = jnp.asarray(np.asarray(obs[k]).reshape(num_envs, *np.asarray(obs[k]).shape[-3:]))
+        arr = np.asarray(obs[k])
+        out[k] = arr.reshape(num_envs, *arr.shape[-3:])
     for k in mlp_keys:
-        out[k] = jnp.asarray(np.asarray(obs[k], np.float32).reshape(num_envs, -1))
+        out[k] = np.asarray(obs[k], np.float32).reshape(num_envs, -1)
     return out
 
 

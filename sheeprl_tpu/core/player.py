@@ -134,13 +134,22 @@ class ParamMirror:
     hop is one transfer instead of one per leaf — over a high-latency link a
     per-leaf ``device_put`` pays the full round trip ~#leaves times. (This is
     the role of the reference's ``parameters_to_vector`` broadcast,
-    sac_decoupled.py:260-263.) Unpacking happens lazily on the player device
-    at ``get()`` time: in ``async`` mode a pending packed snapshot is only
-    unpacked once its transfer finished, so neither push nor get blocks.
+    sac_decoupled.py:260-263.)
 
-    The push enqueues the pack + copy immediately — never stashing the source
-    arrays — because train steps donate their inputs: holding a reference for
-    a deferred copy would read a deleted buffer.
+    The transfer leg runs on a worker thread: ``jax.device_put`` across
+    devices blocks its calling thread for the whole copy (measured: the call
+    itself takes the full transfer time over a remote link), so the main
+    thread only packs (an async on-device dispatch) and hands the packed
+    vectors over. In ``async`` mode at most one transfer is in flight with
+    the NEWEST snapshot parked behind it (older waiting snapshots are the
+    ones dropped); ``fresh`` mode submits every push and the next ``get()``
+    waits for the last — tied-weights semantics, with the copy overlapping
+    whatever the host does between update and next action.
+
+    The pack runs immediately at push — never stashing the source arrays —
+    because train steps donate their inputs: holding a reference for a
+    deferred copy would read a deleted buffer. The worker only ever touches
+    packed vectors, which nothing donates.
     """
 
     def __init__(self, device: Optional[jax.Device], *, sync: str = "fresh") -> None:
@@ -150,11 +159,12 @@ class ParamMirror:
         self.device = device
         self.sync = sync
         self._current: Any = None
-        self._pending_packed: Any = None
+        self._transfer = None  # Future of the in-flight D2H copy
         # Newest packed snapshot waiting behind an in-flight transfer
         # (async backpressure): at most one transfer in flight plus one
         # waiting snapshot, and the waiting slot always holds the NEWEST.
         self._next_packed: Any = None
+        self._executor = None
         self._treedef = None
         self._shapes: Any = None
         self._dtypes: Any = None
@@ -199,16 +209,25 @@ class ParamMirror:
             return self._unpack_fn(packed)
 
     # -------------------------------------------------------------- public
-    def _promote(self) -> None:
+    def _submit(self, packed: Any):
+        import concurrent.futures
+
+        if self._executor is None:
+            self._executor = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="sheeprl-mirror"
+            )
+        return self._executor.submit(jax.device_put, packed, self.device)
+
+    def _promote(self, wait: bool = False) -> None:
         """Advance the pipeline: finished transfer -> current; waiting
         snapshot -> in-flight."""
-        if self._pending_packed is not None and (
-            self._current is None or _all_ready(self._pending_packed)
+        if self._transfer is not None and (
+            wait or self._current is None or self._transfer.done()
         ):
-            self._current = self._unpack_on_device(self._pending_packed)
-            self._pending_packed = None
-        if self._pending_packed is None and self._next_packed is not None:
-            self._pending_packed = jax.device_put(self._next_packed, self.device)
+            self._current = self._unpack_on_device(self._transfer.result())
+            self._transfer = None
+        if self._transfer is None and self._next_packed is not None:
+            self._transfer = self._submit(self._next_packed)
             self._next_packed = None
 
     def push(self, params: Any) -> None:
@@ -219,11 +238,13 @@ class ParamMirror:
         if self._pack_fn is None:
             self._build_codec(params)
         packed = self._pack_fn(params)
-        if self.sync == "fresh" or self._pending_packed is None:
-            self._pending_packed = jax.device_put(packed, self.device)
+        if self.sync == "fresh" or self._transfer is None:
+            # FIFO worker: in fresh mode every push transfers and get() waits
+            # for the newest; replacing the Future reference keeps exactly it.
+            self._transfer = self._submit(packed)
             self._next_packed = None
             return
-        if not _all_ready(self._pending_packed):
+        if not self._transfer.done():
             # Backpressure: keep the in-flight transfer, park THIS (newest)
             # snapshot in the waiting slot — older waiting snapshots are the
             # ones dropped, so the newest always lands eventually.
@@ -232,16 +253,11 @@ class ParamMirror:
             self._next_packed = packed
             return
         self._promote()
-        self._pending_packed = jax.device_put(packed, self.device)
+        self._transfer = self._submit(packed)
 
     def get(self) -> Any:
         if self.device is not None:
-            if self.sync == "fresh":
-                if self._pending_packed is not None:
-                    self._current = self._unpack_on_device(self._pending_packed)
-                    self._pending_packed = None
-            else:
-                self._promote()
+            self._promote(wait=self.sync == "fresh")
         return self._current
 
     def flush(self) -> Any:
@@ -251,10 +267,8 @@ class ParamMirror:
         are reported for the trained weights, not a stale mirror.
         """
         if self.device is not None:
-            while self._pending_packed is not None or self._next_packed is not None:
-                if self._pending_packed is not None:
-                    jax.block_until_ready(self._pending_packed)
-                self._promote()
+            while self._transfer is not None or self._next_packed is not None:
+                self._promote(wait=True)
         return self._current
 
 

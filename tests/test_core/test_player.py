@@ -81,8 +81,7 @@ class TestParamMirror:
         first = mirror.get()
         assert first is not None
         mirror.push({"w": jnp.ones((2,))})
-        jax.block_until_ready(mirror._pending_packed)
-        np.testing.assert_array_equal(np.asarray(mirror.get()["w"]), np.ones((2,)))
+        np.testing.assert_array_equal(np.asarray(mirror.flush()["w"]), np.ones((2,)))
         assert mirror.pushes == 2
 
     def test_async_never_blocks_on_none(self):
