@@ -42,8 +42,13 @@ def _setup_jax():
     import jax
 
     # Persistent compile cache: the warmup run's XLA executables are disk-cache
-    # hits in the measured run, so timing excludes compilation.
-    jax.config.update("jax_compilation_cache_dir", "/tmp/sheeprl_tpu_jax_cache")
+    # hits in the measured run, so timing excludes compilation. Same per-user
+    # secured path the Runtime defaults to (core/runtime.py).
+    from sheeprl_tpu.core.runtime import user_compilation_cache_dir
+
+    cache_dir = user_compilation_cache_dir()
+    if cache_dir is not None:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 
@@ -102,12 +107,19 @@ def _timeboxed(
         if t2 - t1 >= MIN_MEASURE_S or s2 >= total_steps:
             break
     sps = (s2 - s1) / max(t2 - t1, 1e-9)
-    return {
+    result = {
         "metric": metric,
         "value": round(sps, 2),
         "unit": "env-steps/sec",
         "vs_baseline": round(sps / baseline_sps, 3),
     }
+    # Report the weight-mirror semantics the number was measured under, so
+    # async (stale-weights) numbers are never mistaken for the reference's
+    # tied-weights coupled-loop semantics.
+    for ov in extra:
+        if ov.startswith("fabric.player_sync="):
+            result["player_sync"] = ov.split("=", 1)[1]
+    return result
 
 
 def bench_ppo():

@@ -318,11 +318,15 @@ def main(runtime, cfg: Dict[str, Any]):
             aggregator.update("Loss/policy_loss", tm["policy_loss"])
             aggregator.update("Loss/value_loss", tm["value_loss"])
 
+        should_log = cfg.metric.log_level > 0 and (
+            policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
+        )
+        if should_log and aggregator and not aggregator.disabled:
+            # Collective when sync_on_compute is on: every rank joins;
+            # only rank 0 (the only rank with a logger) writes.
+            aggregator.log_and_reset(logger, policy_step)
         if cfg.metric.log_level > 0 and logger is not None:
-            if policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters:
-                if aggregator and not aggregator.disabled:
-                    logger.log_dict(aggregator.compute(), policy_step)
-                    aggregator.reset()
+            if should_log:
                 if not timer.disabled:
                     timer_metrics = timer.compute()
                     if timer_metrics.get("Time/train_time", 0) > 0:
@@ -339,8 +343,9 @@ def main(runtime, cfg: Dict[str, Any]):
                             policy_step,
                         )
                     timer.reset()
-                last_log = policy_step
-                last_train = train_step_count
+        if should_log:
+            last_log = policy_step
+            last_train = train_step_count
 
         if cfg.algo.anneal_lr:
             new_lr = polynomial_decay(iter_num, initial=base_lr, final=0.0, max_decay_steps=total_iters, power=1.0)

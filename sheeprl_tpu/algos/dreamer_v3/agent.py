@@ -589,7 +589,12 @@ class Actor(nn.Module):
 @dataclass(frozen=True)
 class ActorSpec:
     """Distribution metadata for the actor head outputs
-    (reference Actor attributes: agent.py:746-781)."""
+    (reference Actor attributes: agent.py:746-781).
+
+    ``mask_mode`` selects env-provided action masking at sampling time:
+    "minedojo" applies the MineDojo mask protocol (the reference subclasses
+    the module as MinedojoActor, agent.py:848-932; here the module is
+    unchanged and masking is a pure transform in `actor_forward`)."""
 
     actions_dim: Tuple[int, ...]
     is_continuous: bool
@@ -599,6 +604,7 @@ class ActorSpec:
     max_std: float = 1.0
     unimix: float = 0.01
     action_clip: float = 1.0
+    mask_mode: str = "none"  # none | minedojo
 
 
 def _continuous_dist(pre_dist: jax.Array, spec: ActorSpec):
@@ -614,14 +620,60 @@ def _continuous_dist(pre_dist: jax.Array, spec: ActorSpec):
     return Independent(Normal(jnp.tanh(mean), std), 1), False
 
 
+# Finite stand-in for -inf on masked logits: softmax underflows it to an
+# exact 0 probability, but entropies/log-probs of the distribution stay
+# finite (torch's -inf would make entropy NaN on the masked support).
+_MASK_NEG = -1e9
+
+# MineDojo flattened functional-action ids (envs/minedojo.py ACTION_MAP;
+# reference MinedojoActor hardcodes the same ids, agent.py:905-925).
+_MINEDOJO_CRAFT = 15
+_MINEDOJO_EQUIP = 16
+_MINEDOJO_PLACE = 17
+_MINEDOJO_DESTROY = 18
+
+
+def _minedojo_mask_head(
+    i: int, logits: jax.Array, functional_action: Optional[jax.Array], mask: Dict[str, jax.Array]
+) -> jax.Array:
+    """Mask one MineDojo head's logits (vectorized analog of the reference's
+    per-(t,b) python loops, agent.py:903-925):
+
+    - head 0 (action type): invalid action ids are masked out always;
+    - head 1 (craft arg): masked by mask_craft_smelt only where head 0
+      sampled the craft action;
+    - head 2 (inventory arg): masked by mask_equip_place where head 0
+      sampled equip/place, by mask_destroy where it sampled destroy.
+    """
+
+    def valid(name: str) -> jax.Array:
+        return jnp.asarray(mask[name]) > 0.5
+
+    if i == 0:
+        return jnp.where(valid("mask_action_type"), logits, _MASK_NEG)
+    if i == 1:
+        craft = (functional_action == _MINEDOJO_CRAFT)[..., None]
+        return jnp.where(craft & ~valid("mask_craft_smelt"), _MASK_NEG, logits)
+    if i == 2:
+        equip_place = (
+            (functional_action == _MINEDOJO_EQUIP) | (functional_action == _MINEDOJO_PLACE)
+        )[..., None]
+        destroy = (functional_action == _MINEDOJO_DESTROY)[..., None]
+        logits = jnp.where(equip_place & ~valid("mask_equip_place"), _MASK_NEG, logits)
+        return jnp.where(destroy & ~valid("mask_destroy"), _MASK_NEG, logits)
+    return logits
+
+
 def actor_forward(
     pre_dist: List[jax.Array],
     spec: ActorSpec,
     key: Optional[jax.Array] = None,
     greedy: bool = False,
+    mask: Optional[Dict[str, jax.Array]] = None,
 ) -> Tuple[List[jax.Array], List[Any]]:
     """Turn head outputs into (sampled actions, distributions)
-    (reference: Actor.forward, agent.py:783-837)."""
+    (reference: Actor.forward, agent.py:783-837; with ``mask`` the MineDojo
+    masking of MinedojoActor.forward, agent.py:848-932)."""
     if spec.is_continuous:
         dist, tanh_transformed = _continuous_dist(pre_dist[0], spec)
         if not greedy:
@@ -641,11 +693,19 @@ def actor_forward(
         return [actions], [dist]
     dists = []
     actions = []
+    functional_action = None
     keys = jax.random.split(key, len(pre_dist)) if key is not None else [None] * len(pre_dist)
-    for logits, k in zip(pre_dist, keys):
-        d = OneHotCategoricalStraightThrough(logits=uniform_mix(logits, spec.unimix))
+    for i, (logits, k) in enumerate(zip(pre_dist, keys)):
+        logits = uniform_mix(logits, spec.unimix)
+        if mask is not None and spec.mask_mode == "minedojo":
+            logits = _minedojo_mask_head(i, logits, functional_action, mask)
+        d = OneHotCategoricalStraightThrough(logits=logits)
         dists.append(d)
         actions.append(d.mode if greedy else d.rsample(k))
+        if functional_action is None:
+            # Sequential head dependency: later heads are masked according to
+            # the action TYPE the first head actually sampled.
+            functional_action = jnp.argmax(actions[0], axis=-1)
     return actions, dists
 
 
@@ -756,7 +816,32 @@ class DV3Agent:
     ):
         """One acting step (reference: PlayerDV3.get_actions, agent.py:661-691):
         embed obs → GRU step with previous (z, a) → posterior → actor sample.
-        Returns (actions_cat, real_actions, new_state)."""
+        Returns (actions_cat, real_actions, new_state). With a mask-aware
+        actor (spec.mask_mode), the env-provided mask_* observations gate the
+        sampled actions (reference: dreamer_v3.py:574-577)."""
+        mask = None
+        if self.actor_spec.mask_mode != "none":
+            mask = {k: v for k, v in obs.items() if k.startswith("mask")} or None
+            if mask is None:
+                # Obs keys are static, so this fires at trace time, not per
+                # step: a mask-aware actor on an env without mask_* obs is a
+                # misconfiguration that would otherwise silently run unmasked.
+                import warnings
+
+                warnings.warn(
+                    f"algo.actor.cls={self.actor_spec.mask_mode!r} but the observations "
+                    f"carry no mask_* keys ({sorted(obs)}); actions will NOT be masked. "
+                    "Add the mask keys to algo.mlp_keys.encoder (see exp/dreamer_v3_minedojo.yaml)."
+                )
+            elif self.actor_spec.mask_mode == "minedojo":
+                required = {"mask_action_type", "mask_craft_smelt", "mask_equip_place", "mask_destroy"}
+                missing = required - set(mask)
+                if missing:
+                    raise ValueError(
+                        f"algo.actor.cls=minedojo needs all of {sorted(required)} in the "
+                        f"observations; missing {sorted(missing)} — add them to "
+                        "algo.mlp_keys.encoder (see exp/dreamer_v3_minedojo.yaml)."
+                    )
         k1, k2 = jax.random.split(key)
         embedded = self.wm(wm_params, obs, method="embed_obs")
         recurrent_state = self.world_model.apply(
@@ -770,7 +855,7 @@ class DV3Agent:
         )
         latent = jnp.concatenate([stochastic_state, recurrent_state], -1)
         pre_dist = self.actor.apply(actor_params, latent)
-        actions, _ = actor_forward(pre_dist, self.actor_spec, k2, greedy)
+        actions, _ = actor_forward(pre_dist, self.actor_spec, k2, greedy, mask=mask)
         actions_cat = jnp.concatenate(actions, -1)
         if self.is_continuous:
             real_actions = actions_cat
@@ -833,6 +918,9 @@ def build_agent(
         output_kernel_init=uniform_init(0.0),
         dtype=dtype,
     )
+    actor_cls = str(cfg.algo.actor.get("cls", "default") or "default").lower()
+    if actor_cls not in ("default", "minedojo"):
+        raise ValueError(f"algo.actor.cls must be one of default|minedojo, got {actor_cls!r}")
     spec = ActorSpec(
         actions_dim=tuple(int(d) for d in actions_dim),
         is_continuous=is_continuous,
@@ -842,6 +930,7 @@ def build_agent(
         max_std=cfg.algo.actor.get("max_std", 1.0),
         unimix=cfg.algo.unimix,
         action_clip=cfg.algo.actor.action_clip,
+        mask_mode="minedojo" if actor_cls == "minedojo" else "none",
     )
     agent = DV3Agent(
         world_model=wm,

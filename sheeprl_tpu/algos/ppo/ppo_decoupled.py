@@ -45,10 +45,9 @@ from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
 
 @register_algorithm(decoupled=True)
 def main(runtime, cfg: Dict[str, Any]):
-    player_device, trainer_mesh = split_player_trainer(
-        runtime.mesh, cfg.fabric.get("player_device", "auto") or "auto"
-    )
-    n_trainers = int(trainer_mesh.shape[DATA_AXIS])
+    # The player/trainer split happens after the agent is built, so the
+    # auto placement's AUTO_MAX_PARAM_BYTES guard sees the real agent size.
+    player_mode = cfg.fabric.get("player_device", "auto") or "auto"
     rank = runtime.global_rank
 
     initial_ent_coef = float(cfg.algo.ent_coef)
@@ -63,7 +62,6 @@ def main(runtime, cfg: Dict[str, Any]):
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
     runtime.print(f"Log dir: {log_dir}")
-    runtime.print(f"Decoupled PPO: player on {player_device}, {n_trainers} trainer device(s)")
 
     # ----------------------------------------------------------------- envs
     vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
@@ -126,6 +124,12 @@ def main(runtime, cfg: Dict[str, Any]):
 
         # Trainer copy on the trainer mesh, player copy on the player device
         # (the reference's "first weights" broadcast, ppo_decoupled.py:124-127).
+    # Split now that the player-visible params exist: auto applies its size
+    # guard (an oversized agent stays on-mesh rather than paying a packed
+    # host transfer after every update).
+    player_device, trainer_mesh = split_player_trainer(runtime.mesh, player_mode, params=params)
+    n_trainers = int(trainer_mesh.shape[DATA_AXIS])
+    runtime.print(f"Decoupled PPO: player on {player_device}, {n_trainers} trainer device(s)")
     params = mesh_lib.replicate(params, trainer_mesh)
     opt_state = mesh_lib.replicate(opt_state, trainer_mesh)
     # Trainer->player weight broadcast as a packed single-transfer mirror
@@ -333,15 +337,19 @@ def main(runtime, cfg: Dict[str, Any]):
             aggregator.update("Loss/entropy_loss", tm["entropy_loss"])
 
         # ------------------------------------------------------- logging
+        should_log = cfg.metric.log_level > 0 and (
+            policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
+        )
+        if should_log and aggregator and not aggregator.disabled:
+            # Collective when sync_on_compute is on: every rank joins;
+            # only rank 0 (the only rank with a logger) writes.
+            aggregator.log_and_reset(logger, policy_step)
         if cfg.metric.log_level > 0 and logger is not None:
             logger.log("Info/learning_rate", _current_lr(opt_state, base_lr), policy_step)
             logger.log("Info/clip_coef", cfg.algo.clip_coef, policy_step)
             logger.log("Info/ent_coef", cfg.algo.ent_coef, policy_step)
 
-            if policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters:
-                if aggregator and not aggregator.disabled:
-                    logger.log_dict(aggregator.compute(), policy_step)
-                    aggregator.reset()
+            if should_log:
                 if not timer.disabled:
                     timer_metrics = timer.compute()
                     if timer_metrics.get("Time/train_time", 0) > 0:
@@ -358,8 +366,9 @@ def main(runtime, cfg: Dict[str, Any]):
                             policy_step,
                         )
                     timer.reset()
-                last_log = policy_step
-                last_train = train_step_count
+        if should_log:
+            last_log = policy_step
+            last_train = train_step_count
 
         # ----------------------------------------------------- annealing
         if cfg.algo.anneal_lr:

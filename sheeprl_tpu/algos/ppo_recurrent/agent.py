@@ -184,9 +184,12 @@ class RecurrentPPOAgent:
         key: jax.Array,
     ):
         """One env step = a length-1 sequence: (actions_cat, real_actions,
-        logprobs[B,1], values[B,1], new_carry). Obs normalization happens
-        in-graph (prepare_obs hands raw numpy)."""
+        logprobs[B,1], values[B,1], new_carry, next_key). Obs normalization
+        and the PRNG split happen in-graph (cf. ppo/agent.py player_step) so
+        one jitted call is the step's only dispatch — no per-step host
+        round trip when the player lives on a remote mesh device."""
         obs = normalize_obs(obs, self.cnn_keys, list(obs.keys()))
+        next_key, key = jax.random.split(key)
         obs = {k: v[None] for k, v in obs.items()}
         zeros = jnp.zeros((1, prev_actions.shape[0], 1), jnp.float32)
         actor_out, values, carry = self.module.apply(params, obs, prev_actions[None], carry, zeros)
@@ -202,7 +205,7 @@ class RecurrentPPOAgent:
                 actions = tanh_actions
             else:
                 logprob = dist.log_prob(actions)
-            return actions, actions, logprob[..., None], values, carry
+            return actions, actions, logprob[..., None], values, carry, next_key
         actions = []
         real_actions = []
         logprobs = []
@@ -219,6 +222,7 @@ class RecurrentPPOAgent:
             jnp.stack(logprobs, -1).sum(-1, keepdims=True),
             values,
             carry,
+            next_key,
         )
 
     def get_values(self, params: Any, obs: Dict[str, jax.Array], prev_actions: jax.Array, carry) -> jax.Array:

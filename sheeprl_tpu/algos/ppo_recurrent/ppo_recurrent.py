@@ -296,10 +296,9 @@ def main(runtime, cfg: Dict[str, Any]):
             with timer("Time/env_interaction_time"):
                 with placement.ctx():
                     jnp_obs = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
-                    rollout_key, sub = jax.random.split(rollout_key)
                     prev_carry = carry
-                    actions_j, real_actions_j, logprobs_j, values_j, carry = player_step_fn(
-                        placement.params(), jnp_obs, jnp.asarray(prev_actions), carry, sub
+                    actions_j, real_actions_j, logprobs_j, values_j, carry, rollout_key = player_step_fn(
+                        placement.params(), jnp_obs, jnp.asarray(prev_actions), carry, rollout_key
                     )
                 # Single host fetch for the step outputs AND the pre-step
                 # carry snapshot the buffer stores (the post-step carry stays
@@ -446,15 +445,19 @@ def main(runtime, cfg: Dict[str, Any]):
             aggregator.update("Loss/entropy_loss", tm["entropy_loss"])
 
         # ------------------------------------------------------- logging
+        should_log = cfg.metric.log_level > 0 and (
+            policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
+        )
+        if should_log and aggregator and not aggregator.disabled:
+            # Collective when sync_on_compute is on: every rank joins;
+            # only rank 0 (the only rank with a logger) writes.
+            aggregator.log_and_reset(logger, policy_step)
         if cfg.metric.log_level > 0 and logger is not None:
             logger.log("Info/learning_rate", _current_lr(opt_state, base_lr), policy_step)
             logger.log("Info/clip_coef", cfg.algo.clip_coef, policy_step)
             logger.log("Info/ent_coef", cfg.algo.ent_coef, policy_step)
 
-            if policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters:
-                if aggregator and not aggregator.disabled:
-                    logger.log_dict(aggregator.compute(), policy_step)
-                    aggregator.reset()
+            if should_log:
                 if not timer.disabled:
                     timer_metrics = timer.compute()
                     if timer_metrics.get("Time/train_time", 0) > 0:
@@ -471,8 +474,9 @@ def main(runtime, cfg: Dict[str, Any]):
                             policy_step,
                         )
                     timer.reset()
-                last_log = policy_step
-                last_train = train_step_count
+        if should_log:
+            last_log = policy_step
+            last_train = train_step_count
 
         # ----------------------------------------------------- annealing
         if cfg.algo.anneal_lr:

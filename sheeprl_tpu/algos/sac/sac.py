@@ -356,12 +356,14 @@ def main(runtime, cfg: Dict[str, Any]):
                     aggregator.update("Loss/policy_loss", tm["policy_loss"])
                     aggregator.update("Loss/alpha_loss", tm["alpha_loss"])
 
-        if cfg.metric.log_level > 0 and logger is not None and (
+        should_log = cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
-        ):
-            if aggregator and not aggregator.disabled:
-                logger.log_dict(aggregator.compute(), policy_step)
-                aggregator.reset()
+        )
+        if should_log and aggregator and not aggregator.disabled:
+            # Collective when sync_on_compute is on: every rank joins;
+            # only rank 0 (the only rank with a logger) writes.
+            aggregator.log_and_reset(logger, policy_step)
+        if should_log and logger is not None:
             logger.log(
                 "Params/replay_ratio", cumulative_per_rank_gradient_steps * world_size / policy_step, policy_step
             )
@@ -381,6 +383,7 @@ def main(runtime, cfg: Dict[str, Any]):
                         policy_step,
                     )
                 timer.reset()
+        if should_log:
             last_log = policy_step
             last_train = train_step_count
 

@@ -54,10 +54,9 @@ from sheeprl_tpu.utils.utils import Ratio, save_configs
 
 @register_algorithm(decoupled=True)
 def main(runtime, cfg: Dict[str, Any]):
-    player_device, trainer_mesh = split_player_trainer(
-        runtime.mesh, cfg.fabric.get("player_device", "auto") or "auto"
-    )
-    n_trainers = int(trainer_mesh.shape[DATA_AXIS])
+    # The player/trainer split happens after the agent is built, so the
+    # auto placement's AUTO_MAX_PARAM_BYTES guard sees the real actor size.
+    player_mode = cfg.fabric.get("player_device", "auto") or "auto"
     rank = runtime.global_rank
 
     state_ckpt = None
@@ -73,7 +72,6 @@ def main(runtime, cfg: Dict[str, Any]):
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
     runtime.print(f"Log dir: {log_dir}")
-    runtime.print(f"Decoupled SAC: player on {player_device}, {n_trainers} trainer device(s)")
 
     # ------------------------------------------------------------ environment
     vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
@@ -136,6 +134,14 @@ def main(runtime, cfg: Dict[str, Any]):
         # Trainer state lives replicated on the trainer mesh; the player keeps its
         # own committed copy of the actor params on the player device (the
         # "first weights" broadcast of the reference, sac_decoupled.py:227-230).
+    # Split now that the player-visible actor exists: auto applies its size
+    # guard (an oversized actor stays on-mesh rather than paying a packed
+    # host transfer after every update).
+    player_device, trainer_mesh = split_player_trainer(
+        runtime.mesh, player_mode, params=agent_state["actor"]
+    )
+    n_trainers = int(trainer_mesh.shape[DATA_AXIS])
+    runtime.print(f"Decoupled SAC: player on {player_device}, {n_trainers} trainer device(s)")
     agent_state = mesh_lib.replicate(agent_state, trainer_mesh)
     opt_states = mesh_lib.replicate(opt_states, trainer_mesh)
     # The trainer->player weight broadcast as a packed single-transfer mirror
@@ -321,12 +327,14 @@ def main(runtime, cfg: Dict[str, Any]):
                 train_step_count += n_trainers
 
         # ------------------------------------------------------------ logging
-        if cfg.metric.log_level > 0 and logger is not None and (
+        should_log = cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
-        ):
-            if aggregator and not aggregator.disabled:
-                logger.log_dict(aggregator.compute(), policy_step)
-                aggregator.reset()
+        )
+        if should_log and aggregator and not aggregator.disabled:
+            # Collective when sync_on_compute is on: every rank joins;
+            # only rank 0 (the only rank with a logger) writes.
+            aggregator.log_and_reset(logger, policy_step)
+        if should_log and logger is not None:
             if policy_step > 0:
                 logger.log(
                     "Params/replay_ratio",
@@ -349,6 +357,7 @@ def main(runtime, cfg: Dict[str, Any]):
                         policy_step,
                     )
                 timer.reset()
+        if should_log:
             last_log = policy_step
             last_train = train_step_count
 

@@ -50,7 +50,7 @@ def build_mesh(
     return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
 
 
-def split_player_trainer(mesh: Mesh, player_mode: str = "mesh") -> tuple:
+def split_player_trainer(mesh: Mesh, player_mode: str = "mesh", params: Any = None) -> tuple:
     """Partition a mesh's devices into (player device, trainer mesh).
 
     The substrate for decoupled player/trainer algorithms — the analog of the
@@ -65,6 +65,12 @@ def split_player_trainer(mesh: Mesh, player_mode: str = "mesh") -> tuple:
       player runs on the host CPU backend and the trainer mesh keeps EVERY
       accelerator — decoupled training then works on a single chip, with no
       device sacrificed to latency-bound inference.
+
+    ``params`` is the player-visible parameter tree (or None before it
+    exists): ``auto`` refuses the host placement for actors above
+    AUTO_MAX_PARAM_BYTES, whose packed post-update transfers would dominate.
+    Callers that split before building the agent should re-split once the
+    params exist.
     """
     if int(mesh.shape[MODEL_AXIS]) > 1:
         raise RuntimeError(
@@ -75,7 +81,7 @@ def split_player_trainer(mesh: Mesh, player_mode: str = "mesh") -> tuple:
 
     mesh_dev = mesh.devices.flat[0]
     player_mode = str(player_mode).lower()
-    player = resolve_player_device(player_mode, mesh_dev)
+    player = resolve_player_device(player_mode, mesh_dev, params=params)
     if player.platform == "cpu" and (player_mode == "host" or mesh_dev.platform != "cpu"):
         return player, mesh
     devices = list(mesh.devices.flat)

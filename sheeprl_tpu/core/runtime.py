@@ -31,6 +31,36 @@ from sheeprl_tpu.core.prng import seed_everything
 _TPU_PLATFORMS = ("tpu", "axon")
 
 
+def user_compilation_cache_dir() -> Optional[str]:
+    """Per-user XLA compile-cache path, or None if it cannot be secured.
+
+    Under the user's own cache root (XDG), never a world-shared /tmp path:
+    a predictable shared directory would let another local user pre-create
+    it and plant poisoned serialized executables (CWE-379). Created 0700;
+    rejected if it exists but is not owned by us.
+    """
+    import warnings
+
+    xdg = os.environ.get("XDG_CACHE_HOME") or os.path.join(os.path.expanduser("~"), ".cache")
+    cache_dir = os.path.join(xdg, "sheeprl_tpu", "jax")
+    try:
+        os.makedirs(cache_dir, mode=0o700, exist_ok=True)
+        if hasattr(os, "getuid") and os.stat(cache_dir).st_uid != os.getuid():
+            warnings.warn(
+                f"{cache_dir} is not owned by this user; persistent XLA compile cache "
+                "DISABLED (every run recompiles). Set XDG_CACHE_HOME or "
+                "JAX_COMPILATION_CACHE_DIR to a directory you own."
+            )
+            return None
+    except OSError as e:
+        warnings.warn(
+            f"Cannot create {cache_dir} ({e}); persistent XLA compile cache DISABLED "
+            "(every run recompiles). Set XDG_CACHE_HOME or JAX_COMPILATION_CACHE_DIR."
+        )
+        return None
+    return cache_dir
+
+
 class Runtime:
     def __init__(
         self,
@@ -84,15 +114,9 @@ class Runtime:
         # restarts and repeated short runs cheap. Opt out by pointing
         # JAX_COMPILATION_CACHE_DIR at "" or your own location.
         if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
-            import getpass
-            import tempfile
-
-            try:
-                user = getpass.getuser()
-            except Exception:
-                user = str(os.getuid()) if hasattr(os, "getuid") else "default"
-            cache_dir = os.path.join(tempfile.gettempdir(), f"sheeprl_tpu_jax_cache_{user}")
-            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            cache_dir = user_compilation_cache_dir()
+            if cache_dir is not None:
+                jax.config.update("jax_compilation_cache_dir", cache_dir)
             if "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS" not in os.environ:
                 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         self._mesh = mesh_lib.build_mesh(

@@ -4,16 +4,22 @@ API parity with the reference's torchmetrics-backed aggregator
 (sheeprl/utils/metric.py:17-196) without the torch dependency: metrics are
 tiny host accumulators updated with numbers/arrays (jax.Array values are
 pulled to host — call sites pass already-computed scalars, so this never
-forces a device sync inside a hot loop). `sync_on_compute` is accepted for
-config parity; cross-process reduction is the caller's concern (single-host
-runs dominate on TPU, and multi-host metric sync happens through the logger).
+forces a device sync inside a hot loop).
+
+`sync_on_compute` has the reference's torchmetrics semantics: when True and
+more than one process is running, `compute()` first all-gathers each
+metric's accumulator state over DCN (`multihost_utils.process_allgather`)
+and reduces across ranks — MeanMetric returns the global mean (sum of sums
+over sum of counts), Sum the global sum, Max/Min the global extrema. Like
+torchmetrics' sync, this is a COLLECTIVE: every process must call compute()
+on the same metrics in the same order.
 """
 
 from __future__ import annotations
 
 import warnings
 from math import isnan
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -43,6 +49,38 @@ class Metric:
         arr = np.asarray(value, dtype=np.float64)
         return float(arr.mean()) if arr.ndim > 0 else float(arr)
 
+    # -- cross-rank reduction protocol -----------------------------------
+    # `_state()` exposes the accumulator as a flat float tuple; `_reduce()`
+    # folds one such tuple per rank into the final value. compute() is
+    # written in terms of these so MetricAggregator can gather EVERY
+    # metric's state in one batched DCN all-gather instead of one
+    # collective per metric.
+    def _state(self) -> Tuple[float, ...]:
+        raise NotImplementedError
+
+    def _reduce(self, states: List[Tuple[float, ...]]) -> float:
+        raise NotImplementedError
+
+    def _all_states(self) -> List[Tuple[float, ...]]:
+        """Per-rank accumulator states: `[self._state()]` alone when sync is
+        off or the run is single-process, otherwise one tuple per process
+        from a DCN all-gather (the reference's torchmetrics dist-sync
+        analog)."""
+        state = self._state()
+        if not self.sync_on_compute:
+            return [state]
+        import jax
+
+        if jax.process_count() <= 1:
+            return [state]
+        from jax.experimental import multihost_utils
+
+        gathered = multihost_utils.process_allgather(np.asarray(state, np.float64))
+        return [tuple(row) for row in np.asarray(gathered).reshape(jax.process_count(), -1)]
+
+    def compute(self) -> float:
+        return self._reduce(self._all_states())
+
 
 class MeanMetric(Metric):
     def update(self, value: Any) -> None:
@@ -50,8 +88,13 @@ class MeanMetric(Metric):
         self._sum += float(arr.sum())
         self._count += arr.size
 
-    def compute(self) -> float:
-        return self._sum / self._count if self._count else float("nan")
+    def _state(self) -> Tuple[float, ...]:
+        return (self._sum, float(self._count))
+
+    def _reduce(self, states: List[Tuple[float, ...]]) -> float:
+        total = sum(s for s, _ in states)
+        count = sum(c for _, c in states)
+        return total / count if count else float("nan")
 
     def reset(self) -> None:
         self._sum = 0.0
@@ -62,8 +105,11 @@ class SumMetric(Metric):
     def update(self, value: Any) -> None:
         self._sum += float(np.asarray(value, dtype=np.float64).sum())
 
-    def compute(self) -> float:
-        return self._sum
+    def _state(self) -> Tuple[float, ...]:
+        return (self._sum,)
+
+    def _reduce(self, states: List[Tuple[float, ...]]) -> float:
+        return sum(s for (s,) in states)
 
     def reset(self) -> None:
         self._sum = 0.0
@@ -73,8 +119,11 @@ class MaxMetric(Metric):
     def update(self, value: Any) -> None:
         self._max = max(self._max, float(np.asarray(value, dtype=np.float64).max()))
 
-    def compute(self) -> float:
-        return self._max
+    def _state(self) -> Tuple[float, ...]:
+        return (self._max,)
+
+    def _reduce(self, states: List[Tuple[float, ...]]) -> float:
+        return max(m for (m,) in states)
 
     def reset(self) -> None:
         self._max = float("-inf")
@@ -84,8 +133,11 @@ class MinMetric(Metric):
     def update(self, value: Any) -> None:
         self._min = min(self._min, float(np.asarray(value, dtype=np.float64).min()))
 
-    def compute(self) -> float:
-        return self._min
+    def _state(self) -> Tuple[float, ...]:
+        return (self._min,)
+
+    def _reduce(self, states: List[Tuple[float, ...]]) -> float:
+        return min(m for (m,) in states)
 
     def reset(self) -> None:
         self._min = float("inf")
@@ -95,8 +147,13 @@ class LastMetric(Metric):
     def update(self, value: Any) -> None:
         self._last = self._to_float(value)
 
-    def compute(self) -> float:
-        return self._last
+    def _state(self) -> Tuple[float, ...]:
+        return (self._last,)
+
+    def _reduce(self, states: List[Tuple[float, ...]]) -> float:
+        # Cross-rank reduction: mean of the ranks that observed a value.
+        lasts = [v for (v,) in states if not isnan(v)]
+        return float(np.mean(lasts)) if lasts else float("nan")
 
     def reset(self) -> None:
         self._last = float("nan")
@@ -162,15 +219,47 @@ class MetricAggregator:
         return self
 
     def compute(self) -> Dict[str, float]:
+        """Reduced values of every metric, NaN results dropped.
+
+        When any metric has sync_on_compute in a multi-process run this is a
+        COLLECTIVE — every rank must call it at the same point — but the
+        whole aggregator costs ONE batched DCN all-gather, not one per
+        metric."""
         reduced: Dict[str, float] = {}
         if self.disabled:
             return reduced
+        synced_rows: Dict[str, List[Tuple[float, ...]]] = {}
+        synced = {k: m for k, m in self.metrics.items() if m.sync_on_compute}
+        if synced:
+            import jax
+
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils
+
+                states = {k: np.asarray(m._state(), np.float64) for k, m in synced.items()}
+                gathered = multihost_utils.process_allgather(states)
+                n = jax.process_count()
+                synced_rows = {
+                    k: [tuple(row) for row in np.asarray(v).reshape(n, -1)]
+                    for k, v in gathered.items()
+                }
         for k, v in self.metrics.items():
-            value = v.compute()
+            value = v._reduce(synced_rows[k]) if k in synced_rows else v._reduce([v._state()])
             if isinstance(value, float) and isnan(value):
                 continue
             reduced[k] = value
         return reduced
+
+    def log_and_reset(self, logger, step: int) -> Dict[str, float]:
+        """The per-iteration logging contract every algorithm shares:
+        compute (a collective when sync_on_compute is on — EVERY rank calls
+        this, which is exactly why the helper exists), reset, and write the
+        reduced values through the rank-0 logger if there is one."""
+        computed = self.compute()
+        self.reset()
+        if logger is not None:
+            logger.log_dict(computed, step)
+        return computed
 
 
 class RankIndependentMetricAggregator:

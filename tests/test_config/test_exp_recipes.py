@@ -1,0 +1,43 @@
+"""Every shipped exp recipe must compose (VERDICT round 2, missing item 2).
+
+The reference's 43 exp overlays are its experiment contract; this test
+composes each of ours through config/loader.py so a recipe that references a
+dead key, a missing group option, or a broken interpolation fails the suite
+rather than the user's run. Mandatory ``???`` leaves (e.g. the p2e finetuning
+exploration_ckpt_path) are allowed to remain — composition must still
+succeed; they are enforced at check_configs/run time.
+"""
+
+import glob
+import os
+
+import pytest
+
+from sheeprl_tpu.config.loader import compose, default_config_dir
+
+EXP_DIR = os.path.join(default_config_dir(), "exp")
+ALL_EXPS = sorted(
+    os.path.splitext(os.path.basename(p))[0] for p in glob.glob(os.path.join(EXP_DIR, "*.yaml"))
+)
+
+# The five BASELINE.md driver workloads must always be present.
+DRIVER_EXPS = {
+    "ppo",
+    "sac_decoupled",
+    "a2c",
+    "dreamer_v3_100k_ms_pacman",
+    "dreamer_v3_XL_crafter",
+}
+
+
+def test_driver_recipes_present():
+    missing = DRIVER_EXPS - set(ALL_EXPS)
+    assert not missing, f"BASELINE driver recipes missing from configs/exp: {missing}"
+
+
+@pytest.mark.parametrize("exp", ALL_EXPS)
+def test_exp_composes(exp):
+    cfg = compose("config", [f"exp={exp}"])
+    assert cfg.algo.name or exp == "default", f"exp={exp} composed without algo.name"
+    # Interpolations resolved and the core groups merged.
+    assert "env" in cfg and "fabric" in cfg and "buffer" in cfg

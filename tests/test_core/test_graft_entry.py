@@ -1,0 +1,29 @@
+"""Guard the driver contract in __graft_entry__.py.
+
+Round 2 shipped with the multichip dryrun broken because a train-step return
+signature changed without updating the dryrun's unpack (VERDICT round 2, weak
+item 1). This test imports the module and runs both `entry()` and
+`dryrun_multichip` on the virtual CPU mesh so any future signature drift fails
+the suite, not the driver.
+"""
+
+import os
+import sys
+
+import jax
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import __graft_entry__  # noqa: E402
+
+
+def test_entry_compiles_and_runs():
+    fn, args = __graft_entry__.entry()
+    out = jax.jit(fn)(*args)
+    jax.block_until_ready(out)
+
+
+@pytest.mark.parametrize("n_devices", [2, 8])
+def test_dryrun_multichip(n_devices):
+    __graft_entry__.dryrun_multichip(n_devices)

@@ -44,6 +44,22 @@ from sheeprl_tpu.utils.logger import _broadcast_str
 
 s = _broadcast_str("run-dir-from-rank0" if proc_id == 0 else "")
 assert s == "run-dir-from-rank0", s
+
+# --- sync_on_compute cross-rank metric reduction (utils/metric.py)
+from sheeprl_tpu.utils.metric import MaxMetric, MeanMetric, SumMetric
+
+mean = MeanMetric(sync_on_compute=True)
+mean.update([1.0, 2.0] if proc_id == 0 else [6.0])  # global mean = 9/3
+assert abs(mean.compute() - 3.0) < 1e-9, mean.compute()
+local_mean = MeanMetric(sync_on_compute=False)
+local_mean.update([1.0, 2.0] if proc_id == 0 else [6.0])
+assert abs(local_mean.compute() - (1.5 if proc_id == 0 else 6.0)) < 1e-9
+total = SumMetric(sync_on_compute=True)
+total.update(float(proc_id + 1))
+assert abs(total.compute() - 3.0) < 1e-9, total.compute()
+peak = MaxMetric(sync_on_compute=True)
+peak.update(float(proc_id))
+assert peak.compute() == 1.0, peak.compute()
 print(f"proc {proc_id} OK")
 '''
 
