@@ -27,8 +27,11 @@ seconds of the reference's wall-clock (it runs at ~10k fps), so the
 comparison stays dominated by what the benchmark measures: the
 world-model/actor/critic training step and the per-step policy latency.
 
-Workloads: `python bench.py [dreamer_v3|dreamer_v2|dreamer_v1|ppo|a2c|sac]`.
-Reference baselines from BASELINE.md (README.md:83-180).
+Workloads:
+`python bench.py [dreamer_v3|dreamer_v3_S|dreamer_v2|dreamer_v1|ppo|a2c|sac]`.
+Reference baselines from BASELINE.md (README.md:83-180); `dreamer_v3_S` is
+the north-star-scale workload (S model at the Atari-100K recipe shape) vs
+the RTX 3080's ~1.98 env-steps/s.
 """
 
 import json
@@ -37,9 +40,39 @@ import sys
 import time
 
 
-def _setup_jax():
+def _accelerator_reachable(timeout_s: float = 90.0) -> bool:
+    """Probe jax.devices() in a SUBPROCESS with a deadline: a wedged
+    accelerator plugin (e.g. a dead tunnel relay) hangs backend discovery
+    in-process with no way to cancel it — the probe turns that into a
+    clean False so the bench falls back to CPU instead of hanging the
+    driver."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        return out.returncode == 0 and b"ok" in out.stdout
+    except Exception:
+        return False
+
+
+def _setup_jax(platform=None):
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import jax
+
+    if platform is not None:
+        # Force the platform via config + clear_backends (the env-var-only
+        # path still runs the preinstalled accelerator plugin's discovery,
+        # which can stall if its backend is unreachable — the explicit
+        # rebuild honors the selection strictly; same dance as
+        # tests/conftest.py).
+        jax.config.update("jax_platforms", platform)
+        from jax.extend import backend as _jeb
+
+        _jeb.clear_backends()
 
     # Persistent compile cache: the warmup run's XLA executables are disk-cache
     # hits in the measured run, so timing excludes compilation. Same per-user
@@ -113,12 +146,13 @@ def _timeboxed(
         "unit": "env-steps/sec",
         "vs_baseline": round(sps / baseline_sps, 3),
     }
-    # Report the weight-mirror semantics the number was measured under, so
-    # async (stale-weights) numbers are never mistaken for the reference's
-    # tied-weights coupled-loop semantics.
+    # Report the runtime semantics the number was measured under (mirror
+    # sync mode, precision), so async/stale-weights or bf16 numbers are
+    # never mistaken for tied-weights f32 ones.
     for ov in extra:
-        if ov.startswith("fabric.player_sync="):
-            result["player_sync"] = ov.split("=", 1)[1]
+        if ov.startswith("fabric."):
+            k, v = ov.split("=", 1)
+            result[k.split(".", 1)[1]] = v
     return result
 
 
@@ -151,14 +185,16 @@ def bench_sac():
 
 
 def _bench_dreamer(version: str, baseline_seconds: float):
-    # Off-policy: async weight mirror (see bench_sac).
+    # Off-policy: async weight mirror (see bench_sac). Precision is passed
+    # explicitly (it matches the benchmark exp default) so the result JSON
+    # records the semantics the number was measured under.
     return _timeboxed(
         f"dreamer_v{version}_env_steps_per_sec",
         f"dreamer_v{version}_benchmarks",
         16384,
         16384 / baseline_seconds,
         learning_starts=1024,
-        extra=("fabric.player_sync=async",),
+        extra=("fabric.player_sync=async", "fabric.precision=bf16-mixed"),
     )
 
 
@@ -174,20 +210,65 @@ def bench_dreamer_v3():
     return _bench_dreamer("3", 1589.30)  # README.md:168-176
 
 
+def bench_dreamer_v3_S():
+    # North-star scale (BASELINE.md): DreamerV3-S at the Atari-100K recipe —
+    # S model, batch 16 x sequence 64, replay_ratio 1 — vs the RTX 3080's
+    # 100K frames in 14 h (README.md:44-51) = 1.98 env-steps/s. ALE is not
+    # installed in this image, so the deterministic dummy pixel env stands in
+    # for MsPacman (documented divergence: the emulator costs the reference
+    # only a few seconds; the number is dominated by the S-size train step
+    # and per-step policy latency). buffer.size capped host-side (RAM);
+    # steady-state throughput is unaffected and the differencing cancels it.
+    return _timeboxed(
+        "dreamer_v3_S_env_steps_per_sec",
+        "dreamer_v3_100k_ms_pacman",
+        100000,
+        100000 / (14 * 3600),
+        learning_starts=1024,
+        warmup_steps=1280,
+        start_steps=1536,
+        extra=(
+            "env=dummy",
+            "env.id=discrete",
+            "env.capture_video=False",
+            "env.sync_env=True",
+            "buffer.size=20000",
+            "buffer.memmap=False",
+            "buffer.prefetch=True",
+            "fabric.player_sync=async",
+            "fabric.precision=bf16-mixed",
+            "metric.log_level=0",
+            "metric.disable_timer=True",
+        ),
+    )
+
+
 def main() -> None:
-    _setup_jax()
+    which = sys.argv[1] if len(sys.argv) > 1 else "dreamer_v3"
+    # PPO/A2C/SAC are the reference's 4-CPU workloads and pin
+    # fabric.accelerator=cpu in their exp configs; select the CPU platform
+    # outright so the accelerator plugin is never initialized for them.
+    # Accelerator workloads probe the device first and fall back to CPU
+    # (recorded in the output) rather than hang on a wedged plugin.
+    if which in ("ppo", "a2c", "sac"):
+        platform = "cpu"
+    else:
+        platform = None if _accelerator_reachable() else "cpu"
+    _setup_jax(platform)
+    import jax
     import sheeprl_tpu
 
     sheeprl_tpu.register_all()
-    which = sys.argv[1] if len(sys.argv) > 1 else "dreamer_v3"
     result = {
         "dreamer_v3": bench_dreamer_v3,
+        "dreamer_v3_S": bench_dreamer_v3_S,
         "dreamer_v2": bench_dreamer_v2,
         "dreamer_v1": bench_dreamer_v1,
         "ppo": bench_ppo,
         "a2c": bench_a2c,
         "sac": bench_sac,
     }[which]()
+    result["backend"] = jax.default_backend()
     print(json.dumps(result))
 
 

@@ -1,0 +1,16 @@
+#!/bin/sh
+# Refresh every bench number sequentially (each run owns the chip + the
+# single host core; concurrency would corrupt the measurements).
+# Usage: sh scripts/run_all_benches.sh [out_file]
+out="${1:-BENCH_ALL.jsonl}"
+: > "$out"
+for w in ppo a2c sac dreamer_v1 dreamer_v2 dreamer_v3 dreamer_v3_S; do
+    echo "=== $w ===" >&2
+    line=$(python bench.py "$w" 2>"/tmp/bench_$w.err" | tail -1)
+    if [ -n "$line" ]; then
+        echo "$line" | tee -a "$out"
+    else
+        echo "WARNING: $w produced no result — stderr:" >&2
+        tail -5 "/tmp/bench_$w.err" >&2
+    fi
+done

@@ -32,6 +32,7 @@ from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.core.mesh import DATA_AXIS
 from sheeprl_tpu.core.player import PlayerPlacement
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.core.runtime import DispatchThrottle
 from sheeprl_tpu.registry import register_algorithm
 from sheeprl_tpu.utils.checkpoint import load_checkpoint, restore_opt_state, save_checkpoint
 from sheeprl_tpu.utils.env import make_env
@@ -279,6 +280,9 @@ def main(runtime, cfg: Dict[str, Any]):
     obs = envs.reset(seed=cfg.seed)[0]
 
     cumulative_per_rank_gradient_steps = 0
+    # Bound async in-flight train dispatches (core/runtime.py: an
+    # unbounded queue pins every pending call's sampled batch on host).
+    dispatch_throttle = DispatchThrottle()
     for iter_num in range(start_iter, total_iters + 1):
         policy_step += policy_steps_per_iter
 
@@ -353,6 +357,7 @@ def main(runtime, cfg: Dict[str, Any]):
                     agent_state, opt_states, train_metrics, train_key = train_fn(
                         agent_state, opt_states, critic_data, actor_data, train_key
                     )
+                    dispatch_throttle.add(train_metrics)
                     # Block only when the train timer needs an accurate stop;
                     # with metrics off the dispatch stays fully async, so the
                     # H2D infeed + train overlap the next env steps.

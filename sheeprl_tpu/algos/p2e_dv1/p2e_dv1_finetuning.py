@@ -28,6 +28,7 @@ from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.core.player import PlayerPlacement
 from sheeprl_tpu.data.infeed import ReplayInfeed
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.core.runtime import DispatchThrottle
 from sheeprl_tpu.registry import register_algorithm
 from sheeprl_tpu.utils.checkpoint import load_checkpoint, restore_opt_state, save_checkpoint
 from sheeprl_tpu.utils.env import make_env
@@ -255,6 +256,9 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
         player_state = init_player_fn(placement.params()["world_model"], cfg.env.num_envs)
 
     cumulative_per_rank_gradient_steps = 0
+    # Bound async in-flight train dispatches (core/runtime.py: an
+    # unbounded queue pins every pending call's sampled batch on host).
+    dispatch_throttle = DispatchThrottle()
     for iter_num in range(start_iter, total_iters + 1):
         policy_step += policy_steps_per_iter
 
@@ -349,6 +353,7 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
                             agent_state, opt_states, batch, train_key
                         )
                         per_step_metrics.append(train_metrics)
+                        dispatch_throttle.add(train_metrics)
                         cumulative_per_rank_gradient_steps += 1
                     # Block only when the train timer needs an accurate stop;
                     # with metrics off the dispatch stays fully async, so the

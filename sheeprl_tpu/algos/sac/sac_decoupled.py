@@ -43,6 +43,7 @@ from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.core import mesh as mesh_lib
 from sheeprl_tpu.core.mesh import DATA_AXIS, split_player_trainer
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.core.runtime import DispatchThrottle
 from sheeprl_tpu.registry import register_algorithm
 from sheeprl_tpu.utils.checkpoint import load_checkpoint, restore_opt_state, save_checkpoint
 from sheeprl_tpu.utils.env import make_env
@@ -232,6 +233,9 @@ def main(runtime, cfg: Dict[str, Any]):
     obs = envs.reset(seed=cfg.seed)[0]
 
     cumulative_per_rank_gradient_steps = 0
+    # Bound async in-flight train dispatches (core/runtime.py: an
+    # unbounded queue pins every pending call's sampled batch on host).
+    dispatch_throttle = DispatchThrottle()
     for iter_num in range(start_iter, total_iters + 1):
         policy_step += policy_steps_per_iter
 
@@ -310,6 +314,7 @@ def main(runtime, cfg: Dict[str, Any]):
                         train_key,
                         np.asarray(agent.tau if do_ema else 0.0, np.float32),
                     )
+                    dispatch_throttle.add(train_metrics)
                     # The broadcast back: enqueue the packed weight copy and
                     # return to env stepping.
                     actor_mirror.push(agent_state["actor"])

@@ -38,6 +38,7 @@ from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.core.mesh import DATA_AXIS
 from sheeprl_tpu.core.player import PlayerPlacement
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.core.runtime import DispatchThrottle
 from sheeprl_tpu.registry import register_algorithm
 from sheeprl_tpu.utils.checkpoint import load_checkpoint, restore_opt_state, save_checkpoint
 from sheeprl_tpu.utils.env import make_env
@@ -351,6 +352,9 @@ def main(runtime, cfg: Dict[str, Any]):
     obs = envs.reset(seed=cfg.seed)[0]
 
     cumulative_per_rank_gradient_steps = 0
+    # Bound async in-flight train dispatches (core/runtime.py: an
+    # unbounded queue pins every pending call's sampled batch on host).
+    dispatch_throttle = DispatchThrottle()
     for iter_num in range(start_iter, total_iters + 1):
         policy_step += policy_steps_per_iter
 
@@ -434,6 +438,7 @@ def main(runtime, cfg: Dict[str, Any]):
                             agent_state, opt_states, batch, train_key, update_actor, update_ema, update_decoder
                         )
                         per_step_metrics.append((train_metrics, update_actor, update_decoder))
+                        dispatch_throttle.add(train_metrics)
                         cumulative_per_rank_gradient_steps += 1
                     # Block only when the train timer needs an accurate stop;
                     # with metrics off the dispatch stays fully async, so the

@@ -31,6 +31,36 @@ from sheeprl_tpu.core.prng import seed_everything
 _TPU_PLATFORMS = ("tpu", "axon")
 
 
+class DispatchThrottle:
+    """Bound the number of in-flight async train dispatches.
+
+    XLA dispatch is asynchronous: an off-policy loop with metrics disabled
+    and `fabric.player_sync=async` never fetches anything, so the host can
+    enqueue train calls (each pinning its sampled device batch — ~13 MB at
+    the DreamerV3-S 100K shape) far ahead of the device, growing host
+    memory without bound until the client stalls. `add(token)` keeps a
+    window of ``depth`` dispatched outputs and blocks on the oldest when
+    the window is full — a full window costs no throughput (the device is
+    `depth` steps behind at most), an unbounded one took a bench host to
+    38 GB RSS before deadlocking.
+    """
+
+    def __init__(self, depth: int = 4) -> None:
+        from collections import deque
+
+        self._depth = int(depth)
+        self._queue = deque()
+
+    def add(self, token: Any) -> None:
+        self._queue.append(token)
+        while len(self._queue) > self._depth:
+            jax.block_until_ready(self._queue.popleft())
+
+    def drain(self) -> None:
+        while self._queue:
+            jax.block_until_ready(self._queue.popleft())
+
+
 def user_compilation_cache_dir() -> Optional[str]:
     """Per-user XLA compile-cache path, or None if it cannot be secured.
 

@@ -1,0 +1,29 @@
+"""DispatchThrottle: bound async in-flight train dispatches
+(core/runtime.py — regression for the unbounded-queue hang found while
+benchmarking DreamerV3-S: host enqueued train calls far ahead of the
+device, pinning every pending call's batch until RSS exhaustion)."""
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.core.runtime import DispatchThrottle
+
+
+def test_window_is_bounded():
+    t = DispatchThrottle(depth=3)
+    for i in range(10):
+        t.add(jnp.ones((4,)) * i)
+        assert len(t._queue) <= 3
+    t.drain()
+    assert len(t._queue) == 0
+
+
+def test_blocks_on_oldest_not_newest():
+    t = DispatchThrottle(depth=2)
+    tokens = [jax.jit(lambda x: x * 2)(jnp.ones((8,))) for _ in range(2)]
+    for tok in tokens:
+        t.add(tok)
+    # Third add evicts (and blocks on) the FIRST token only.
+    t.add(jax.jit(lambda x: x + 1)(jnp.ones((8,))))
+    assert len(t._queue) == 2
+    t.drain()

@@ -81,3 +81,30 @@ def test_local_batch_not_divisible():
     mesh = build_mesh()
     with pytest.raises(ValueError):
         local_batch_size(12, mesh)
+
+
+def test_split_player_trainer_rejects_model_axis():
+    """Decoupled x TP is an explicit scope cut (core/mesh.py:75): the raise
+    must fire for every player mode, including auto (VERDICT r2 weak 6)."""
+    import pytest
+
+    from sheeprl_tpu.core.mesh import build_mesh, split_player_trainer
+
+    mesh = build_mesh(model_axis_size=2)
+    for mode in ("auto", "host", "mesh"):
+        with pytest.raises(RuntimeError, match="model_axis"):
+            split_player_trainer(mesh, mode)
+
+
+def test_split_player_trainer_auto_with_params():
+    """auto + params threads the size guard (ADVICE r2): on the CPU test
+    platform host==mesh silicon, so the split stays on-mesh regardless."""
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.core.mesh import build_mesh, split_player_trainer
+
+    mesh = build_mesh()
+    player, trainer_mesh = split_player_trainer(
+        mesh, "auto", params={"w": jnp.zeros((8, 8))}
+    )
+    assert player is not None and trainer_mesh is not None
