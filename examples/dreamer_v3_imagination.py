@@ -44,7 +44,7 @@ def main() -> None:
     args = _parse_args(sys.argv[1:])
 
     from sheeprl_tpu.algos.dreamer_v3.agent import WorldModel, build_agent
-    from sheeprl_tpu.algos.dreamer_v3.utils import prepare_obs
+    from sheeprl_tpu.algos.dreamer_v3.utils import normalize_player_obs, prepare_obs
     from sheeprl_tpu.algos.ppo.agent import actions_metadata
     from sheeprl_tpu.core.runtime import Runtime
     from sheeprl_tpu.utils.checkpoint import load_checkpoint
@@ -74,9 +74,14 @@ def main() -> None:
     cnn_keys = list(cfg.algo.cnn_keys.decoder)
     mlp_keys = list(cfg.algo.mlp_keys.decoder)
 
+    enc_cnn_keys = list(cfg.algo.cnn_keys.encoder)
     decode = jax.jit(lambda p, lat: agent.wm(p, lat, method="decode"))
     player_step = jax.jit(
-        lambda wm, a, s, o, k: agent.player_step(wm, a, s, o, k, greedy=True)
+        # Pixels arrive uint8; the [-0.5, 0.5] scaling happens in-graph
+        # exactly like the training player (dreamer_v3.py:542).
+        lambda wm, a, s, o, k: agent.player_step(
+            wm, a, s, normalize_player_obs(o, enc_cnn_keys), k, greedy=True
+        )
     )
     imagine = jax.jit(
         lambda p, prior, h, actions, k: agent.world_model.apply(
@@ -91,7 +96,7 @@ def main() -> None:
 
     # ----- context: posterior replay + reconstruction
     for _ in range(int(args.context)):
-        jnp_obs = prepare_obs(obs, cnn_keys=list(cfg.algo.cnn_keys.encoder), num_envs=1)
+        jnp_obs = prepare_obs(obs, cnn_keys=enc_cnn_keys, num_envs=1)
         key, sub = jax.random.split(key)
         actions_cat, real_actions, player_state = player_step(
             wm_params, agent_state["actor"], player_state, jnp_obs, sub
@@ -101,7 +106,8 @@ def main() -> None:
         )
         rec = jax.device_get(decode(wm_params, latent))
         for k in cnn_keys:
-            real_frames.append(np.asarray(jnp_obs[k][0]))
+            # Store both rows in the decoder's [-0.5, 0.5] domain.
+            real_frames.append(np.asarray(jnp_obs[k][0], np.float32) / 255.0 - 0.5)
             recon_frames.append(np.asarray(rec[k][0]))
         for k in mlp_keys:
             target = np.asarray(symlog(jnp.asarray(obs[k], jnp.float32)))

@@ -3,14 +3,15 @@
 # single host core; concurrency would corrupt the measurements).
 # Usage: sh scripts/run_all_benches.sh [out_file]
 out="${1:-BENCH_ALL.jsonl}"
+errdir=$(mktemp -d)
 : > "$out"
 for w in ppo a2c sac dreamer_v1 dreamer_v2 dreamer_v3 dreamer_v3_S; do
     echo "=== $w ===" >&2
-    line=$(python bench.py "$w" 2>"/tmp/bench_$w.err" | tail -1)
+    line=$(python bench.py "$w" 2>"$errdir/$w.err" | tail -1)
     if [ -n "$line" ]; then
         echo "$line" | tee -a "$out"
     else
         echo "WARNING: $w produced no result — stderr:" >&2
-        tail -5 "/tmp/bench_$w.err" >&2
+        tail -5 "$errdir/$w.err" >&2
     fi
 done

@@ -130,8 +130,10 @@ def main(runtime, cfg: Dict[str, Any]):
     player_device, trainer_mesh = split_player_trainer(runtime.mesh, player_mode, params=params)
     n_trainers = int(trainer_mesh.shape[DATA_AXIS])
     runtime.print(f"Decoupled PPO: player on {player_device}, {n_trainers} trainer device(s)")
-    params = mesh_lib.replicate(params, trainer_mesh)
-    opt_state = mesh_lib.replicate(opt_state, trainer_mesh)
+    # shard_wide_params == replicate when model_axis is 1; with a model
+    # axis it shards wide dense stacks tensor-parallel over the trainers.
+    params = mesh_lib.shard_wide_params(params, trainer_mesh)
+    opt_state = mesh_lib.shard_wide_params(opt_state, trainer_mesh)
     # Trainer->player weight broadcast as a packed single-transfer mirror
     # (core/player.py). On-policy: always fresh — the next rollout must see
     # the post-update weights, exactly like the reference's blocking

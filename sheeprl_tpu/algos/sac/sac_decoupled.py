@@ -143,8 +143,10 @@ def main(runtime, cfg: Dict[str, Any]):
     )
     n_trainers = int(trainer_mesh.shape[DATA_AXIS])
     runtime.print(f"Decoupled SAC: player on {player_device}, {n_trainers} trainer device(s)")
-    agent_state = mesh_lib.replicate(agent_state, trainer_mesh)
-    opt_states = mesh_lib.replicate(opt_states, trainer_mesh)
+    # shard_wide_params == replicate when model_axis is 1; with a model
+    # axis it shards wide dense stacks tensor-parallel over the trainers.
+    agent_state = mesh_lib.shard_wide_params(agent_state, trainer_mesh)
+    opt_states = mesh_lib.shard_wide_params(opt_states, trainer_mesh)
     # The trainer->player weight broadcast as a packed single-transfer mirror
     # (core/player.py): honors fabric.player_sync — "fresh" makes the next
     # inference wait for the post-update actor, "async" serves the newest

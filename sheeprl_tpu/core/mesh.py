@@ -66,33 +66,37 @@ def split_player_trainer(mesh: Mesh, player_mode: str = "mesh", params: Any = No
       accelerator — decoupled training then works on a single chip, with no
       device sacrificed to latency-bound inference.
 
+    Composes with tensor parallelism (``fabric.model_axis > 1``): the
+    trainer partition keeps the ``model`` axis. With the player on the
+    host the full (data x model) mesh trains; on-mesh, the player takes
+    grid[0, 0] and the trainers keep data rows 1..d-1 as a
+    (d-1) x model mesh — the rest of row 0 idles, exactly like the
+    reference's rank-0 player process idles its accelerator share.
+
     ``params`` is the player-visible parameter tree (or None before it
     exists): ``auto`` refuses the host placement for actors above
     AUTO_MAX_PARAM_BYTES, whose packed post-update transfers would dominate.
     Callers that split before building the agent should re-split once the
     params exist.
     """
-    if int(mesh.shape[MODEL_AXIS]) > 1:
-        raise RuntimeError(
-            "Decoupled training does not compose with fabric.model_axis > 1 yet: "
-            "the trainer partition is pure data-parallel. Set fabric.model_axis=1."
-        )
     from sheeprl_tpu.core.player import resolve_player_device
 
+    model_size = int(mesh.shape[MODEL_AXIS])
     mesh_dev = mesh.devices.flat[0]
     player_mode = str(player_mode).lower()
     player = resolve_player_device(player_mode, mesh_dev, params=params)
     if player.platform == "cpu" and (player_mode == "host" or mesh_dev.platform != "cpu"):
         return player, mesh
-    devices = list(mesh.devices.flat)
-    if len(devices) < 2:
+    data_size = int(mesh.shape[DATA_AXIS])
+    if data_size < 2:
         raise RuntimeError(
-            "The decoupled on-mesh split needs at least 2 devices (one player + at least "
-            "one trainer); run with fabric.devices>=2, or put the player on the "
-            "host with fabric.player_device=host to train on every device."
+            "The decoupled on-mesh split needs at least 2 data rows (one player + at "
+            "least one trainer row); run with fabric.devices>=2, or put the player on "
+            "the host with fabric.player_device=host to train on every device."
         )
-    trainer_mesh = build_mesh(devices=devices[1:], model_axis_size=1)
-    return devices[0], trainer_mesh
+    grid = mesh.devices.reshape(data_size, model_size)
+    trainer_mesh = build_mesh(devices=list(grid[1:].flat), model_axis_size=model_size)
+    return grid[0, 0], trainer_mesh
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

@@ -83,17 +83,27 @@ def test_local_batch_not_divisible():
         local_batch_size(12, mesh)
 
 
-def test_split_player_trainer_rejects_model_axis():
-    """Decoupled x TP is an explicit scope cut (core/mesh.py:75): the raise
-    must fire for every player mode, including auto (VERDICT r2 weak 6)."""
+def test_split_player_trainer_composes_with_model_axis():
+    """Decoupled x TP (round-2 weak item 6, now supported): the trainer
+    partition keeps the model axis — grid[0,0] plays, rows 1..d-1 train."""
+    from sheeprl_tpu.core.mesh import DATA_AXIS, MODEL_AXIS, build_mesh, split_player_trainer
+
+    mesh = build_mesh(model_axis_size=2)  # 4 x 2 on the 8-device CPU mesh
+    player, trainer_mesh = split_player_trainer(mesh, "mesh")
+    assert player == mesh.devices.reshape(4, 2)[0, 0]
+    assert int(trainer_mesh.shape[DATA_AXIS]) == 3
+    assert int(trainer_mesh.shape[MODEL_AXIS]) == 2
+    assert player not in set(trainer_mesh.devices.flat)
+
+
+def test_split_player_trainer_model_axis_needs_two_data_rows():
     import pytest
 
     from sheeprl_tpu.core.mesh import build_mesh, split_player_trainer
 
-    mesh = build_mesh(model_axis_size=2)
-    for mode in ("auto", "host", "mesh"):
-        with pytest.raises(RuntimeError, match="model_axis"):
-            split_player_trainer(mesh, mode)
+    mesh = build_mesh(devices=None, data_axis_size=1, model_axis_size=2)
+    with pytest.raises(RuntimeError, match="2 data rows"):
+        split_player_trainer(mesh, "mesh")
 
 
 def test_split_player_trainer_auto_with_params():
