@@ -133,7 +133,9 @@ def main() -> None:
 
     if cnn_keys:
         # One PNG strip: context real frames on top, context recon +
-        # imagined continuation below ((obs+0.5)*255 undoes prepare_obs).
+        # imagined continuation below. Both rows are in the decoder's
+        # [-0.5, 0.5] domain (real frames converted above), so one
+        # shared (x+0.5)*255 maps them back to displayable uint8.
         rows = []
         pad = [np.zeros_like(recon_frames[0])] * (len(recon_frames) - len(real_frames))
         for frames in (real_frames + pad, recon_frames):

@@ -4,6 +4,8 @@
 # Usage: sh scripts/run_all_benches.sh [out_file]
 out="${1:-BENCH_ALL.jsonl}"
 errdir=$(mktemp -d)
+trap 'rm -rf "$errdir"' EXIT
+echo "bench stderr in $errdir" >&2
 : > "$out"
 for w in ppo a2c sac dreamer_v1 dreamer_v2 dreamer_v3 dreamer_v3_S; do
     echo "=== $w ===" >&2

@@ -29,12 +29,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _setup_jax() -> None:
+def _setup_jax(num_cpu_devices: int = None) -> None:
     # CPU: learning validation must not depend on (or monopolize) a chip.
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    if num_cpu_devices is not None:
+        jax.config.update("jax_num_cpu_devices", int(num_cpu_devices))
     try:
         from jax.extend import backend as _jeb
 
@@ -92,9 +94,11 @@ def _greedy_episodes(agent_step, env_cfg, episodes: int, seed0: int = 1000):
 
 
 # ------------------------------------------------------------------ PPO
-def validate_ppo(total_steps: int = 131072, episodes: int = 10):
-    """PPO CartPole-v1: the classic 'solved' bar is 475/500."""
-    _setup_jax()
+def validate_ppo(total_steps: int = 131072, episodes: int = 10, devices: int = 1):
+    """PPO CartPole-v1: the classic 'solved' bar is 475/500. ``devices>1``
+    validates that data-parallel sharding preserves learning, not just
+    compilation (runs on a virtual CPU mesh)."""
+    _setup_jax(num_cpu_devices=devices if devices > 1 else None)
     import jax
     import numpy as np
 
@@ -123,6 +127,7 @@ def validate_ppo(total_steps: int = 131072, episodes: int = 10):
             "algo.optimizer.eps=1e-5",
             "algo.run_test=False",
             "fabric.accelerator=cpu",
+            f"fabric.devices={devices}",
             "metric.log_level=0",
             "checkpoint.every=10000",
             "checkpoint.save_last=True",
@@ -151,8 +156,10 @@ def validate_ppo(total_steps: int = 131072, episodes: int = 10):
         return np.asarray(get_actions(params, jnp_obs)), None
 
     mean, rews = _greedy_episodes(step, cfg, episodes)
-    return {"algo": "ppo", "env": "CartPole-v1", "mean_return": mean, "returns": rews,
-            "threshold": 475.0, "train_seconds": round(train_s, 1), "total_steps": total_steps}
+    label = "ppo" if devices == 1 else f"ppo ({devices}-device dp)"
+    return {"algo": label, "env": "CartPole-v1", "mean_return": mean, "returns": rews,
+            "threshold": 475.0, "train_seconds": round(train_s, 1),
+            "total_steps": total_steps, "devices": devices}
 
 
 # ------------------------------------------------------------------ SAC
@@ -304,7 +311,17 @@ def validate_dreamer_v3(total_steps: int = 16384, episodes: int = 10):
             "total_steps": total_steps}
 
 
-VALIDATORS = {"ppo": validate_ppo, "sac": validate_sac, "dreamer_v3": validate_dreamer_v3}
+def validate_ppo_dp():
+    """PPO on a 2-device data-parallel CPU mesh (sharded learning proof)."""
+    return validate_ppo(devices=2)
+
+
+VALIDATORS = {
+    "ppo": validate_ppo,
+    "ppo_dp": validate_ppo_dp,
+    "sac": validate_sac,
+    "dreamer_v3": validate_dreamer_v3,
+}
 
 
 def _write_results(results) -> None:

@@ -30,6 +30,17 @@ def test_ppo_learns_cartpole():
 
 @pytest.mark.slow
 @pytest.mark.skipif(not _RUN_SLOW, reason="set SHEEPRL_SLOW_TESTS=1 to run")
+def test_ppo_learns_cartpole_data_parallel():
+    """Data-parallel sharding must preserve learning, not just compile
+    (recorded in RESULTS.md: 500.0 on a 2-device CPU mesh)."""
+    r = validate_ppo(devices=2)
+    assert r["mean_return"] >= r["threshold"], (
+        f"2-device PPO stopped learning: {r['mean_return']:.1f} < {r['threshold']} ({r['returns']})"
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _RUN_SLOW, reason="set SHEEPRL_SLOW_TESTS=1 to run")
 def test_sac_learns_pendulum():
     r = validate_sac()
     assert r["mean_return"] >= r["threshold"], (
