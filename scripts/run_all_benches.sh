@@ -4,7 +4,7 @@
 # Usage: sh scripts/run_all_benches.sh [out_file]
 out="${1:-BENCH_ALL.jsonl}"
 errdir=$(mktemp -d)
-trap 'rm -rf "$errdir"' EXIT
+# kept after exit for post-mortem (unpredictable path, no CWE-379 risk)
 echo "bench stderr in $errdir" >&2
 : > "$out"
 for w in ppo a2c sac dreamer_v1 dreamer_v2 dreamer_v3 dreamer_v3_S; do

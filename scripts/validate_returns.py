@@ -1,6 +1,7 @@
 """Learning validation: train three algorithm families on CPU-scale
 workloads and verify the policies actually improve returns (VERDICT round 2,
 missing item 1 — "nothing anywhere demonstrates that any algorithm learns").
+Validators: PPO (single + 2-device data-parallel), A2C, SAC, DreamerV3.
 
 Workloads (minutes each on CPU):
   - PPO   CartPole-v1  -> mean greedy return over 10 episodes >= 475 (solved)
@@ -14,7 +15,7 @@ episode-return trace and the final greedy eval mean. The pytest wrappers in
 tests/test_algos/test_learning.py call the same entrypoints, so a silent
 sign error in a loss fails the suite, not just this script.
 
-Usage: python scripts/validate_returns.py [ppo|sac|dreamer_v3|all]
+Usage: python scripts/validate_returns.py [ppo|ppo_dp|a2c|sac|dreamer_v3|all]
 """
 
 from __future__ import annotations
@@ -34,15 +35,18 @@ def _setup_jax(num_cpu_devices: int = None) -> None:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
-    if num_cpu_devices is not None:
-        jax.config.update("jax_num_cpu_devices", int(num_cpu_devices))
+    # clear_backends FIRST: jax_num_cpu_devices (and a platform change)
+    # must be applied before backends are (re)built — updating after an
+    # earlier validator initialized the backend raises otherwise.
     try:
         from jax.extend import backend as _jeb
 
         _jeb.clear_backends()
     except Exception:
         pass
+    jax.config.update("jax_platforms", "cpu")
+    if num_cpu_devices is not None:
+        jax.config.update("jax_num_cpu_devices", int(num_cpu_devices))
 
 
 def _compose(overrides):
@@ -93,20 +97,41 @@ def _greedy_episodes(agent_step, env_cfg, episodes: int, seed0: int = 1000):
     return float(np.mean(rews)), rews
 
 
+def _ppo_family_greedy_eval(cfg, root: str, prepare_obs_fn, episodes: int):
+    """Shared checkpoint-load + greedy-eval scaffolding for the PPO-family
+    agents (PPO and A2C share build_agent): load the newest checkpoint,
+    rebuild the agent on one CPU device, and run greedy episodes."""
+    import jax
+    import numpy as np
+
+    from sheeprl_tpu.algos.ppo.agent import actions_metadata, build_agent
+    from sheeprl_tpu.core.runtime import Runtime
+    from sheeprl_tpu.utils.checkpoint import load_checkpoint
+    from sheeprl_tpu.utils.env import make_env
+
+    state = load_checkpoint(_latest_ckpt(root))
+    runtime = Runtime(devices=1, accelerator="cpu").launch()
+    runtime.seed_everything(cfg.seed)
+    env = make_env(cfg, None, 0, None, "probe", vector_env_idx=0)()
+    actions_dim, is_continuous = actions_metadata(env.action_space)
+    obs_space = env.observation_space
+    env.close()
+    agent, params = build_agent(runtime, actions_dim, is_continuous, cfg, obs_space, state["agent"])
+    get_actions = jax.jit(lambda p, o: agent.get_actions(p, o, greedy=True))
+
+    def step(obs, _state):
+        return np.asarray(get_actions(params, prepare_obs_fn(obs))), None
+
+    return _greedy_episodes(step, cfg, episodes)
+
+
 # ------------------------------------------------------------------ PPO
 def validate_ppo(total_steps: int = 131072, episodes: int = 10, devices: int = 1):
     """PPO CartPole-v1: the classic 'solved' bar is 475/500. ``devices>1``
     validates that data-parallel sharding preserves learning, not just
     compilation (runs on a virtual CPU mesh)."""
     _setup_jax(num_cpu_devices=devices if devices > 1 else None)
-    import jax
-    import numpy as np
-
-    from sheeprl_tpu.algos.ppo.agent import build_agent
     from sheeprl_tpu.algos.ppo.utils import prepare_obs
-    from sheeprl_tpu.core.runtime import Runtime
-    from sheeprl_tpu.utils.checkpoint import load_checkpoint
-    from sheeprl_tpu.utils.env import make_env
 
     root = f"validate_ppo_{os.getpid()}"
     cfg = _compose(
@@ -139,27 +164,56 @@ def validate_ppo(total_steps: int = 131072, episodes: int = 10, devices: int = 1
     _run(cfg)
     train_s = time.time() - t0
 
-    state = load_checkpoint(_latest_ckpt(root))
-    runtime = Runtime(devices=1, accelerator="cpu").launch()
-    runtime.seed_everything(cfg.seed)
-    env = make_env(cfg, None, 0, None, "probe", vector_env_idx=0)()
-    from sheeprl_tpu.algos.ppo.agent import actions_metadata
-
-    actions_dim, is_continuous = actions_metadata(env.action_space)
-    obs_space = env.observation_space
-    env.close()
-    agent, params = build_agent(runtime, actions_dim, is_continuous, cfg, obs_space, state["agent"])
-    get_actions = jax.jit(lambda p, o: agent.get_actions(p, o, greedy=True))
-
-    def step(obs, _state):
-        jnp_obs = prepare_obs(obs, cnn_keys=[])
-        return np.asarray(get_actions(params, jnp_obs)), None
-
-    mean, rews = _greedy_episodes(step, cfg, episodes)
+    mean, rews = _ppo_family_greedy_eval(
+        cfg, root, lambda obs: prepare_obs(obs, cnn_keys=[]), episodes
+    )
     label = "ppo" if devices == 1 else f"ppo ({devices}-device dp)"
     return {"algo": label, "env": "CartPole-v1", "mean_return": mean, "returns": rews,
-            "threshold": 475.0, "train_seconds": round(train_s, 1),
+            "threshold": 475.0, "untrained": 20.0, "train_seconds": round(train_s, 1),
             "total_steps": total_steps, "devices": devices}
+
+
+# ------------------------------------------------------------------ A2C
+def validate_a2c(total_steps: int = 524288, episodes: int = 10):
+    """A2C CartPole-v1: slower learner than PPO (5-step rollouts, single
+    epoch); bar set at 400 (random ~20, solved 475)."""
+    _setup_jax()
+    from sheeprl_tpu.algos.a2c.utils import prepare_obs
+
+    root = f"validate_a2c_{os.getpid()}"
+    cfg = _compose(
+        [
+            "exp=a2c",
+            f"algo.total_steps={total_steps}",
+            "env.num_envs=8",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "algo.rollout_steps=16",
+            "algo.per_rank_batch_size=128",
+            "algo.ent_coef=0.01",
+            "algo.anneal_lr=True",
+            "algo.max_grad_norm=0.5",
+            "algo.optimizer.lr=1e-3",
+            "algo.run_test=False",
+            "fabric.accelerator=cpu",
+            "metric.log_level=0",
+            "checkpoint.every=50000",
+            "checkpoint.save_last=True",
+            f"root_dir={root}",
+            "seed=42",
+        ]
+    )
+    t0 = time.time()
+    _run(cfg)
+    train_s = time.time() - t0
+
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    mean, rews = _ppo_family_greedy_eval(
+        cfg, root, lambda obs: prepare_obs(obs, mlp_keys=mlp_keys, num_envs=1), episodes
+    )
+    return {"algo": "a2c", "env": "CartPole-v1", "mean_return": mean, "returns": rews,
+            "threshold": 400.0, "untrained": 20.0, "train_seconds": round(train_s, 1),
+            "total_steps": total_steps}
 
 
 # ------------------------------------------------------------------ SAC
@@ -218,7 +272,8 @@ def validate_sac(total_steps: int = 12288, episodes: int = 10):
 
     mean, rews = _greedy_episodes(step, cfg, episodes)
     return {"algo": "sac", "env": "Pendulum-v1", "mean_return": mean, "returns": rews,
-            "threshold": -300.0, "train_seconds": round(train_s, 1), "total_steps": total_steps}
+            "threshold": -300.0, "untrained": -1400.0, "train_seconds": round(train_s, 1),
+            "total_steps": total_steps}
 
 
 # ------------------------------------------------------------- DreamerV3
@@ -307,8 +362,8 @@ def validate_dreamer_v3(total_steps: int = 16384, episodes: int = 10):
 
     mean, rews = _greedy_episodes(step, cfg, episodes)
     return {"algo": "dreamer_v3", "env": "CartPole-v1 (state)", "mean_return": mean,
-            "returns": rews, "threshold": 150.0, "train_seconds": round(train_s, 1),
-            "total_steps": total_steps}
+            "returns": rews, "threshold": 150.0, "untrained": 20.0,
+            "train_seconds": round(train_s, 1), "total_steps": total_steps}
 
 
 def validate_ppo_dp():
@@ -319,6 +374,7 @@ def validate_ppo_dp():
 VALIDATORS = {
     "ppo": validate_ppo,
     "ppo_dp": validate_ppo_dp,
+    "a2c": validate_a2c,
     "sac": validate_sac,
     "dreamer_v3": validate_dreamer_v3,
 }
@@ -327,24 +383,41 @@ VALIDATORS = {
 def _write_results(results) -> None:
     path = os.path.join(_REPO, "RESULTS.md")
     lines = [
-        "# RESULTS — learning validation (CPU)\n",
-        "\nGenerated by `python scripts/validate_returns.py all`. Greedy eval over",
+        "# RESULTS — learning validation (CPU)",
+        "",
+        "Produced by `python scripts/validate_returns.py all`. Greedy eval over",
         "10 episodes after a CPU-scale training run; thresholds are the",
         "classic solve bars (reference discipline: README results tables,",
-        "/root/reference/README.md:26-79).\n",
-        "\n| Algo | Env | Steps | Train s | Mean return | Threshold | Pass |",
-        "|---|---|---|---|---|---|---|",
+        "`/root/reference/README.md:26-79`). Each run demonstrates the full",
+        "loop — env vectorization, replay, jitted update, checkpoint, restore,",
+        "greedy eval — actually improves returns; the data-parallel PPO row",
+        "shows sharded training preserves learning, not just compilation.",
+        "",
+        "| Algo | Env | Steps | Train s | Mean return | Threshold | Untrained | Pass |",
+        "|---|---|---|---|---|---|---|---|",
     ]
     for r in results:
         ok = r["mean_return"] >= r["threshold"]
         lines.append(
             f"| {r['algo']} | {r['env']} | {r['total_steps']} | {r['train_seconds']} "
-            f"| **{r['mean_return']:.1f}** | {r['threshold']} | {'✅' if ok else '❌'} |"
+            f"| **{r['mean_return']:.1f}** | {r['threshold']} | ~{r.get('untrained', '?')} "
+            f"| {'✅' if ok else '❌'} |"
         )
-    lines.append("\nPer-episode returns:\n")
+    lines += [
+        "",
+        "Per-episode returns:",
+        "",
+    ]
     for r in results:
         lines.append(f"- **{r['algo']}**: {[round(x, 1) for x in r['returns']]}")
-    lines.append("")
+    lines += [
+        "",
+        "The PPO validation also runs in the test suite",
+        "(`tests/test_algos/test_learning.py::test_ppo_learns_cartpole`); the",
+        "data-parallel PPO, A2C, SAC and DreamerV3 validations are gated behind",
+        "`SHEEPRL_SLOW_TESTS=1`.",
+        "",
+    ]
     with open(path, "w") as fp:
         fp.write("\n".join(lines))
     print(f"wrote {path}")
@@ -352,6 +425,8 @@ def _write_results(results) -> None:
 
 def main() -> None:
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which != "all" and which not in VALIDATORS:
+        sys.exit(f"unknown validator {which!r}; choose from {sorted(VALIDATORS)} or 'all'")
     names = list(VALIDATORS) if which == "all" else [which]
     results = []
     for name in names:

@@ -14,7 +14,12 @@ import pytest
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
 
-from scripts.validate_returns import validate_dreamer_v3, validate_ppo, validate_sac  # noqa: E402
+from scripts.validate_returns import (  # noqa: E402
+    validate_a2c,
+    validate_dreamer_v3,
+    validate_ppo,
+    validate_sac,
+)
 
 _RUN_SLOW = os.environ.get("SHEEPRL_SLOW_TESTS", "") == "1"
 
@@ -36,6 +41,15 @@ def test_ppo_learns_cartpole_data_parallel():
     r = validate_ppo(devices=2)
     assert r["mean_return"] >= r["threshold"], (
         f"2-device PPO stopped learning: {r['mean_return']:.1f} < {r['threshold']} ({r['returns']})"
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _RUN_SLOW, reason="set SHEEPRL_SLOW_TESTS=1 to run")
+def test_a2c_learns_cartpole():
+    r = validate_a2c()
+    assert r["mean_return"] >= r["threshold"], (
+        f"A2C stopped learning: {r['mean_return']:.1f} < {r['threshold']} ({r['returns']})"
     )
 
 
