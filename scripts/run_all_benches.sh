@@ -4,9 +4,9 @@
 # Usage: sh scripts/run_all_benches.sh [out_file]
 out="${1:-BENCH_ALL.jsonl}"
 errdir=$(mktemp -d)
-# kept after exit for post-mortem (unpredictable path, no CWE-379 risk)
 echo "bench stderr in $errdir" >&2
 : > "$out"
+failed=0
 for w in ppo a2c sac dreamer_v1 dreamer_v2 dreamer_v3 dreamer_v3_S; do
     echo "=== $w ===" >&2
     line=$(python bench.py "$w" 2>"$errdir/$w.err" | tail -1)
@@ -15,5 +15,8 @@ for w in ppo a2c sac dreamer_v1 dreamer_v2 dreamer_v3 dreamer_v3_S; do
     else
         echo "WARNING: $w produced no result — stderr:" >&2
         tail -5 "$errdir/$w.err" >&2
+        failed=1
     fi
 done
+# keep stderr only when something failed (post-mortem); clean otherwise
+[ "$failed" = 0 ] && rm -rf "$errdir"

@@ -1,0 +1,50 @@
+"""Every DreamerV3 size preset must build (reference:
+configs/algo/dreamer_v3_{XS,S,M,L,XL}.yaml). jax.eval_shape constructs the
+full agent abstractly — no allocation — so even XL (210M params) checks in
+milliseconds, and a config edit that breaks a preset's shape contract fails
+here rather than at minute-scale init in a real run."""
+
+import types
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import sheeprl_tpu
+from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
+from sheeprl_tpu.config.loader import compose
+
+# Parameter-count floors (millions): catches silent config shrinkage.
+EXPECTED_MIN_M = {"XS": 8, "S": 18, "M": 38, "L": 80, "XL": 200}
+
+
+@pytest.mark.parametrize("size", ["XS", "S", "M", "L", "XL"])
+def test_size_preset_builds(size):
+    sheeprl_tpu.register_all()
+    cfg = compose(
+        "config",
+        [
+            "exp=dreamer_v3",
+            f"algo=dreamer_v3_{size}",
+            "env=dummy",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.cnn_keys.decoder=[rgb]",
+            "algo.mlp_keys.encoder=[]",
+            "algo.mlp_keys.decoder=[]",
+        ],
+    )
+    obs = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (64, 64, 3), np.uint8)})
+    rt = types.SimpleNamespace(
+        root_key=jax.random.PRNGKey(0),
+        precision=types.SimpleNamespace(compute_dtype=jnp.float32),
+    )
+
+    def build():
+        _, state = build_agent(rt, (6,), False, cfg, obs)
+        return state
+
+    shapes = jax.eval_shape(build)
+    n_params = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(shapes))
+    assert n_params >= EXPECTED_MIN_M[size] * 1e6, (size, n_params)

@@ -2,9 +2,10 @@
 sign error in a loss must fail the suite, not survive 296 dry-run tests.
 
 The PPO test always runs (minutes on CPU): PPO CartPole-v1 must reach the
-classic 475 solve bar. The SAC and DreamerV3 validations take longer and
-are additionally gated behind SHEEPRL_SLOW_TESTS=1; run them (and record
-RESULTS.md) with `python scripts/validate_returns.py all`.
+classic 475 solve bar. The data-parallel PPO, A2C, SAC, and DreamerV3
+validations take longer and are additionally gated behind
+SHEEPRL_SLOW_TESTS=1; run them (and record RESULTS.md) with
+`python scripts/validate_returns.py all`.
 """
 
 import os
