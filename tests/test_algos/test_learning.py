@@ -17,6 +17,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspa
 
 from scripts.validate_returns import (  # noqa: E402
     validate_a2c,
+    validate_ppo_recurrent,
     validate_dreamer_v3,
     validate_ppo,
     validate_sac,
@@ -51,6 +52,16 @@ def test_a2c_learns_cartpole():
     r = validate_a2c()
     assert r["mean_return"] >= r["threshold"], (
         f"A2C stopped learning: {r['mean_return']:.1f} < {r['threshold']} ({r['returns']})"
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _RUN_SLOW, reason="set SHEEPRL_SLOW_TESTS=1 to run")
+def test_ppo_recurrent_learns_masked_cartpole():
+    """Velocity-masked CartPole needs memory: validates BPTT end to end."""
+    r = validate_ppo_recurrent()
+    assert r["mean_return"] >= r["threshold"], (
+        f"PPO-recurrent stopped learning: {r['mean_return']:.1f} < {r['threshold']} ({r['returns']})"
     )
 
 
