@@ -17,6 +17,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspa
 
 from scripts.validate_returns import (  # noqa: E402
     validate_a2c,
+    validate_dreamer_v2,
+    validate_droq,
     validate_ppo_recurrent,
     validate_dreamer_v3,
     validate_ppo,
@@ -71,6 +73,24 @@ def test_sac_learns_pendulum():
     r = validate_sac()
     assert r["mean_return"] >= r["threshold"], (
         f"SAC stopped learning: {r['mean_return']:.1f} < {r['threshold']} ({r['returns']})"
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _RUN_SLOW, reason="set SHEEPRL_SLOW_TESTS=1 to run")
+def test_droq_learns_pendulum():
+    r = validate_droq()
+    assert r["mean_return"] >= r["threshold"], (
+        f"DroQ stopped learning: {r['mean_return']:.1f} < {r['threshold']} ({r['returns']})"
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _RUN_SLOW, reason="set SHEEPRL_SLOW_TESTS=1 to run")
+def test_dreamer_v2_learns_cartpole():
+    r = validate_dreamer_v2()
+    assert r["mean_return"] >= r["threshold"], (
+        f"DreamerV2 stopped learning: {r['mean_return']:.1f} < {r['threshold']} ({r['returns']})"
     )
 
 
