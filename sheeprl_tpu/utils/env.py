@@ -34,6 +34,15 @@ from sheeprl_tpu.envs.wrappers import (
 )
 
 
+def _dictify_obs(env: gym.Env, key: str) -> gym.Env:
+    """Wrap a bare-Box observation into a one-key Dict obs space."""
+    return gym.wrappers.TransformObservation(
+        env,
+        lambda obs: {key: obs},
+        gym.spaces.Dict({key: env.observation_space}),
+    )
+
+
 def make_env(
     cfg: Dict[str, Any],
     seed: int,
@@ -91,18 +100,17 @@ def make_env(
                     render_key=cfg.algo.cnn_keys.encoder[0],
                     obs_key=cfg.algo.mlp_keys.encoder[0] if encoder_mlp_keys_length > 0 else "state",
                 )
+                if encoder_mlp_keys_length == 0:
+                    # render_only leaves a bare pixel Box (no dict): wrap it
+                    # under the cnn key like the pixel-only branch below.
+                    env = _dictify_obs(env, cfg.algo.cnn_keys.encoder[0])
             else:
                 if encoder_mlp_keys_length > 1:
                     warnings.warn(
                         "Multiple mlp keys have been specified and only one vector observation "
                         f"is allowed in {cfg.env.id}, only the first one is kept: {cfg.algo.mlp_keys.encoder[0]}"
                     )
-                mlp_key = cfg.algo.mlp_keys.encoder[0]
-                env = gym.wrappers.TransformObservation(
-                    env,
-                    lambda obs: {mlp_key: obs},
-                    gym.spaces.Dict({mlp_key: env.observation_space}),
-                )
+                env = _dictify_obs(env, cfg.algo.mlp_keys.encoder[0])
         elif isinstance(env.observation_space, gym.spaces.Box) and 2 <= len(env.observation_space.shape) <= 3:
             # Pixel-only observation
             if encoder_cnn_keys_length > 1:
@@ -115,12 +123,7 @@ def make_env(
                     "You have selected a pixel observation but no cnn key has been specified. "
                     "Please set at least one cnn key in the config file: `algo.cnn_keys.encoder=[your_cnn_key]`"
                 )
-            cnn_key = cfg.algo.cnn_keys.encoder[0]
-            env = gym.wrappers.TransformObservation(
-                env,
-                lambda obs: {cnn_key: obs},
-                gym.spaces.Dict({cnn_key: env.observation_space}),
-            )
+            env = _dictify_obs(env, cfg.algo.cnn_keys.encoder[0])
 
         requested = set(cfg.algo.mlp_keys.encoder + cfg.algo.cnn_keys.encoder)
         if len(requested.intersection(set(env.observation_space.keys()))) == 0:

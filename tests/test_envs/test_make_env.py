@@ -71,6 +71,21 @@ class TestMakeEnv:
         assert set(obs.keys()) == {"state"}
         assert obs["state"].shape == (4,)
 
+    def test_vector_env_pixels_only_render(self):
+        """cnn-only keys on a vector env: the render becomes the single
+        pixel obs, dict-ified under the cnn key (regression: render_only
+        left a bare Box and the key check crashed)."""
+        cfg = base_cfg(
+            wrapper={"_target_": "gymnasium.make", "id": "CartPole-v1", "render_mode": "rgb_array"},
+            id="CartPole-v1",
+        )
+        cfg.algo = dotdict({"cnn_keys": {"encoder": ["rgb"]}, "mlp_keys": {"encoder": []}})
+        env = make_env(cfg, seed=0, rank=0)()
+        obs, _ = env.reset(seed=0)
+        assert set(obs.keys()) == {"rgb"}
+        assert obs["rgb"].shape == (64, 64, 3)
+        env.close()
+
     def test_time_limit(self):
         cfg = base_cfg(max_episode_steps=3)
         cfg.env.wrapper["n_steps"] = 1000
