@@ -19,6 +19,7 @@ from scripts.validate_returns import (  # noqa: E402
     validate_a2c,
     validate_dreamer_v2,
     validate_droq,
+    validate_p2e_dv3,
     validate_ppo_recurrent,
     validate_dreamer_v3,
     validate_ppo,
@@ -82,6 +83,17 @@ def test_droq_learns_pendulum():
     r = validate_droq()
     assert r["mean_return"] >= r["threshold"], (
         f"DroQ stopped learning: {r['mean_return']:.1f} < {r['threshold']} ({r['returns']})"
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _RUN_SLOW, reason="set SHEEPRL_SLOW_TESTS=1 to run")
+def test_p2e_dv3_chain_learns_cartpole():
+    """The exploration->finetuning checkpoint chain must transfer: the
+    finetuned task actor clears 100 (random ~20)."""
+    r = validate_p2e_dv3()
+    assert r["mean_return"] >= r["threshold"], (
+        f"P2E chain stopped learning: {r['mean_return']:.1f} < {r['threshold']} ({r['returns']})"
     )
 
 
