@@ -86,6 +86,21 @@ class TestMakeEnv:
         assert obs["rgb"].shape == (64, 64, 3)
         env.close()
 
+    def test_vector_env_pixels_and_state_render(self):
+        """cnn+mlp keys on a vector env: render joins the original vector
+        obs in one dict (AddRenderObservation render_only=False path)."""
+        cfg = base_cfg(
+            wrapper={"_target_": "gymnasium.make", "id": "CartPole-v1", "render_mode": "rgb_array"},
+            id="CartPole-v1",
+        )
+        cfg.algo = dotdict({"cnn_keys": {"encoder": ["rgb"]}, "mlp_keys": {"encoder": ["state"]}})
+        env = make_env(cfg, seed=0, rank=0)()
+        obs, _ = env.reset(seed=0)
+        assert set(obs.keys()) == {"rgb", "state"}
+        assert obs["rgb"].shape == (64, 64, 3)
+        assert obs["state"].shape == (4,)
+        env.close()
+
     def test_time_limit(self):
         cfg = base_cfg(max_episode_steps=3)
         cfg.env.wrapper["n_steps"] = 1000
