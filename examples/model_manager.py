@@ -1,0 +1,146 @@
+"""Model-manager walkthrough (the runnable analog of the reference's
+examples/model_manager.ipynb): train a small PPO run with MLflow logging +
+model registration enabled, then exercise the full MlflowModelManager
+surface — retrieve the experiment, inspect the registered model, register a
+second version from a checkpoint, transition it to "staging", download it,
+register the best model of the experiment, and delete an old version.
+
+Requires mlflow (not installed in every image — the script gates on the
+same import flag as sheeprl_tpu.utils.mlflow) and a tracking backend with a
+model registry, e.g. a local sqlite store (the default below) or a server
+started with `mlflow ui`.
+
+Usage:
+    python examples/model_manager.py [tracking_uri=sqlite:///mlflow.db]
+"""
+
+import glob
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import sheeprl_tpu
+from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE
+from sheeprl_tpu.utils.utils import dotdict
+
+if not _IS_MLFLOW_AVAILABLE:
+    sys.exit(
+        "mlflow is required for this walkthrough: pip install mlflow, then "
+        "rerun (optionally against a live server: tracking_uri=http://localhost:5000)."
+    )
+
+import mlflow  # noqa: E402
+
+from sheeprl_tpu.cli import check_configs, registration, run_algorithm  # noqa: E402
+from sheeprl_tpu.config.loader import compose  # noqa: E402
+from sheeprl_tpu.core.runtime import Runtime  # noqa: E402
+from sheeprl_tpu.utils.mlflow import MlflowModelManager  # noqa: E402
+
+
+def _parse_args(argv):
+    args = {"tracking_uri": "sqlite:///mlflow.db"}
+    for a in argv:
+        if "=" not in a:
+            raise ValueError(f"arguments are key=value, got {a!r}")
+        k, v = a.split("=", 1)
+        args[k] = v
+    return dotdict(args)
+
+
+def _train(tracking_uri: str, total_steps: int) -> dotdict:
+    """One small PPO CartPole run with MLflow logging + registration on
+    (the notebook's `run_algorithm` cell)."""
+    sheeprl_tpu.register_all()
+    cfg = compose(
+        "config",
+        [
+            "exp=ppo",
+            f"algo.total_steps={total_steps}",
+            "model_manager.disabled=False",
+            "logger@metric.logger=mlflow",
+            f"checkpoint.every={total_steps}",
+            "checkpoint.save_last=True",
+            "exp_name=mlflow_example",
+            f"metric.logger.tracking_uri={tracking_uri}",
+            "fabric.accelerator=cpu",
+            "env.capture_video=False",
+        ],
+    )
+    check_configs(cfg)
+    run_algorithm(cfg)
+    return cfg
+
+
+def main() -> None:
+    args = _parse_args(sys.argv[1:])
+
+    # --- Run the experiment and register the model -----------------------
+    cfg = _train(args.tracking_uri, total_steps=1024)
+
+    # --- Get experiment info ---------------------------------------------
+    mlflow.set_tracking_uri(args.tracking_uri)
+    exp = mlflow.get_experiment_by_name("mlflow_example")
+    print("Experiment:", exp.experiment_id, exp.name)
+    runs = mlflow.search_runs(experiment_ids=[exp.experiment_id])
+    print(f"Experiment ({exp.experiment_id}) has {len(runs)} run(s)")
+
+    # --- Retrieve model info ---------------------------------------------
+    runtime = Runtime(devices=1, accelerator="cpu").launch()
+    manager = MlflowModelManager(runtime, args.tracking_uri)
+    model_info = mlflow.search_registered_models(filter_string="name='mlflow_example_agent'")[-1]
+    model_name = model_info.name
+    print("Name:", model_name)
+    print("Description:", model_info.description)
+    latest = manager.get_latest_version(model_name)
+    print("Latest version:", latest.version)
+
+    # --- Register a new version from a checkpoint ------------------------
+    # (the notebook's `sheeprl_model_manager.py` cell: a second, longer run,
+    # then registration() from its checkpoint against the same run id)
+    cfg2 = _train(args.tracking_uri, total_steps=2048)
+    ckpts = sorted(
+        glob.glob(os.path.join("logs", "runs", cfg2.root_dir, "**", "ckpt_*.ckpt"), recursive=True),
+        key=os.path.getmtime,
+    )
+    run_id = mlflow.search_runs(experiment_ids=[exp.experiment_id])["run_id"][0]
+    registration(
+        [
+            f"checkpoint_path={ckpts[-1]}",
+            "model_manager=ppo",
+            "model_manager.models.agent.description='New PPO agent version (CartPole-v1)'",
+            f"run.id={run_id}",
+            f"tracking_uri={args.tracking_uri}",
+        ]
+    )
+    latest = manager.get_latest_version(model_name)
+    print("Latest version after checkpoint registration:", latest.version)
+
+    # --- Stage, download, best-model, delete -----------------------------
+    manager.transition_model(
+        model_name, latest.version, "staging", description="Staging model for the walkthrough"
+    )
+    download_path = os.path.join("models", "ppo-agent-cartpole")
+    manager.download_model(model_name, latest.version, download_path)
+    print("Downloaded to", download_path, "->", os.listdir(download_path))
+
+    manager.register_best_models(
+        "mlflow_example",
+        {
+            "agent": {
+                "name": "ppo_agent_cartpole_best_reward",
+                "path": "agent",
+                "tags": {},
+                "description": "The best PPO agent in the CartPole environment.",
+            }
+        },
+    )
+    if int(latest.version) > 1:
+        manager.delete_model(
+            model_name, int(latest.version) - 1, f"Delete model version {int(latest.version) - 1}"
+        )
+    print("Walkthrough complete.")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,324 @@
+"""Same-host torch measurement of the reference's CPU benchmark workloads.
+
+The reference's published CPU numbers (README.md:100-140: PPO 65,536 steps in
+81.27 s, A2C in 84.76 s, SAC in 320.21 s) were taken on a 4-vCPU box; ours
+run on this 1-core host, so cross-host ratios conflate hardware with
+framework. This harness re-measures the torch side ON THIS HOST: the same
+three benchmark workloads (sheeprl/configs/exp/{ppo,a2c,sac}_benchmarks.yaml
+— same envs, model shapes, batch/rollout sizes, optimizers, update cadence)
+implemented in plain torch (lightning/hydra are not installed here, so the
+reference cannot run verbatim; this is a from-scratch reimplementation of
+its per-step work, not its code). The result is an apples-to-apples
+same-host column for BENCH_ALL.md next to bench.py's JAX numbers.
+
+Workload fidelity notes (semantics from the reference, cited per workload):
+- PPO  (ppo_benchmarks.yaml): CartPole-v1, 1 sync env, Tanh MLP encoder
+  64x2 -> linear actor/critic heads (actor/critic mlp_layers=0), GAE(0.99,
+  0.95), 10 epochs x minibatch 64 over 128-step rollouts, Adam 3e-4,
+  normalize_advantages, vf_coef 0.5, grad-clip 0.5, 65,536 steps.
+- A2C  (a2c_benchmarks.yaml): CartPole-v1, 1 env, rollout 5, batch 5,
+  RMSprop(lr 7e-4, alpha 0.99, eps 1e-5), mean loss reduction, vf_coef 1.0,
+  grad-clip 0.5, 65,536 steps.
+- SAC  (sac_benchmarks.yaml + algos/sac/sac.py:222-355): LunarLanderContinuous
+  (v3 here; v2 is removed from this gymnasium), 4 sync envs, hidden 256,
+  twin Q + EMA targets (tau 0.005, every update), auto-alpha, replay_ratio
+  1.0 via the Ratio scheduler (sample once per iter at
+  grad_steps*batch_size, then chunked updates), Adam 3e-4, learning_starts
+  100, batch 256, 65,536 steps.
+
+Usage: python scripts/bench_reference_torch.py [ppo|a2c|sac|all]
+Prints one JSON line per workload:
+  {"metric": ..., "value": <env-steps/s>, "unit": "env-steps/sec",
+   "harness": "torch-same-host", "wall_seconds": ...}
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+import time
+
+import gymnasium as gym
+import numpy as np
+import torch
+import torch.nn as nn
+
+torch.set_num_threads(1)  # the host has one core; oversubscription only slows it
+
+TOTAL_STEPS = 65536
+
+
+# --------------------------------------------------------------- PPO / A2C
+class ActorCritic(nn.Module):
+    """Tanh-MLP encoder (dense_units x mlp_layers) with linear actor/critic
+    heads — the benchmark shape (encoder.mlp_features_dim=null,
+    actor/critic mlp_layers=0)."""
+
+    def __init__(self, obs_dim: int, n_actions: int, dense_units: int = 64, mlp_layers: int = 2):
+        super().__init__()
+        layers, d = [], obs_dim
+        for _ in range(mlp_layers):
+            layers += [nn.Linear(d, dense_units), nn.Tanh()]
+            d = dense_units
+        self.encoder = nn.Sequential(*layers)
+        self.actor = nn.Linear(d, n_actions)
+        self.critic = nn.Linear(d, 1)
+
+    def forward(self, obs: torch.Tensor):
+        feats = self.encoder(obs)
+        return self.actor(feats), self.critic(feats)
+
+
+def _gae(rewards, values, dones, next_value, gamma=0.99, lmbda=0.95):
+    T = rewards.shape[0]
+    advantages = torch.zeros_like(rewards)
+    last_adv = 0.0
+    for t in reversed(range(T)):
+        next_v = next_value if t == T - 1 else values[t + 1]
+        not_done = 1.0 - dones[t]
+        delta = rewards[t] + gamma * next_v * not_done - values[t]
+        last_adv = delta + gamma * lmbda * not_done * last_adv
+        advantages[t] = last_adv
+    return advantages, advantages + values
+
+
+def _rollout_policy_phase(env, model, obs, steps):
+    """Shared on-policy collection: sample actions, step, stack tensors."""
+    obs_buf, act_buf, logp_buf, val_buf, rew_buf, done_buf = [], [], [], [], [], []
+    for _ in range(steps):
+        with torch.no_grad():
+            logits, value = model(obs)
+            dist = torch.distributions.Categorical(logits=logits)
+            action = dist.sample()
+            logp = dist.log_prob(action)
+        nobs, reward, term, trunc, _ = env.step(int(action.item()))
+        obs_buf.append(obs)
+        act_buf.append(action)
+        logp_buf.append(logp)
+        val_buf.append(value.squeeze(-1))
+        rew_buf.append(torch.as_tensor([float(reward)]))
+        done = term or trunc
+        done_buf.append(torch.as_tensor([float(done)]))
+        if done:
+            nobs, _ = env.reset()
+        obs = torch.as_tensor(nobs, dtype=torch.float32).unsqueeze(0)
+    with torch.no_grad():
+        _, next_value = model(obs)
+    return (
+        obs,
+        torch.cat(obs_buf),
+        torch.cat(act_buf),
+        torch.cat(logp_buf),
+        torch.stack(val_buf),
+        torch.stack(rew_buf),
+        torch.stack(done_buf),
+        next_value.squeeze(-1),
+    )
+
+
+def bench_ppo():
+    env = gym.make("CartPole-v1")
+    obs, _ = env.reset(seed=42)
+    obs = torch.as_tensor(obs, dtype=torch.float32).unsqueeze(0)
+    model = ActorCritic(env.observation_space.shape[0], env.action_space.n)
+    opt = torch.optim.Adam(model.parameters(), lr=3e-4, eps=1e-5)
+    rollout, batch, epochs = 128, 64, 10
+
+    t0 = time.perf_counter()
+    for _ in range(TOTAL_STEPS // rollout):
+        obs, b_obs, b_act, b_logp, values, rewards, dones, next_value = _rollout_policy_phase(
+            env, model, obs, rollout
+        )
+        adv, returns = _gae(rewards, values, dones, next_value)
+        adv, returns = adv.reshape(-1), returns.reshape(-1)
+        for _ in range(epochs):
+            perm = torch.randperm(rollout)
+            for start in range(0, rollout, batch):
+                idx = perm[start : start + batch]
+                logits, value = model(b_obs[idx])
+                dist = torch.distributions.Categorical(logits=logits)
+                new_logp = dist.log_prob(b_act[idx])
+                ratio = torch.exp(new_logp - b_logp[idx])
+                mb_adv = adv[idx]
+                mb_adv = (mb_adv - mb_adv.mean()) / (mb_adv.std() + 1e-8)
+                pg = -torch.min(
+                    ratio * mb_adv, torch.clamp(ratio, 0.8, 1.2) * mb_adv
+                ).mean()
+                v_loss = 0.5 * (value.squeeze(-1) - returns[idx]).pow(2).mean()
+                loss = pg + 0.5 * v_loss
+                opt.zero_grad(set_to_none=True)
+                loss.backward()
+                nn.utils.clip_grad_norm_(model.parameters(), 0.5)
+                opt.step()
+    wall = time.perf_counter() - t0
+    env.close()
+    return {"metric": "ppo_cartpole_env_steps_per_sec", "value": round(TOTAL_STEPS / wall, 2),
+            "unit": "env-steps/sec", "harness": "torch-same-host", "wall_seconds": round(wall, 1)}
+
+
+def bench_a2c():
+    env = gym.make("CartPole-v1")
+    obs, _ = env.reset(seed=42)
+    obs = torch.as_tensor(obs, dtype=torch.float32).unsqueeze(0)
+    model = ActorCritic(env.observation_space.shape[0], env.action_space.n)
+    opt = torch.optim.RMSprop(model.parameters(), lr=7e-4, alpha=0.99, eps=1e-5)
+    rollout = 5
+
+    t0 = time.perf_counter()
+    for _ in range(TOTAL_STEPS // rollout):
+        obs, b_obs, b_act, _b_logp, values, rewards, dones, next_value = _rollout_policy_phase(
+            env, model, obs, rollout
+        )
+        adv, returns = _gae(rewards, values, dones, next_value)
+        logits, value = model(b_obs)
+        dist = torch.distributions.Categorical(logits=logits)
+        pg = -(dist.log_prob(b_act) * adv.reshape(-1).detach()).mean()
+        v_loss = (value.squeeze(-1) - returns.reshape(-1).detach()).pow(2).mean()
+        loss = pg + v_loss
+        opt.zero_grad(set_to_none=True)
+        loss.backward()
+        nn.utils.clip_grad_norm_(model.parameters(), 0.5)
+        opt.step()
+    wall = time.perf_counter() - t0
+    env.close()
+    return {"metric": "a2c_cartpole_env_steps_per_sec", "value": round(TOTAL_STEPS / wall, 2),
+            "unit": "env-steps/sec", "harness": "torch-same-host", "wall_seconds": round(wall, 1)}
+
+
+# --------------------------------------------------------------------- SAC
+class SACActor(nn.Module):
+    def __init__(self, obs_dim, act_dim, hidden=256):
+        super().__init__()
+        self.net = nn.Sequential(
+            nn.Linear(obs_dim, hidden), nn.ReLU(), nn.Linear(hidden, hidden), nn.ReLU()
+        )
+        self.mean = nn.Linear(hidden, act_dim)
+        self.log_std = nn.Linear(hidden, act_dim)
+
+    def forward(self, obs):
+        h = self.net(obs)
+        mean, log_std = self.mean(h), torch.clamp(self.log_std(h), -5, 2)
+        std = torch.exp(log_std)
+        normal = torch.distributions.Normal(mean, std)
+        x = normal.rsample()
+        action = torch.tanh(x)
+        logp = (normal.log_prob(x) - torch.log(1 - action.pow(2) + 1e-6)).sum(-1, keepdim=True)
+        return action, logp
+
+
+class SACCritic(nn.Module):
+    def __init__(self, obs_dim, act_dim, hidden=256):
+        super().__init__()
+        self.net = nn.Sequential(
+            nn.Linear(obs_dim + act_dim, hidden), nn.ReLU(),
+            nn.Linear(hidden, hidden), nn.ReLU(), nn.Linear(hidden, 1),
+        )
+
+    def forward(self, obs, act):
+        return self.net(torch.cat([obs, act], -1))
+
+
+def bench_sac():
+    num_envs, batch, hidden, learning_starts = 4, 256, 256, 100
+    env = gym.vector.SyncVectorEnv(
+        [lambda: gym.make("LunarLanderContinuous-v3") for _ in range(num_envs)]
+    )
+    obs_dim = env.single_observation_space.shape[0]
+    act_dim = env.single_action_space.shape[0]
+    actor = SACActor(obs_dim, act_dim, hidden)
+    q1, q2 = SACCritic(obs_dim, act_dim, hidden), SACCritic(obs_dim, act_dim, hidden)
+    q1_t, q2_t = SACCritic(obs_dim, act_dim, hidden), SACCritic(obs_dim, act_dim, hidden)
+    q1_t.load_state_dict(q1.state_dict())
+    q2_t.load_state_dict(q2.state_dict())
+    log_alpha = torch.zeros(1, requires_grad=True)
+    target_entropy = -float(act_dim)
+    actor_opt = torch.optim.Adam(actor.parameters(), lr=3e-4, eps=1e-5)
+    q_opt = torch.optim.Adam(list(q1.parameters()) + list(q2.parameters()), lr=3e-4, eps=1e-5)
+    alpha_opt = torch.optim.Adam([log_alpha], lr=3e-4, eps=1e-5)
+    gamma, tau = 0.99, 0.005
+
+    cap = TOTAL_STEPS + 1
+    buf_obs = np.zeros((cap, obs_dim), np.float32)
+    buf_nobs = np.zeros((cap, obs_dim), np.float32)
+    buf_act = np.zeros((cap, act_dim), np.float32)
+    buf_rew = np.zeros((cap, 1), np.float32)
+    buf_term = np.zeros((cap, 1), np.float32)
+    size = 0
+
+    obs, _ = env.reset(seed=42)
+    grad_debt = 0.0  # the Ratio scheduler: replay_ratio 1.0
+    t0 = time.perf_counter()
+    step = 0
+    while step < TOTAL_STEPS:
+        if step < learning_starts:
+            actions = env.action_space.sample()
+        else:
+            with torch.no_grad():
+                actions, _ = actor(torch.as_tensor(obs, dtype=torch.float32))
+            actions = actions.numpy()
+        nobs, rewards, terms, truncs, _ = env.step(actions)
+        for i in range(num_envs):
+            j = (size + i) % cap
+            buf_obs[j], buf_nobs[j], buf_act[j] = obs[i], nobs[i], actions[i]
+            buf_rew[j, 0], buf_term[j, 0] = rewards[i], float(terms[i])
+        size = min(size + num_envs, cap)
+        obs = nobs
+        step += num_envs
+
+        if step >= learning_starts:
+            grad_debt += num_envs  # replay_ratio 1.0: one grad step per policy step
+            grad_steps = int(grad_debt)
+            grad_debt -= grad_steps
+            if grad_steps > 0:
+                idx = np.random.randint(0, size, grad_steps * batch)
+                g_obs = torch.as_tensor(buf_obs[idx])
+                g_nobs = torch.as_tensor(buf_nobs[idx])
+                g_act = torch.as_tensor(buf_act[idx])
+                g_rew = torch.as_tensor(buf_rew[idx])
+                g_term = torch.as_tensor(buf_term[idx])
+                for k in range(grad_steps):
+                    sl = slice(k * batch, (k + 1) * batch)
+                    o, no, a, r, d = g_obs[sl], g_nobs[sl], g_act[sl], g_rew[sl], g_term[sl]
+                    alpha = log_alpha.exp().detach()
+                    with torch.no_grad():
+                        na, nlogp = actor(no)
+                        tq = torch.min(q1_t(no, na), q2_t(no, na)) - alpha * nlogp
+                        target = r + (1 - d) * gamma * tq
+                    q_loss = (q1(o, a) - target).pow(2).mean() + (q2(o, a) - target).pow(2).mean()
+                    q_opt.zero_grad(set_to_none=True)
+                    q_loss.backward()
+                    q_opt.step()
+                    with torch.no_grad():
+                        for t_p, p in zip(q1_t.parameters(), q1.parameters()):
+                            t_p.mul_(1 - tau).add_(tau * p)
+                        for t_p, p in zip(q2_t.parameters(), q2.parameters()):
+                            t_p.mul_(1 - tau).add_(tau * p)
+                    pa, plogp = actor(o)
+                    pq = torch.min(q1(o, pa), q2(o, pa))
+                    a_loss = (alpha * plogp - pq).mean()
+                    actor_opt.zero_grad(set_to_none=True)
+                    a_loss.backward()
+                    actor_opt.step()
+                    al_loss = (-log_alpha.exp() * (plogp.detach() + target_entropy)).mean()
+                    alpha_opt.zero_grad(set_to_none=True)
+                    al_loss.backward()
+                    alpha_opt.step()
+    wall = time.perf_counter() - t0
+    env.close()
+    return {"metric": "sac_env_steps_per_sec", "value": round(TOTAL_STEPS / wall, 2),
+            "unit": "env-steps/sec", "harness": "torch-same-host", "wall_seconds": round(wall, 1)}
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    workloads = {"ppo": bench_ppo, "a2c": bench_a2c, "sac": bench_sac}
+    names = list(workloads) if which == "all" else [which]
+    for name in names:
+        torch.manual_seed(42)
+        np.random.seed(42)
+        print(json.dumps(workloads[name]()), flush=True)
+
+
+if __name__ == "__main__":
+    main()

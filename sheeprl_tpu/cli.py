@@ -213,6 +213,13 @@ def run_algorithm(cfg: dotdict) -> None:
             cfg.fabric.devices = exploration_cfg.fabric.devices
             cfg.fabric.num_nodes = exploration_cfg.fabric.num_nodes
 
+    if cfg.get("xla_deterministic"):
+        # Reference: the reproducible() wrapper around every entrypoint
+        # (sheeprl/cli.py:187-197). Must precede launch(): XLA_FLAGS are
+        # read when the backend is constructed.
+        from sheeprl_tpu.core.runtime import enable_xla_determinism
+
+        enable_xla_determinism()
     runtime = instantiate(cfg.fabric)
     runtime.launch()
     runtime.seed_everything(cfg.seed)

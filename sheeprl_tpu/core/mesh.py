@@ -95,6 +95,17 @@ def split_player_trainer(mesh: Mesh, player_mode: str = "mesh", params: Any = No
             "the host with fabric.player_device=host to train on every device."
         )
     grid = mesh.devices.reshape(data_size, model_size)
+    if model_size > 1:
+        # The cost of the on-mesh player placement must be visible, not just
+        # documented: everything in row 0 except the player idles.
+        import warnings
+
+        warnings.warn(
+            f"Decoupled on-mesh split with model_axis={model_size}: the player takes "
+            f"grid[0,0] and the other {model_size - 1} device(s) of row 0 IDLE. "
+            "Use fabric.player_device=host to train on every device instead.",
+            UserWarning,
+        )
     trainer_mesh = build_mesh(devices=list(grid[1:].flat), model_axis_size=model_size)
     return grid[0, 0], trainer_mesh
 

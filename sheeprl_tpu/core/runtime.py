@@ -31,6 +31,51 @@ from sheeprl_tpu.core.prng import seed_everything
 _TPU_PLATFORMS = ("tpu", "axon")
 
 
+def force_cpu_platform(num_devices: Optional[int] = None, force: bool = False) -> None:
+    """Make this process CPU-only (optionally with a virtual n-device CPU
+    platform) via the explicit platform dance.
+
+    `jax.devices("cpu")` is not relay-proof: the first backend touch runs
+    `xla_bridge.backends()`, which initializes EVERY registered plugin — a
+    wedged accelerator relay (e.g. a dead tunnel) then hangs the process
+    inside plugin discovery with no timeout, even though only the CPU client
+    was asked for. The cure is this dance (clear_backends + config update)
+    before anything touches the backend; env-var-only selection does not
+    stop the plugin's discovery/connect. This helper is the ONE copy of the
+    dance — bench.py, scripts/validate_returns.py and __graft_entry__ all
+    call it.
+
+    With ``force=False`` the dance only runs while no backend exists yet:
+    once backends are built, clearing them would invalidate every live
+    jax.Array in the process (test suites construct many Runtimes
+    mid-session), and the accelerator plugin evidently initialized fine
+    anyway. ``force=True`` (script entrypoints that own the whole process,
+    or a device-count change) clears unconditionally — the caller asserts
+    no live arrays it cares about exist.
+    """
+    if not force:
+        try:
+            from jax._src import xla_bridge as _xb
+
+            initialized = bool(_xb._backends)
+        except Exception:
+            # Private-API drift: fall back to the public live-array census.
+            # No live arrays -> clearing can invalidate nothing (jit caches
+            # re-trace); live arrays -> backends exist, skip (the unsafe
+            # branch is clearing under live arrays, not hanging: a built
+            # backend already proved the plugin reachable).
+            initialized = bool(jax.live_arrays())
+        if initialized:
+            return
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from jax.extend import backend as _jeb
+
+    _jeb.clear_backends()
+    jax.config.update("jax_platforms", "cpu")
+    if num_devices is not None:
+        jax.config.update("jax_num_cpu_devices", int(num_devices))
+
+
 class DispatchThrottle:
     """Bound the number of in-flight async train dispatches.
 
@@ -61,33 +106,74 @@ class DispatchThrottle:
             jax.block_until_ready(self._queue.popleft())
 
 
-def user_compilation_cache_dir() -> Optional[str]:
-    """Per-user XLA compile-cache path, or None if it cannot be secured.
+def enable_xla_determinism() -> None:
+    """Process-wide determinism knob (``cfg.xla_deterministic``).
 
-    Under the user's own cache root (XDG), never a world-shared /tmp path:
-    a predictable shared directory would let another local user pre-create
-    it and plant poisoned serialized executables (CWE-379). Created 0700;
-    rejected if it exists but is not owned by us.
+    Reference semantics: the ``reproducible()`` wrapper
+    (sheeprl/cli.py:187-197) sets the CUBLAS workspace config,
+    ``cudnn.deterministic`` and ``torch.use_deterministic_algorithms``
+    before the entrypoint runs. The XLA analog, applied before the first
+    backend touch:
+
+    - **TPU/CPU**: XLA executables are deterministic by construction for a
+      fixed program (reductions are compiled tree-reductions, not atomics),
+      so the contract here is PRNG discipline — one root key, fold_in-only
+      streams (core/prng.py), which ``Runtime.seed_everything`` enforces —
+      plus stable compilation inputs (static shapes; no autotune lottery).
+    - **GPU** (JAX-on-CUDA completeness): ``--xla_gpu_deterministic_ops``
+      forces deterministic reductions/scatters and
+      ``--xla_gpu_autotune_level=0`` pins kernel selection. XLA_FLAGS is
+      read at backend construction, so this must run before any jax op;
+      appended here if absent.
+    - ``jax_threefry_partitionable`` makes random bits invariant to
+      sharding, so the same seed draws the same values whether a tensor
+      lives on 1 or 8 devices — determinism across mesh shapes, not just
+      across runs.
     """
-    import warnings
+    flags = "--xla_gpu_deterministic_ops=true --xla_gpu_autotune_level=0"
+    prev = os.environ.get("XLA_FLAGS", "")
+    if "xla_gpu_deterministic_ops" not in prev:
+        os.environ["XLA_FLAGS"] = (prev + " " + flags).strip()
+    jax.config.update("jax_threefry_partitionable", True)
 
+
+def secure_user_cache_dir(subdir: str = "") -> Optional[str]:
+    """A per-user 0700 cache directory under XDG, or None if unsecurable.
+
+    Never a world-shared /tmp path: a predictable shared directory would let
+    another local user pre-create it and plant poisoned content (CWE-379).
+    Created 0700; rejected if it exists but is not owned by us; an existing
+    user-owned dir with group/world bits is tightened in place (makedirs'
+    mode is umask-subject and not applied to pre-existing dirs). The ONE
+    copy of this dance — the XLA compile cache and bench.py's probe marker
+    both route through it.
+    """
     xdg = os.environ.get("XDG_CACHE_HOME") or os.path.join(os.path.expanduser("~"), ".cache")
-    cache_dir = os.path.join(xdg, "sheeprl_tpu", "jax")
+    cache_dir = os.path.join(xdg, "sheeprl_tpu", subdir) if subdir else os.path.join(xdg, "sheeprl_tpu")
     try:
         os.makedirs(cache_dir, mode=0o700, exist_ok=True)
-        if hasattr(os, "getuid") and os.stat(cache_dir).st_uid != os.getuid():
-            warnings.warn(
-                f"{cache_dir} is not owned by this user; persistent XLA compile cache "
-                "DISABLED (every run recompiles). Set XDG_CACHE_HOME or "
-                "JAX_COMPILATION_CACHE_DIR to a directory you own."
-            )
+        st = os.stat(cache_dir)
+        if hasattr(os, "getuid") and st.st_uid != os.getuid():
             return None
-    except OSError as e:
-        warnings.warn(
-            f"Cannot create {cache_dir} ({e}); persistent XLA compile cache DISABLED "
-            "(every run recompiles). Set XDG_CACHE_HOME or JAX_COMPILATION_CACHE_DIR."
-        )
+        if st.st_mode & 0o077:
+            os.chmod(cache_dir, 0o700)
+    except OSError:
         return None
+    return cache_dir
+
+
+def user_compilation_cache_dir() -> Optional[str]:
+    """Per-user XLA compile-cache path, or None (with a warning) if it
+    cannot be secured."""
+    import warnings
+
+    cache_dir = secure_user_cache_dir("jax")
+    if cache_dir is None:
+        warnings.warn(
+            "Cannot secure a per-user compile-cache dir; persistent XLA compile cache "
+            "DISABLED (every run recompiles). Set XDG_CACHE_HOME or "
+            "JAX_COMPILATION_CACHE_DIR to a directory you own."
+        )
     return cache_dir
 
 
@@ -123,6 +209,17 @@ class Runtime:
         """Initialize multi-host (if configured) and build the mesh."""
         if self._launched:
             return self
+        if self.accelerator == "cpu" or os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+            # A CPU-selected run (fabric.accelerator=cpu, or the user pinned
+            # JAX_PLATFORMS=cpu in the environment) must never wait on — or
+            # wedge against — an accelerator plugin it will not use. Thread
+            # the requested device count through so a multi-device CPU run
+            # (fabric.devices=N) gets its virtual N-device platform instead
+            # of failing on the default 1-device CPU client.
+            n = None
+            if self.requested_devices not in ("auto", -1, None):
+                n = int(self.requested_devices) * self.model_axis
+            force_cpu_platform(num_devices=n)
         if self.num_nodes > 1:
             # On TPU pods jax.distributed.initialize() auto-detects the
             # coordinator from platform metadata; no env var is required.

@@ -29,7 +29,15 @@ class MetricAggregatorException(Exception):
 
 
 class Metric:
-    """Minimal metric interface: update / compute / reset."""
+    """Minimal metric interface: update / compute / reset.
+
+    Metrics registered in a :class:`MetricAggregator` may additionally
+    implement the `_state()`/`_reduce()` protocol below so the aggregator
+    can batch every metric's cross-rank sync into ONE DCN all-gather; a
+    metric that only implements `compute()` still works — the aggregator
+    falls back to calling it directly (unbatched, and synced only if the
+    metric's own compute() handles it).
+    """
 
     def __init__(self, sync_on_compute: bool = False):
         self.sync_on_compute = sync_on_compute
@@ -236,7 +244,12 @@ class MetricAggregator:
             if jax.process_count() > 1:
                 from jax.experimental import multihost_utils
 
-                states = {k: np.asarray(m._state(), np.float64) for k, m in synced.items()}
+                states = {}
+                for k, m in synced.items():
+                    try:
+                        states[k] = np.asarray(m._state(), np.float64)
+                    except NotImplementedError:
+                        pass  # falls back to m.compute() below (unbatched)
                 gathered = multihost_utils.process_allgather(states)
                 n = jax.process_count()
                 synced_rows = {
@@ -244,7 +257,15 @@ class MetricAggregator:
                     for k, v in gathered.items()
                 }
         for k, v in self.metrics.items():
-            value = v._reduce(synced_rows[k]) if k in synced_rows else v._reduce([v._state()])
+            if k in synced_rows:
+                value = v._reduce(synced_rows[k])
+            else:
+                try:
+                    value = v._reduce([v._state()])
+                except NotImplementedError:
+                    # A custom metric implementing only the documented minimal
+                    # update/compute/reset interface.
+                    value = v.compute()
             if isinstance(value, float) and isnan(value):
                 continue
             reduced[k] = value

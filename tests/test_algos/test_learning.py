@@ -1,10 +1,11 @@
 """Learning-validation tests (VERDICT round 2, missing item 1): a silent
 sign error in a loss must fail the suite, not survive 296 dry-run tests.
 
-The PPO test always runs (minutes on CPU): PPO CartPole-v1 must reach the
-classic 475 solve bar. The data-parallel PPO, A2C, SAC, and DreamerV3
-validations take longer and are additionally gated behind
-SHEEPRL_SLOW_TESTS=1; run them (and record RESULTS.md) with
+PPO (on-policy), SAC and DroQ (off-policy) always run — together a few
+minutes on CPU, covering both loss families in the default suite. The
+data-parallel PPO, A2C, PPO-recurrent, Dreamer and P2E validations take
+many minutes each and are additionally gated behind SHEEPRL_SLOW_TESTS=1;
+run them (and record RESULTS.md) with
 `python scripts/validate_returns.py all`.
 """
 
@@ -17,19 +18,23 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspa
 
 from scripts.validate_returns import (  # noqa: E402
     validate_a2c,
+    validate_dreamer_v1,
     validate_dreamer_v2,
+    validate_dreamer_v2_bf16,
+    validate_dreamer_v3,
+    validate_dreamer_v3_bf16,
     validate_droq,
     validate_p2e_dv3,
-    validate_ppo_recurrent,
-    validate_dreamer_v3,
     validate_ppo,
+    validate_ppo_recurrent,
     validate_sac,
+    validate_sac_ae,
+    validate_sac_decoupled,
 )
 
 _RUN_SLOW = os.environ.get("SHEEPRL_SLOW_TESTS", "") == "1"
 
 
-@pytest.mark.slow
 def test_ppo_learns_cartpole():
     r = validate_ppo()
     assert r["mean_return"] >= r["threshold"], (
@@ -68,18 +73,18 @@ def test_ppo_recurrent_learns_masked_cartpole():
     )
 
 
-@pytest.mark.slow
-@pytest.mark.skipif(not _RUN_SLOW, reason="set SHEEPRL_SLOW_TESTS=1 to run")
 def test_sac_learns_pendulum():
+    # Ungated (VERDICT r3 weak #5): ~51 s on the 1-core host — cheap enough
+    # for the default suite to catch off-policy loss regressions. No `slow`
+    # marker: `-m "not slow"` must not deselect the loss-regression guard.
     r = validate_sac()
     assert r["mean_return"] >= r["threshold"], (
         f"SAC stopped learning: {r['mean_return']:.1f} < {r['threshold']} ({r['returns']})"
     )
 
 
-@pytest.mark.slow
-@pytest.mark.skipif(not _RUN_SLOW, reason="set SHEEPRL_SLOW_TESTS=1 to run")
 def test_droq_learns_pendulum():
+    # Ungated (VERDICT r3 weak #5): ~113 s on the 1-core host.
     r = validate_droq()
     assert r["mean_return"] >= r["threshold"], (
         f"DroQ stopped learning: {r['mean_return']:.1f} < {r['threshold']} ({r['returns']})"
@@ -94,6 +99,58 @@ def test_p2e_dv3_chain_learns_cartpole():
     r = validate_p2e_dv3()
     assert r["mean_return"] >= r["threshold"], (
         f"P2E chain stopped learning: {r['mean_return']:.1f} < {r['threshold']} ({r['returns']})"
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _RUN_SLOW, reason="set SHEEPRL_SLOW_TESTS=1 to run")
+def test_sac_decoupled_learns_pendulum():
+    """The decoupled player/trainer split must LEARN on the 2-device mesh
+    (weight mirror freshness + buffer routing), not just dry-run."""
+    r = validate_sac_decoupled()
+    assert r["mean_return"] >= r["threshold"], (
+        f"decoupled SAC stopped learning: {r['mean_return']:.1f} < {r['threshold']} ({r['returns']})"
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _RUN_SLOW, reason="set SHEEPRL_SLOW_TESTS=1 to run")
+def test_sac_ae_learns_pendulum_pixels():
+    """SAC from pixels through the conv autoencoder (hours on CPU)."""
+    r = validate_sac_ae()
+    assert r["mean_return"] >= r["threshold"], (
+        f"SAC-AE stopped learning: {r['mean_return']:.1f} < {r['threshold']} ({r['returns']})"
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _RUN_SLOW, reason="set SHEEPRL_SLOW_TESTS=1 to run")
+def test_dreamer_v1_learns_cartpole():
+    """The continuous-latent RSSM (DV1) must learn, not just compile."""
+    r = validate_dreamer_v1()
+    assert r["mean_return"] >= r["threshold"], (
+        f"DreamerV1 stopped learning: {r['mean_return']:.1f} < {r['threshold']} ({r['returns']})"
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _RUN_SLOW, reason="set SHEEPRL_SLOW_TESTS=1 to run")
+def test_dreamer_v3_learns_cartpole_bf16():
+    """bf16-mixed (the TPU recipe default) must preserve learning."""
+    r = validate_dreamer_v3_bf16()
+    assert r["mean_return"] >= r["threshold"], (
+        f"DreamerV3 bf16-mixed stopped learning: {r['mean_return']:.1f} < {r['threshold']} ({r['returns']})"
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _RUN_SLOW, reason="set SHEEPRL_SLOW_TESTS=1 to run")
+def test_dreamer_v2_learns_cartpole_bf16():
+    """DV2's KL-balanced objective gets its own bf16 proof (its recipes
+    also default to bf16-mixed)."""
+    r = validate_dreamer_v2_bf16()
+    assert r["mean_return"] >= r["threshold"], (
+        f"DreamerV2 bf16-mixed stopped learning: {r['mean_return']:.1f} < {r['threshold']} ({r['returns']})"
     )
 
 
