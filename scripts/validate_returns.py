@@ -673,7 +673,6 @@ VALIDATORS = {
     "ppo_recurrent": validate_ppo_recurrent,
     "sac": validate_sac,
     "sac_decoupled": validate_sac_decoupled,
-    "sac_ae": validate_sac_ae,
     "droq": validate_droq,
     "dreamer_v1": validate_dreamer_v1,
     "dreamer_v2": validate_dreamer_v2,
@@ -681,10 +680,13 @@ VALIDATORS = {
     "dreamer_v3": validate_dreamer_v3,
     "dreamer_v3_bf16": validate_dreamer_v3_bf16,
     "p2e_dv3": validate_p2e_dv3,
+    # Last on purpose: ~4-5 h on this host — a crash in any cheaper
+    # validator must surface before the pixel run starts.
+    "sac_ae": validate_sac_ae,
 }
 
 
-def _write_results(results) -> None:
+def _write_results(results, crashed=()) -> None:
     path = os.path.join(_REPO, "RESULTS.md")
     lines = [
         "# RESULTS — learning validation (CPU)",
@@ -707,6 +709,10 @@ def _write_results(results) -> None:
             f"| **{r['mean_return']:.1f}** | {r['threshold']} | ~{r.get('untrained', '?')} "
             f"| {'✅' if ok else '❌'} |"
         )
+    for name in crashed:
+        # A crashed validator must be a visible red row, not a silent
+        # omission under the narrative below.
+        lines.append(f"| {name} | — | — | — | **CRASHED** | — | — | ❌ |")
     lines += [
         "",
         "Per-episode returns:",
@@ -752,14 +758,25 @@ def main() -> None:
         sys.exit(f"unknown validator {which!r}; choose from {sorted(VALIDATORS)} or 'all'")
     names = list(VALIDATORS) if which == "all" else [which]
     results = []
+    crashed = []
     for name in names:
-        r = VALIDATORS[name]()
+        try:
+            r = VALIDATORS[name]()
+        except Exception as e:  # an `all` sweep must not lose hours to one crash
+            if which != "all":
+                raise
+            import traceback
+
+            traceback.print_exc()
+            crashed.append(name)
+            print(f"{name}: CRASHED ({type(e).__name__}: {e})", flush=True)
+            continue
         status = "PASS" if r["mean_return"] >= r["threshold"] else "FAIL"
-        print(f"{name}: mean_return={r['mean_return']:.1f} (threshold {r['threshold']}) {status}")
+        print(f"{name}: mean_return={r['mean_return']:.1f} (threshold {r['threshold']}) {status}", flush=True)
         results.append(r)
     if which == "all":
-        _write_results(results)
-    if any(r["mean_return"] < r["threshold"] for r in results):
+        _write_results(results, crashed)
+    if crashed or any(r["mean_return"] < r["threshold"] for r in results):
         sys.exit(1)
 
 

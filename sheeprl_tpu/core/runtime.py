@@ -53,6 +53,7 @@ def force_cpu_platform(num_devices: Optional[int] = None, force: bool = False) -
     or a device-count change) clears unconditionally — the caller asserts
     no live arrays it cares about exist.
     """
+    global _CPU_PIN_BY_US
     if not force:
         try:
             from jax._src import xla_bridge as _xb
@@ -67,6 +68,8 @@ def force_cpu_platform(num_devices: Optional[int] = None, force: bool = False) -
             initialized = bool(jax.live_arrays())
         if initialized:
             return
+    if os.environ.get("JAX_PLATFORMS", "").strip() != "cpu":
+        _CPU_PIN_BY_US = True  # ours, not the user's: a later TPU launch may undo it
     os.environ["JAX_PLATFORMS"] = "cpu"
     from jax.extend import backend as _jeb
 
@@ -74,6 +77,24 @@ def force_cpu_platform(num_devices: Optional[int] = None, force: bool = False) -
     jax.config.update("jax_platforms", "cpu")
     if num_devices is not None:
         jax.config.update("jax_num_cpu_devices", int(num_devices))
+
+
+_CPU_PIN_BY_US = False
+
+
+def _unpin_cpu_platform_for_accelerator() -> None:
+    """Undo a CPU pin *we* made, so a cpu-launch-then-tpu-launch sequence in
+    one process still reaches the accelerator. Only possible while no
+    arrays are alive (unpinning rebuilds backends); with live arrays the
+    first launch's platform owns the process and the TPU launch fails with
+    the ordinary 'no TPU devices visible' error."""
+    if not _CPU_PIN_BY_US or jax.config.jax_platforms != "cpu" or jax.live_arrays():
+        return
+    os.environ.pop("JAX_PLATFORMS", None)
+    from jax.extend import backend as _jeb
+
+    _jeb.clear_backends()
+    jax.config.update("jax_platforms", "")
 
 
 class DispatchThrottle:
@@ -130,10 +151,16 @@ def enable_xla_determinism() -> None:
       lives on 1 or 8 devices — determinism across mesh shapes, not just
       across runs.
     """
-    flags = "--xla_gpu_deterministic_ops=true --xla_gpu_autotune_level=0"
-    prev = os.environ.get("XLA_FLAGS", "")
-    if "xla_gpu_deterministic_ops" not in prev:
-        os.environ["XLA_FLAGS"] = (prev + " " + flags).strip()
+    # Drop any pre-existing settings of these two flags (whatever their
+    # value — "=false" must not survive a determinism request), then append
+    # the deterministic ones.
+    kept = [
+        tok
+        for tok in os.environ.get("XLA_FLAGS", "").split()
+        if not tok.startswith(("--xla_gpu_deterministic_ops", "--xla_gpu_autotune_level"))
+    ]
+    kept += ["--xla_gpu_deterministic_ops=true", "--xla_gpu_autotune_level=0"]
+    os.environ["XLA_FLAGS"] = " ".join(kept)
     jax.config.update("jax_threefry_partitionable", True)
 
 
@@ -220,6 +247,8 @@ class Runtime:
             if self.requested_devices not in ("auto", -1, None):
                 n = int(self.requested_devices) * self.model_axis
             force_cpu_platform(num_devices=n)
+        elif self.accelerator in _TPU_PLATFORMS:
+            _unpin_cpu_platform_for_accelerator()
         if self.num_nodes > 1:
             # On TPU pods jax.distributed.initialize() auto-detects the
             # coordinator from platform metadata; no env var is required.

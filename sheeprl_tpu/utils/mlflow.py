@@ -301,9 +301,11 @@ class MlflowModelManager(AbstractModelManager):
         best_run = runs[0]
         registered: Dict[str, ModelVersion] = {}
         for key, info in models_info.items():
+            # Reference contract (mlflow.py:276): the entry's registry name
+            # is under "name"; "model_name" accepted as an alias.
             registered[key] = self.register_model(
                 f"runs:/{best_run.info.run_id}/{info.get('path', key)}",
-                info["model_name"],
+                info["name"] if "name" in info else info["model_name"],
                 info.get("description"),
                 info.get("tags"),
             )

@@ -1,0 +1,64 @@
+"""Round-4 relay-armor infrastructure: secure_user_cache_dir and
+force_cpu_platform (core/runtime.py), and bench.py's probe-verdict cache.
+
+The wedged-relay hang itself cannot be reproduced on the CPU suite; what is
+pinned here is the safety envelope: the no-op guarantee of the conditional
+dance when backends already exist (clearing them would invalidate every
+live array in this very test process), and the 0700/ownership discipline of
+the per-user cache dirs.
+"""
+
+import os
+import stat
+import sys
+
+import jax
+import pytest
+
+from sheeprl_tpu.core.runtime import force_cpu_platform, secure_user_cache_dir
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+
+def test_secure_user_cache_dir_creates_0700(tmp_path, monkeypatch):
+    monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path))
+    d = secure_user_cache_dir("jax")
+    assert d == str(tmp_path / "sheeprl_tpu" / "jax")
+    assert stat.S_IMODE(os.stat(d).st_mode) == 0o700
+
+
+def test_secure_user_cache_dir_tightens_existing_mode(tmp_path, monkeypatch):
+    monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path))
+    loose = tmp_path / "sheeprl_tpu"
+    loose.mkdir(mode=0o755)
+    d = secure_user_cache_dir()
+    assert d == str(loose)
+    assert stat.S_IMODE(os.stat(d).st_mode) == 0o700
+
+
+def test_force_cpu_platform_is_noop_when_backends_exist():
+    # The suite's conftest already built the 8-device CPU platform; the
+    # conditional dance must NOT clear it (live arrays all over the suite).
+    before = jax.devices()
+    arr = jax.numpy.ones((4,)) + 1  # a live array the dance must not kill
+    force_cpu_platform()
+    assert jax.devices() == before
+    assert float(arr.sum()) == 8.0
+
+
+def test_probe_marker_round_trip(tmp_path, monkeypatch):
+    monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path))
+    import bench
+
+    marker = bench._probe_marker_path()
+    assert marker and marker.startswith(str(tmp_path))
+    # Simulate a cached verdict and confirm the probe short-circuits on it.
+    with open(marker, "w") as fp:
+        fp.write("0")
+    assert bench._accelerator_reachable(timeout_s=1) is False
+    with open(marker, "w") as fp:
+        fp.write("1")
+    assert bench._accelerator_reachable(timeout_s=1) is True
+    # The env override beats the marker.
+    monkeypatch.setenv("SHEEPRL_ACCEL_REACHABLE", "0")
+    assert bench._accelerator_reachable(timeout_s=1) is False

@@ -1,0 +1,129 @@
+"""MetricAggregator unit tests (reference surface: sheeprl/utils/metric.py
+17-196 — torchmetrics-backed there, host-numpy accumulators here).
+
+Covers the reduce semantics of every built-in metric, the NaN-drop rule,
+the disabled flag, log_and_reset, the RankIndependent wrapper on a single
+process, and the round-4 fallback: a custom metric implementing only the
+documented minimal update/compute/reset interface (no _state/_reduce
+batched-sync protocol) must still compute through an aggregator.
+"""
+
+import math
+
+import pytest
+
+from sheeprl_tpu.utils.metric import (
+    LastMetric,
+    MaxMetric,
+    MeanMetric,
+    Metric,
+    MetricAggregator,
+    MetricAggregatorException,
+    MinMetric,
+    RankIndependentMetricAggregator,
+    SumMetric,
+)
+
+
+class OnlyComputeMetric(Metric):
+    """The minimal documented interface: no _state()/_reduce()."""
+
+    def update(self, value):
+        self._values.append(float(value))
+
+    def compute(self):
+        return max(self._values) if self._values else float("nan")
+
+    def reset(self):
+        self._values = []
+
+
+def test_builtin_metric_semantics():
+    m = MeanMetric()
+    m.update([1.0, 2.0, 3.0])
+    m.update(5.0)
+    assert m.compute() == pytest.approx(11.0 / 4)
+
+    s = SumMetric()
+    s.update([1.0, 2.0])
+    s.update(3.0)
+    assert s.compute() == pytest.approx(6.0)
+
+    mx, mn = MaxMetric(), MinMetric()
+    for v in (3.0, -1.0, 7.0):
+        mx.update(v)
+        mn.update(v)
+    assert mx.compute() == 7.0
+    assert mn.compute() == -1.0
+
+    last = LastMetric()
+    last.update(2.0)
+    last.update(9.0)
+    assert last.compute() == 9.0
+
+
+def test_aggregator_compute_and_nan_drop():
+    agg = MetricAggregator({"mean": MeanMetric(), "empty": MeanMetric()})
+    agg.update("mean", 4.0)
+    out = agg.compute()
+    # The untouched metric reduces to NaN and is dropped, not reported.
+    assert out == {"mean": 4.0}
+
+
+def test_aggregator_falls_back_to_compute_only_metric():
+    agg = MetricAggregator({"custom": OnlyComputeMetric(), "mean": MeanMetric()})
+    agg.update("custom", 3.5)
+    agg.update("custom", 1.0)
+    agg.update("mean", 2.0)
+    assert agg.compute() == {"custom": 3.5, "mean": 2.0}
+
+
+def test_aggregator_reset_and_log_and_reset():
+    logged = {}
+
+    class Logger:
+        def log_dict(self, metrics, step):
+            logged.update({"step": step, **metrics})
+
+    agg = MetricAggregator({"mean": MeanMetric()})
+    agg.update("mean", 2.0)
+    out = agg.log_and_reset(Logger(), step=7)
+    assert out == {"mean": 2.0}
+    assert logged == {"step": 7, "mean": 2.0}
+    # After the reset, the accumulator is empty -> NaN -> dropped.
+    assert agg.compute() == {}
+
+
+def test_aggregator_unknown_key_warns_and_raise_mode():
+    agg = MetricAggregator({"mean": MeanMetric()})
+    with pytest.warns(UserWarning):
+        agg.update("nope", 1.0)
+    strict = MetricAggregator({"mean": MeanMetric()}, raise_on_missing=True)
+    with pytest.raises(MetricAggregatorException):
+        strict.update("nope", 1.0)
+
+
+def test_aggregator_disabled_is_inert():
+    MetricAggregator.disabled = True
+    try:
+        agg = MetricAggregator({"mean": MeanMetric()})
+        agg.update("mean", 1.0)
+        assert agg.compute() == {}
+    finally:
+        MetricAggregator.disabled = False
+
+
+def test_rank_independent_single_process():
+    ria = RankIndependentMetricAggregator({"sum": SumMetric()})
+    ria.update("sum", 2.0)
+    ria.update("sum", 3.0)
+    out = ria.compute()
+    assert out == [{"sum": 5.0}]
+    ria.reset()
+    # A reset Sum is legitimately 0.0 (only NaN results are dropped).
+    assert ria.compute() == [{"sum": 0.0}]
+
+
+def test_last_metric_nan_until_first_update():
+    last = LastMetric()
+    assert math.isnan(last._state()[0])
