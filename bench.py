@@ -221,17 +221,26 @@ def bench_sac():
     )
 
 
+def _accel_precision() -> str:
+    """bf16-mixed on an accelerator (the TPU recipe default, PROFILE.md A/B);
+    32-true on a CPU fallback — XLA:CPU bf16 is emulation, and the reference
+    CPU baselines are fp32, so the fallback stays apples-to-apples."""
+    import jax
+
+    return "bf16-mixed" if jax.default_backend() != "cpu" else "32-true"
+
+
 def _bench_dreamer(version: str, baseline_seconds: float):
     # Off-policy: async weight mirror (see bench_sac). Precision is passed
-    # explicitly (it matches the benchmark exp default) so the result JSON
-    # records the semantics the number was measured under.
+    # explicitly so the result JSON records the semantics the number was
+    # measured under.
     return _timeboxed(
         f"dreamer_v{version}_env_steps_per_sec",
         f"dreamer_v{version}_benchmarks",
         16384,
         16384 / baseline_seconds,
         learning_starts=1024,
-        extra=("fabric.player_sync=async", "fabric.precision=bf16-mixed"),
+        extra=("fabric.player_sync=async", f"fabric.precision={_accel_precision()}"),
     )
 
 
@@ -270,7 +279,7 @@ def bench_dreamer_v3_S(batch: int = None):
         "buffer.memmap=False",
         "buffer.prefetch=True",
         "fabric.player_sync=async",
-        "fabric.precision=bf16-mixed",
+        f"fabric.precision={_accel_precision()}",
         "metric.log_level=0",
         "metric.disable_timer=True",
     ]

@@ -686,6 +686,27 @@ VALIDATORS = {
 }
 
 
+_CACHE_PATH = os.path.join(_REPO, "validate_results.json")
+
+
+def _load_cache() -> dict:
+    import json
+
+    try:
+        with open(_CACHE_PATH) as fp:
+            return json.load(fp)
+    except (OSError, ValueError):
+        return {}
+
+
+def _save_cache(cache: dict) -> None:
+    import json
+
+    with open(_CACHE_PATH, "w") as fp:
+        json.dump(cache, fp, indent=1, sort_keys=True)
+        fp.write("\n")
+
+
 def _write_results(results, crashed=()) -> None:
     path = os.path.join(_REPO, "RESULTS.md")
     lines = [
@@ -757,6 +778,8 @@ def main() -> None:
     if which != "all" and which not in VALIDATORS:
         sys.exit(f"unknown validator {which!r}; choose from {sorted(VALIDATORS)} or 'all'")
     names = list(VALIDATORS) if which == "all" else [which]
+    cache = _load_cache()
+    had_cache = bool(cache)
     results = []
     crashed = []
     for name in names:
@@ -769,13 +792,25 @@ def main() -> None:
 
             traceback.print_exc()
             crashed.append(name)
+            # Evict any stale success: the CRASHED row must not coexist
+            # with an old PASS row for the same validator.
+            if cache.pop(name, None) is not None:
+                _save_cache(cache)
             print(f"{name}: CRASHED ({type(e).__name__}: {e})", flush=True)
             continue
         status = "PASS" if r["mean_return"] >= r["threshold"] else "FAIL"
         print(f"{name}: mean_return={r['mean_return']:.1f} (threshold {r['threshold']}) {status}", flush=True)
         results.append(r)
-    if which == "all":
-        _write_results(results, crashed)
+        # Persist per-validator so a subset re-run (after a fix, or after a
+        # crash killed an `all` sweep) refreshes just its rows.
+        cache[name] = r
+        _save_cache(cache)
+    # Regenerate RESULTS.md from the union of everything validated so far
+    # (canonical validator order). A subset run with no prior cache must
+    # not clobber a committed full table with a one-row one.
+    if which == "all" or had_cache:
+        rows = [cache[n] for n in VALIDATORS if n in cache]
+        _write_results(rows, crashed)
     if crashed or any(r["mean_return"] < r["threshold"] for r in results):
         sys.exit(1)
 

@@ -45,7 +45,7 @@ from sheeprl_tpu.utils.distribution import (
     Normal,
     OneHotCategorical,
 )
-from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.env import make_env, seed_vector_spaces
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.timer import timer
@@ -420,6 +420,7 @@ def main(runtime, cfg: Dict[str, Any]):
         ],
         autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
     )
+    seed_vector_spaces(envs, cfg.seed + rank * cfg.env.num_envs)
     action_space = envs.single_action_space
     observation_space = envs.single_observation_space
 

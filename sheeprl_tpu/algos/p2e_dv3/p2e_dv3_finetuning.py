@@ -35,7 +35,7 @@ from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.core.runtime import DispatchThrottle
 from sheeprl_tpu.registry import register_algorithm
 from sheeprl_tpu.utils.checkpoint import load_checkpoint, restore_opt_state, save_checkpoint
-from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.env import make_env, seed_vector_spaces
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.ops import init_moments
@@ -104,6 +104,7 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
         ],
         autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
     )
+    seed_vector_spaces(envs, cfg.seed + rank * cfg.env.num_envs)
     action_space = envs.single_action_space
     observation_space = envs.single_observation_space
 

@@ -35,7 +35,7 @@ from sheeprl_tpu.core.mesh import DATA_AXIS, split_player_trainer
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.registry import register_algorithm
 from sheeprl_tpu.utils.checkpoint import load_checkpoint, restore_opt_state, save_checkpoint
-from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.env import make_env, seed_vector_spaces
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.ops import gae
@@ -79,6 +79,7 @@ def main(runtime, cfg: Dict[str, Any]):
         ],
         autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
     )
+    seed_vector_spaces(envs, cfg.seed + rank * cfg.env.num_envs)
     observation_space = envs.single_observation_space
     if not isinstance(observation_space, gym.spaces.Dict):
         raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")

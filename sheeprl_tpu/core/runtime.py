@@ -68,8 +68,12 @@ def force_cpu_platform(num_devices: Optional[int] = None, force: bool = False) -
             initialized = bool(jax.live_arrays())
         if initialized:
             return
+    global _PRE_PIN_JAX_PLATFORMS
     if os.environ.get("JAX_PLATFORMS", "").strip() != "cpu":
-        _CPU_PIN_BY_US = True  # ours, not the user's: a later TPU launch may undo it
+        # Ours, not the user's: a later TPU launch may undo it — restoring
+        # the user's pre-pin value, not deleting it.
+        _CPU_PIN_BY_US = True
+        _PRE_PIN_JAX_PLATFORMS = os.environ.get("JAX_PLATFORMS")
     os.environ["JAX_PLATFORMS"] = "cpu"
     from jax.extend import backend as _jeb
 
@@ -80,6 +84,7 @@ def force_cpu_platform(num_devices: Optional[int] = None, force: bool = False) -
 
 
 _CPU_PIN_BY_US = False
+_PRE_PIN_JAX_PLATFORMS = None
 
 
 def _unpin_cpu_platform_for_accelerator() -> None:
@@ -90,11 +95,14 @@ def _unpin_cpu_platform_for_accelerator() -> None:
     the ordinary 'no TPU devices visible' error."""
     if not _CPU_PIN_BY_US or jax.config.jax_platforms != "cpu" or jax.live_arrays():
         return
-    os.environ.pop("JAX_PLATFORMS", None)
+    if _PRE_PIN_JAX_PLATFORMS is None:
+        os.environ.pop("JAX_PLATFORMS", None)
+    else:
+        os.environ["JAX_PLATFORMS"] = _PRE_PIN_JAX_PLATFORMS
     from jax.extend import backend as _jeb
 
     _jeb.clear_backends()
-    jax.config.update("jax_platforms", "")
+    jax.config.update("jax_platforms", _PRE_PIN_JAX_PLATFORMS or "")
 
 
 class DispatchThrottle:

@@ -224,6 +224,21 @@ def get_dummy_env(id: str, **kwargs: Any) -> gym.Env:
     raise ValueError(f"Unrecognized dummy environment: {id}")
 
 
+def seed_vector_spaces(envs: gym.vector.VectorEnv, seed: int) -> None:
+    """Seed the VECTOR env's batched action/observation spaces.
+
+    make_env seeds each sub-env's own spaces, but gym.vector builds
+    separate *batched* Space objects whose RNG is seeded from OS entropy —
+    so `envs.action_space.sample()` (the prefill path of every off-policy
+    algorithm) was the one nondeterministic draw left in a seeded run,
+    making borderline learning validations flap run to run.
+
+    New code should construct vector envs through :func:`make_vector_env`
+    (which calls this); the in-algorithm construction sites predate it."""
+    envs.action_space.seed(seed)
+    envs.observation_space.seed(seed)
+
+
 def make_vector_env(
     cfg: Dict[str, Any],
     seed: int,
@@ -237,6 +252,7 @@ def make_vector_env(
         make_env(cfg, seed + rank * cfg.env.num_envs + i, rank, run_name, prefix, vector_env_idx=i)
         for i in range(cfg.env.num_envs)
     ]
-    if cfg.env.sync_env:
-        return gym.vector.SyncVectorEnv(thunks, autoreset_mode=gym.vector.AutoresetMode.SAME_STEP)
-    return gym.vector.AsyncVectorEnv(thunks, autoreset_mode=gym.vector.AutoresetMode.SAME_STEP)
+    cls = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
+    envs = cls(thunks, autoreset_mode=gym.vector.AutoresetMode.SAME_STEP)
+    seed_vector_spaces(envs, seed + rank * cfg.env.num_envs)
+    return envs
