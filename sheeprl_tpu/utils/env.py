@@ -62,6 +62,23 @@ def make_env(
             instantiate_kwargs["rank"] = rank + vector_env_idx
         env = instantiate(cfg.env.wrapper, **instantiate_kwargs)
 
+        if isinstance(env.action_space, gym.spaces.Box):
+            low, high = env.action_space.low, env.action_space.high
+            if (
+                np.all(np.isfinite(low))
+                and np.all(np.isfinite(high))
+                and np.all(high > low)  # degenerate dims would rescale to NaN
+                and (np.any(low != -1.0) or np.any(high != 1.0))
+            ):
+                # Present every continuous env as [-1, 1] (divergence from
+                # the reference, which only ever runs continuous control on
+                # DMC where bounds are natively [-1, 1]): tanh-squashed
+                # policies (the Dreamer actors) otherwise silently command
+                # a fraction of the env's torque range — Pendulum's [-2, 2]
+                # made swing-up unlearnable. SAC is unaffected: its
+                # scale/bias are computed from the (rescaled) space.
+                env = gym.wrappers.RescaleAction(env, -1.0, 1.0)
+
         is_atari = "AtariPreprocessing" in str(cfg.env.wrapper.get("_target_", ""))
         if cfg.env.action_repeat > 1 and not is_atari:
             # Atari frame skip lives inside AtariPreprocessing already.
