@@ -15,9 +15,20 @@ import numpy as np
 
 
 def seed_everything(seed: int) -> jax.Array:
-    """Seed python/numpy host RNGs and return the root JAX key."""
-    random.seed(seed)
-    np.random.seed(seed)
+    """Seed python/numpy host RNGs and return the root JAX key.
+
+    The HOST streams (python/numpy — replay sampling, env glue) fold in the
+    process index so multi-host ranks draw distinct sequences; the returned
+    JAX root key deliberately does NOT — model initialization must be
+    identical on every rank (algorithms derive per-rank jax streams
+    explicitly via fold_in where divergence is wanted).
+    """
+    try:
+        rank = jax.process_index()
+    except Exception:  # backend not initialized yet: single-process semantics
+        rank = 0
+    random.seed(seed + rank)
+    np.random.seed(seed + rank)
     return jax.random.PRNGKey(seed)
 
 

@@ -108,7 +108,13 @@ class ReplayBuffer:
         self._buf: Dict[str, Any] = {}
         self._pos = 0
         self._full = False
-        self._rng = np.random.default_rng()
+        # Deterministic under seed_everything: derive the sampling stream
+        # from the (seeded, rank-folded) global RNG instead of OS entropy —
+        # an unseeded default_rng() made replay sampling the last
+        # nondeterministic draw in a seeded run. Reproducibility therefore
+        # tracks buffer CONSTRUCTION ORDER; call .seed(n) for a stream
+        # pinned independently of it.
+        self._rng = np.random.default_rng(np.random.randint(0, 2**31))
 
     # ----------------------------------------------------------- properties
     @property
@@ -392,7 +398,13 @@ class EnvIndependentReplayBuffer:
         ]
         self._buffer_size = buffer_size
         self._n_envs = n_envs
-        self._rng = np.random.default_rng()
+        # Deterministic under seed_everything: derive the sampling stream
+        # from the (seeded, rank-folded) global RNG instead of OS entropy —
+        # an unseeded default_rng() made replay sampling the last
+        # nondeterministic draw in a seeded run. Reproducibility therefore
+        # tracks buffer CONSTRUCTION ORDER; call .seed(n) for a stream
+        # pinned independently of it.
+        self._rng = np.random.default_rng(np.random.randint(0, 2**31))
         self._concat_along_axis = buffer_cls.batch_axis
 
     @property
@@ -525,7 +537,13 @@ class EpisodeBuffer:
         self._open_episodes: List[List[Dict[str, np.ndarray]]] = [[] for _ in range(n_envs)]
         self._cum_lengths: List[int] = []
         self._buf: List[Dict[str, Any]] = []
-        self._rng = np.random.default_rng()
+        # Deterministic under seed_everything: derive the sampling stream
+        # from the (seeded, rank-folded) global RNG instead of OS entropy —
+        # an unseeded default_rng() made replay sampling the last
+        # nondeterministic draw in a seeded run. Reproducibility therefore
+        # tracks buffer CONSTRUCTION ORDER; call .seed(n) for a stream
+        # pinned independently of it.
+        self._rng = np.random.default_rng(np.random.randint(0, 2**31))
 
     # ----------------------------------------------------------- properties
     @property

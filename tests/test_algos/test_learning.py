@@ -137,8 +137,9 @@ def test_sac_ae_learns_pendulum_pixels():
 
 @pytest.mark.slow
 @pytest.mark.skipif(not _RUN_SLOW, reason="set SHEEPRL_SLOW_TESTS=1 to run")
-def test_dreamer_v1_learns_cartpole():
-    """The continuous-latent RSSM (DV1) must learn, not just compile."""
+def test_dreamer_v1_learns_pendulum():
+    """The continuous-latent RSSM (DV1) must learn its native
+    continuous-control class (Pendulum), not just compile."""
     r = validate_dreamer_v1()
     assert r["mean_return"] >= r["threshold"], (
         f"DreamerV1 stopped learning: {r['mean_return']:.1f} < {r['threshold']} ({r['returns']})"
