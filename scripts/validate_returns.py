@@ -46,8 +46,10 @@ def _setup_jax(num_cpu_devices: int = None) -> None:
     # process, so each _setup_jax clears the previous validator's backend —
     # safe because no validator holds jax arrays across _setup_jax calls
     # (each trains, checkpoints to disk, and evals within its own body).
-    # num_devices always pinned (default 1) so a multi-device validator
-    # (ppo_dp, sac_decoupled) never leaks its device count into the next.
+    # num_devices is a MINIMUM (force_cpu_platform semantics): a platform
+    # grown to 2 devices by ppo_dp/sac_decoupled stays at 2 for later
+    # validators — harmless, as every validator pins fabric.devices
+    # explicitly and trains on exactly the devices it requests.
     from sheeprl_tpu.core.runtime import force_cpu_platform
 
     force_cpu_platform(num_devices=int(num_cpu_devices or 1), force=True)
@@ -726,13 +728,14 @@ def _write_results(results, crashed=()) -> None:
     lines = [
         "# RESULTS — learning validation (CPU)",
         "",
-        "Produced by `python scripts/validate_returns.py all`. Greedy eval over",
-        "10 episodes after a CPU-scale training run; thresholds are the",
-        "classic solve bars (reference discipline: README results tables,",
-        "`/root/reference/README.md:26-79`). Each run demonstrates the full",
-        "loop — env vectorization, replay, jitted update, checkpoint, restore,",
-        "greedy eval — actually improves returns; the data-parallel PPO row",
-        "shows sharded training preserves learning, not just compilation.",
+        "Produced by `python scripts/validate_returns.py all` (subset re-runs",
+        "merge through validate_results.json). Greedy eval over 10 episodes",
+        "after a CPU-scale training run; thresholds are the classic solve",
+        "bars except where a row's note says otherwise (reference",
+        "discipline: README results tables, `/root/reference/README.md:26-79`).",
+        "Each run demonstrates the full loop — env vectorization, replay,",
+        "jitted update, checkpoint, restore, greedy eval — actually improves",
+        "returns.",
         "",
         "| Algo | Env | Steps | Train s | Mean return | Threshold | Untrained | Pass |",
         "|---|---|---|---|---|---|---|---|",

@@ -23,10 +23,19 @@ def seed_everything(seed: int) -> jax.Array:
     identical on every rank (algorithms derive per-rank jax streams
     explicitly via fold_in where divergence is wanted).
     """
+    # Never let this call INITIALIZE the backend: process_index() would run
+    # plugin discovery (hanging on a wedged accelerator relay) and then
+    # report rank 0 on every host anyway. If no backend exists yet, use
+    # single-process semantics — multi-host flows seed via Runtime AFTER
+    # launch(), when the real rank is known.
+    rank = 0
     try:
-        rank = jax.process_index()
-    except Exception:  # backend not initialized yet: single-process semantics
-        rank = 0
+        from jax._src import xla_bridge as _xb
+
+        if _xb._backends:
+            rank = jax.process_index()
+    except Exception:
+        pass
     random.seed(seed + rank)
     np.random.seed(seed + rank)
     return jax.random.PRNGKey(seed)

@@ -79,7 +79,13 @@ def force_cpu_platform(num_devices: Optional[int] = None, force: bool = False) -
 
     _jeb.clear_backends()
     jax.config.update("jax_platforms", "cpu")
-    if num_devices is not None:
+    if num_devices is not None and len(jax.devices()) < int(num_devices):
+        # `num_devices` is a MINIMUM, applied only when the environment's
+        # own sizing (XLA_FLAGS --xla_force_host_platform_device_count, or
+        # a prior jax_num_cpu_devices) comes up short: pinning
+        # unconditionally would SHRINK a test harness's 8-device virtual
+        # platform to fabric.devices of whichever Runtime launched first.
+        _jeb.clear_backends()
         jax.config.update("jax_num_cpu_devices", int(num_devices))
 
 

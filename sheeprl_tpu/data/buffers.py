@@ -71,6 +71,20 @@ def _validate_add_data(data: Dict[str, np.ndarray]) -> None:
             )
 
 
+
+def _seeded_sampling_rng() -> np.random.Generator:
+    """Sampling stream derived from the (seeded, rank-folded) global RNG.
+
+    An unseeded ``default_rng()`` (OS entropy) made replay sampling the last
+    nondeterministic draw in a seeded run — same-seed off-policy trainings
+    diverged after the prefill. Deriving from the global RNG makes buffers
+    deterministic under ``seed_everything``; reproducibility then tracks
+    buffer CONSTRUCTION ORDER — call ``.seed(n)`` for a stream pinned
+    independently of it. (dtype pinned: the legacy randint bound would
+    overflow a C-long int32 on ILP32 platforms.)
+    """
+    return np.random.default_rng(int(np.random.randint(0, 2**31, dtype=np.int64)))
+
 class ReplayBuffer:
     """Circular [buffer_size, n_envs, ...] dict-of-arrays buffer with uniform
     sampling and wraparound-safe next-observation sampling."""
@@ -108,13 +122,7 @@ class ReplayBuffer:
         self._buf: Dict[str, Any] = {}
         self._pos = 0
         self._full = False
-        # Deterministic under seed_everything: derive the sampling stream
-        # from the (seeded, rank-folded) global RNG instead of OS entropy —
-        # an unseeded default_rng() made replay sampling the last
-        # nondeterministic draw in a seeded run. Reproducibility therefore
-        # tracks buffer CONSTRUCTION ORDER; call .seed(n) for a stream
-        # pinned independently of it.
-        self._rng = np.random.default_rng(np.random.randint(0, 2**31))
+        self._rng = _seeded_sampling_rng()
 
     # ----------------------------------------------------------- properties
     @property
@@ -398,13 +406,7 @@ class EnvIndependentReplayBuffer:
         ]
         self._buffer_size = buffer_size
         self._n_envs = n_envs
-        # Deterministic under seed_everything: derive the sampling stream
-        # from the (seeded, rank-folded) global RNG instead of OS entropy —
-        # an unseeded default_rng() made replay sampling the last
-        # nondeterministic draw in a seeded run. Reproducibility therefore
-        # tracks buffer CONSTRUCTION ORDER; call .seed(n) for a stream
-        # pinned independently of it.
-        self._rng = np.random.default_rng(np.random.randint(0, 2**31))
+        self._rng = _seeded_sampling_rng()
         self._concat_along_axis = buffer_cls.batch_axis
 
     @property
@@ -537,13 +539,7 @@ class EpisodeBuffer:
         self._open_episodes: List[List[Dict[str, np.ndarray]]] = [[] for _ in range(n_envs)]
         self._cum_lengths: List[int] = []
         self._buf: List[Dict[str, Any]] = []
-        # Deterministic under seed_everything: derive the sampling stream
-        # from the (seeded, rank-folded) global RNG instead of OS entropy —
-        # an unseeded default_rng() made replay sampling the last
-        # nondeterministic draw in a seeded run. Reproducibility therefore
-        # tracks buffer CONSTRUCTION ORDER; call .seed(n) for a stream
-        # pinned independently of it.
-        self._rng = np.random.default_rng(np.random.randint(0, 2**31))
+        self._rng = _seeded_sampling_rng()
 
     # ----------------------------------------------------------- properties
     @property

@@ -25,6 +25,16 @@ from sheeprl_tpu.utils.metric import (
 )
 
 
+@pytest.fixture(autouse=True)
+def _aggregator_enabled():
+    """CLI runs elsewhere in the suite set the class-level disable flag
+    (metric.log_level=0); these tests assume an enabled aggregator."""
+    prev = MetricAggregator.disabled
+    MetricAggregator.disabled = False
+    yield
+    MetricAggregator.disabled = prev
+
+
 class OnlyComputeMetric(Metric):
     """The minimal documented interface: no _state()/_reduce()."""
 
