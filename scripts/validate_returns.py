@@ -696,9 +696,24 @@ VALIDATORS = {
     "dreamer_v3": validate_dreamer_v3,
     "dreamer_v3_bf16": validate_dreamer_v3_bf16,
     "p2e_dv3": validate_p2e_dv3,
-    # Last on purpose: ~4-5 h on this host — a crash in any cheaper
+    # Last on purpose: hours on this host — a crash in any cheaper
     # validator must surface before the pixel run starts.
     "sac_ae": validate_sac_ae,
+}
+
+# Validators whose runtime exceeds this host class (documented, not skipped
+# silently): subset-run regeneration treats them as optional, and the report
+# prints their note when no recorded run exists.
+HW_GATED_NOTES = {
+    "sac_ae": (
+        "sac_ae (SAC from 64×64 pixels through the conv autoencoder) has no "
+        "recorded run yet: measured at ~0.1 policy-steps/s on the 1-core "
+        "build host, the 10,240-step probe needs ~24 h of CPU — it is gated "
+        "on a faster host or the accelerator, not on missing code (its "
+        "dry-run e2e, checkpoint round-trip and pixel pipeline are all "
+        "exercised in the suite; record it with "
+        "`python scripts/validate_returns.py sac_ae`)."
+    ),
 }
 
 
@@ -723,7 +738,7 @@ def _save_cache(cache: dict) -> None:
         fp.write("\n")
 
 
-def _write_results(results, crashed=()) -> None:
+def _write_results(results, crashed=(), missing=()) -> None:
     path = os.path.join(_REPO, "RESULTS.md")
     lines = [
         "# RESULTS — learning validation (CPU)",
@@ -742,8 +757,9 @@ def _write_results(results, crashed=()) -> None:
     ]
     for r in results:
         ok = r["mean_return"] >= r["threshold"]
+        train_s = "—" if r.get("train_seconds") is None else r["train_seconds"]
         lines.append(
-            f"| {r['algo']} | {r['env']} | {r['total_steps']} | {r['train_seconds']} "
+            f"| {r['algo']} | {r['env']} | {r['total_steps']} | {train_s} "
             f"| **{r['mean_return']:.1f}** | {r['threshold']} | ~{r.get('untrained', '?')} "
             f"| {'✅' if ok else '❌'} |"
         )
@@ -751,13 +767,21 @@ def _write_results(results, crashed=()) -> None:
         # A crashed validator must be a visible red row, not a silent
         # omission under the narrative below.
         lines.append(f"| {name} | — | — | — | **CRASHED** | — | — | ❌ |")
+    for name in missing:
+        lines.append(f"| {name} | — | — | — | *not yet recorded* | — | — | ⏳ |")
+    for name in missing:
+        if name in HW_GATED_NOTES:
+            lines += ["", HW_GATED_NOTES[name]]
     lines += [
         "",
         "Per-episode returns:",
         "",
     ]
     for r in results:
-        lines.append(f"- **{r['algo']}**: {[round(x, 1) for x in r['returns']]}")
+        if r.get("returns") is None:
+            lines.append(f"- **{r['algo']}**: (per-episode trace not retained for this row)")
+        else:
+            lines.append(f"- **{r['algo']}**: {[round(x, 1) for x in r['returns']]}")
     # Per-validator interpretation, emitted ONLY for rows present and
     # passing — the narrative must never outrun the table.
     notes = {
@@ -827,10 +851,13 @@ def main() -> None:
     # (canonical validator order). A subset run only regenerates when the
     # cache covers the FULL matrix — a partial cache must never clobber a
     # committed full table with fewer rows.
-    complete = all(n in cache for n in VALIDATORS)
+    # Hardware-gated validators are optional for regeneration: a cache that
+    # covers everything else may refresh the table, with the gated rows
+    # rendered as pending (their notes explain why).
+    complete = all(n in cache for n in VALIDATORS if n not in HW_GATED_NOTES)
     if which == "all" or complete:
         rows = [cache[n] for n in VALIDATORS if n in cache]
-        _write_results(rows, crashed)
+        _write_results(rows, crashed, missing=[n for n in VALIDATORS if n not in cache and n not in crashed])
     else:
         missing = sorted(set(VALIDATORS) - set(cache))
         print(f"cache covers {len(cache)}/{len(VALIDATORS)} validators "
