@@ -41,7 +41,7 @@ from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.core.runtime import DispatchThrottle
 from sheeprl_tpu.registry import register_algorithm
 from sheeprl_tpu.utils.checkpoint import load_checkpoint, restore_opt_state, save_checkpoint
-from sheeprl_tpu.utils.env import make_env, seed_vector_spaces
+from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
 from sheeprl_tpu.utils.timer import timer
@@ -197,22 +197,7 @@ def main(runtime, cfg: Dict[str, Any]):
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
     runtime.print(f"Log dir: {log_dir}")
 
-    vectorized_env = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
-    envs = vectorized_env(
-        [
-            make_env(
-                cfg,
-                cfg.seed + rank * cfg.env.num_envs + i,
-                rank * cfg.env.num_envs,
-                log_dir if rank == 0 else None,
-                "train",
-                vector_env_idx=i,
-            )
-            for i in range(cfg.env.num_envs)
-        ],
-        autoreset_mode=gym.vector.AutoresetMode.SAME_STEP,
-    )
-    seed_vector_spaces(envs, cfg.seed + rank * cfg.env.num_envs)
+    envs = make_vector_env(cfg, rank, log_dir)
     action_space = envs.single_action_space
     observation_space = envs.single_observation_space
     if not isinstance(action_space, gym.spaces.Box):

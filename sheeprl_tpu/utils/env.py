@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import os
 import warnings
+from functools import partial
 from typing import Any, Callable, Dict, Optional
 
 import cv2
@@ -30,6 +31,7 @@ from sheeprl_tpu.envs.wrappers import (
     FrameStack,
     GrayscaleRenderWrapper,
     MaskVelocityWrapper,
+    RestartOnException,
     RewardAsObservationWrapper,
 )
 
@@ -250,26 +252,42 @@ def seed_vector_spaces(envs: gym.vector.VectorEnv, seed: int) -> None:
     algorithm) was the one nondeterministic draw left in a seeded run,
     making borderline learning validations flap run to run.
 
-    New code should construct vector envs through :func:`make_vector_env`
-    (which calls this); the in-algorithm construction sites predate it."""
+    Every algorithm constructs its training envs through
+    :func:`make_vector_env`, which calls this; only bespoke vector envs
+    built elsewhere need to call it directly."""
     envs.action_space.seed(seed)
     envs.observation_space.seed(seed)
 
 
 def make_vector_env(
     cfg: Dict[str, Any],
-    seed: int,
     rank: int,
-    run_name: Optional[str] = None,
-    prefix: str = "",
+    log_dir: Optional[str] = None,
+    restart_on_exception: bool = False,
 ) -> gym.vector.VectorEnv:
-    """Build the Sync/AsyncVectorEnv of `cfg.env.num_envs` wrapped envs
-    (reference pattern: e.g. sheeprl/algos/ppo/ppo.py:137-150)."""
+    """The canonical training vector env — the ONE construction every
+    algorithm main uses (reference pattern: e.g. sheeprl/algos/ppo/ppo.py:
+    137-150): `cfg.env.num_envs` wrapped envs with per-env seeds
+    `cfg.seed + rank*num_envs + i`, video capture from global-rank-0's env 0
+    only, same-step autoreset, and the batched action/observation spaces
+    seeded (the off-policy prefill path draws from them).
+    ``restart_on_exception`` wraps each env in RestartOnException — the
+    long-horizon Dreamer runs' fault tolerance against crashy simulators."""
+    base = rank * cfg.env.num_envs
     thunks = [
-        make_env(cfg, seed + rank * cfg.env.num_envs + i, rank, run_name, prefix, vector_env_idx=i)
+        make_env(
+            cfg,
+            cfg.seed + base + i,
+            base,
+            log_dir if rank == 0 else None,
+            "train",
+            vector_env_idx=i,
+        )
         for i in range(cfg.env.num_envs)
     ]
+    if restart_on_exception:
+        thunks = [partial(RestartOnException, t) for t in thunks]
     cls = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
     envs = cls(thunks, autoreset_mode=gym.vector.AutoresetMode.SAME_STEP)
-    seed_vector_spaces(envs, seed + rank * cfg.env.num_envs)
+    seed_vector_spaces(envs, cfg.seed + base)
     return envs

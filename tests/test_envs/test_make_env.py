@@ -28,6 +28,7 @@ def base_cfg(**env_overrides):
     env.update(env_overrides)
     return dotdict(
         {
+            "seed": 0,
             "env": env,
             "algo": {"cnn_keys": {"encoder": ["rgb"]}, "mlp_keys": {"encoder": ["state"]}},
         }
@@ -141,7 +142,7 @@ class TestMakeEnv:
 
 class TestVectorEnv:
     def test_sync_vector_env(self):
-        envs = make_vector_env(base_cfg(), seed=0, rank=0)
+        envs = make_vector_env(base_cfg(), rank=0)
         assert envs.num_envs == 2
         obs, _ = envs.reset()
         assert obs["rgb"].shape == (2, 64, 64, 3)
