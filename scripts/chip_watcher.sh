@@ -1,0 +1,45 @@
+#!/bin/sh
+# Chip-return watcher (VERDICT r4 next #1): probe accelerator reachability
+# on a loop and fire scripts/on_chip_return.sh ONCE the moment the tunnel
+# answers, so the capture happens unattended inside the live window.
+#
+# The probe is bench.py's subprocess probe (a wedged relay hangs backend
+# discovery in-process with no way to cancel — only a subprocess with a
+# deadline turns that into a clean verdict; see
+# core/runtime.force_cpu_platform's docstring for the full story). The
+# probe never holds the chip: jax.devices() in a child that exits cleanly.
+#
+# Usage: nohup sh scripts/chip_watcher.sh >> logs/on_chip/watcher.log 2>&1 &
+#   SHEEPRL_WATCH_INTERVAL_S  probe cadence (default 1800)
+set -u
+cd "$(dirname "$0")/.."
+interval="${SHEEPRL_WATCH_INTERVAL_S:-1800}"
+mkdir -p logs/on_chip
+while :; do
+    # Bypass the marker-file cache (SHEEPRL_ACCEL_REACHABLE would also
+    # short-circuit): the watcher wants a FRESH verdict each tick.
+    verdict=$(env -u SHEEPRL_ACCEL_REACHABLE python - <<'EOF'
+import time
+import bench
+# stat the marker as stale so the probe really runs
+p = bench._probe_marker_path()
+if p:
+    import os
+    try:
+        os.utime(p, (0, 0))
+    except OSError:
+        pass
+print("1" if bench._accelerator_reachable() else "0")
+EOF
+    )
+    echo "$(date -u +%FT%TZ) probe verdict: ${verdict:-err}" >&2
+    if [ "$verdict" = "1" ]; then
+        echo "$(date -u +%FT%TZ) CHIP REACHABLE — starting on_chip_return" >&2
+        SHEEPRL_ACCEL_REACHABLE=1 sh scripts/on_chip_return.sh
+        rc=$?
+        echo "$(date -u +%FT%TZ) on_chip_return rc=$rc" >&2
+        [ "$rc" = 0 ] && exit 0
+        # capture failed mid-window: keep watching, retry next tick
+    fi
+    sleep "$interval"
+done

@@ -1,0 +1,76 @@
+#!/bin/sh
+# The one-command ON-CHIP capture (VERDICT r4 next #1): run the full TPU
+# bench sweep the hour the tunnel heals, unattended, so a live-chip window
+# is never missed again. Triggered automatically by scripts/chip_watcher.sh
+# (which probes reachability on a loop); runnable by hand any time.
+#
+# Captures, sequentially (each run owns the chip and the single host core):
+#   - micro dreamer_v1 / dreamer_v2 / dreamer_v3 (reference benchmark
+#     recipes; bench.py picks bf16-mixed on an accelerator backend — the
+#     TPU recipe default — and records the precision in the JSON)
+#   - dreamer_v3 at 32-true for the precision A/B against the bf16 row
+#   - dreamer_v3_S north star (vs the RTX 3080's ~1.98 env-steps/s) and
+#     the _b32/_b64 batch-scaling MFU study
+#   - ppo/a2c/sac CPU rows are NOT rerun here (they pin fabric.accelerator
+#     =cpu; their numbers do not change with chip health)
+#
+# Results: logs/on_chip/BENCH_TPU_<utc-stamp>.jsonl (one bench.py JSON line
+# per workload, each self-describing: metric/value/vs_baseline/backend/
+# precision) plus a DONE marker with the timestamp. BENCH_ALL.md is updated
+# BY HAND from that jsonl — a number lands in the table only after a human
+# (or the round's builder) checks backend=="tpu"/"axon" on every line.
+#
+# Usage: sh scripts/on_chip_return.sh [--smoke]
+#   --smoke: plumbing test (CPU ok): ppo only, 5 s differencing window,
+#            results stamped _SMOKE and never table-worthy.
+set -u
+cd "$(dirname "$0")/.."
+outdir="logs/on_chip"
+mkdir -p "$outdir"
+stamp=$(date -u +%Y%m%dT%H%M%SZ)
+
+if [ "${1:-}" = "--smoke" ]; then
+    out="$outdir/BENCH_SMOKE_$stamp.jsonl"
+    workloads="ppo"
+    export SHEEPRL_BENCH_MIN_WINDOW_S=5
+else
+    out="$outdir/BENCH_TPU_$stamp.jsonl"
+    workloads="dreamer_v3 dreamer_v2 dreamer_v1 dreamer_v3_S dreamer_v3_S_b32 dreamer_v3_S_b64"
+fi
+
+: > "$out"
+failed=0
+for w in $workloads; do
+    echo "=== on_chip_return: $w ===" >&2
+    line=$(python bench.py "$w" 2>"$outdir/$w.$stamp.err" | tail -1)
+    if [ -n "$line" ]; then
+        echo "$line" | tee -a "$out"
+    else
+        echo "WARNING: $w produced no result — stderr tail:" >&2
+        tail -5 "$outdir/$w.$stamp.err" >&2
+        failed=1
+    fi
+done
+
+if [ "${1:-}" != "--smoke" ] && [ "$failed" = 0 ]; then
+    # Precision A/B leg: dreamer_v3 at 32-true next to the bf16 default row.
+    echo "=== on_chip_return: dreamer_v3 (32-true A/B) ===" >&2
+    python - <<'EOF' 2>"$outdir/dreamer_v3_f32.$stamp.err" | tail -1 | tee -a "$out"
+import json
+import bench
+bench._setup_jax(None)
+import jax, sheeprl_tpu
+sheeprl_tpu.register_all()
+r = bench._timeboxed(
+    "dreamer_v3_env_steps_per_sec", "dreamer_v3_benchmarks", 16384,
+    16384 / 1589.30, learning_starts=1024,
+    extra=("fabric.player_sync=async", "fabric.precision=32-true"),
+)
+r["backend"] = jax.default_backend()
+print(json.dumps(r))
+EOF
+fi
+
+echo "$stamp rc=$failed" >> "$outdir/DONE"
+echo "on_chip_return: wrote $out (failed=$failed)" >&2
+exit "$failed"

@@ -393,13 +393,17 @@ def validate_sac_decoupled(total_steps: int = 12288, episodes: int = 10):
                                 total_steps, episodes, replay_ratio=0.5)
 
 
-def validate_sac_ae(total_steps: int = 10240, episodes: int = 10):
-    """SAC-AE: SAC from PIXELS through a conv autoencoder — the
-    pixel-reconstruction pathway is the algorithm's whole point (reference
-    sac_ae.py + agent.py:500-640). Pendulum-v1 rendered at 64x64 with
-    action_repeat=2 (10240 policy steps = 20480 frames), bar -300 like SAC.
-    ~4-5 h on the 1-core host — the slowest validator by far."""
-    _setup_jax()
+def _sac_ae_validate(
+    algo_label: str,
+    total_steps: int,
+    episodes: int,
+    screen_size: int,
+    cnn_mult: int,
+    threshold: float,
+):
+    """Shared SAC-AE pixel-Pendulum validation body (full-scale and the
+    reduced-scale probe differ only in screen size / conv width / budget /
+    bar)."""
     import jax
     import numpy as np
 
@@ -409,7 +413,7 @@ def validate_sac_ae(total_steps: int = 10240, episodes: int = 10):
     from sheeprl_tpu.utils.checkpoint import load_checkpoint
     from sheeprl_tpu.utils.env import make_env
 
-    root = f"validate_sac_ae_{os.getpid()}"
+    root = f"validate_{algo_label}_{os.getpid()}"
     cfg = _compose(
         [
             "exp=sac_ae",
@@ -418,11 +422,12 @@ def validate_sac_ae(total_steps: int = 10240, episodes: int = 10):
             "env.num_envs=4",
             "env.sync_env=True",
             "env.capture_video=False",
-            "env.screen_size=64",
+            f"env.screen_size={screen_size}",
             "env.action_repeat=2",
             "algo.learning_starts=1000",
             "algo.replay_ratio=0.5",
             "algo.run_test=False",
+            f"algo.cnn_channels_multiplier={cnn_mult}",
             "algo.cnn_keys.encoder=[rgb]",
             "algo.cnn_keys.decoder=[rgb]",
             "algo.mlp_keys.encoder=[]",
@@ -455,9 +460,168 @@ def validate_sac_ae(total_steps: int = 10240, episodes: int = 10):
         return np.asarray(get_actions(agent_state, np_obs)), None
 
     mean, rews = _greedy_episodes(step, cfg, episodes)
-    return {"algo": "sac_ae (pixels)", "env": "Pendulum-v1 (64x64 rgb)", "mean_return": mean,
-            "returns": rews, "threshold": -300.0, "untrained": -1400.0,
-            "train_seconds": round(train_s, 1), "total_steps": total_steps}
+    return {"algo": algo_label, "env": f"Pendulum-v1 ({screen_size}x{screen_size} rgb)",
+            "mean_return": mean, "returns": rews, "threshold": threshold,
+            "untrained": -1400.0, "train_seconds": round(train_s, 1),
+            "total_steps": total_steps}
+
+
+def validate_sac_ae_small(total_steps: int = 6144, episodes: int = 10):
+    """SAC-AE at REDUCED scale (VERDICT r4 missing #3): 32x32 pixels and a
+    quarter-width conv stack make the pixel probe fit this 1-core host
+    (hours instead of the ~24 h the 64x64 full-width probe costs). The bar
+    is a LEARNING bar — clearly beats untrained (~-1400) and random
+    (~-1200) — not Pendulum's solved band: the point is evidence that the
+    conv-AE + detached-encoder actor update (reference sac_ae.py:330-360)
+    learns from pixels, at a scale this host can afford. The full-scale
+    probe (validate_sac_ae) stays queued for chip return."""
+    _setup_jax()
+    return _sac_ae_validate(
+        "sac_ae_small", total_steps, episodes, screen_size=32, cnn_mult=4,
+        threshold=-900.0,
+    )
+
+
+def validate_sac_ae(total_steps: int = 10240, episodes: int = 10):
+    """SAC-AE at FULL scale: SAC from PIXELS through a conv autoencoder —
+    the pixel-reconstruction pathway is the algorithm's whole point
+    (reference sac_ae.py + agent.py:500-640). Pendulum-v1 rendered at 64x64
+    with action_repeat=2 (10240 policy steps = 20480 frames), bar -300 like
+    SAC. ~24 h on the 1-core host — chip-gated; validate_sac_ae_small is
+    the host-affordable learning proof."""
+    _setup_jax()
+    r = _sac_ae_validate(
+        "sac_ae", total_steps, episodes, screen_size=64, cnn_mult=16,
+        threshold=-300.0,
+    )
+    r["algo"] = "sac_ae (pixels)"
+    return r
+
+
+# --------------------------------------------------- DMC walker-walk
+def validate_sac_walker_walk(
+    total_steps: int = 150_000,
+    chunk_steps: int = 25_000,
+    episodes: int = 10,
+    chunk_episodes: int = 5,
+):
+    """North-star workload (BASELINE.json driver workload #2; VERDICT r4
+    missing #2): SAC-decoupled on DMC walker-walk from state observations —
+    the one published-scale reference workload runnable on this host
+    (dm_control is installed; reference env recipe:
+    /root/reference/sheeprl/configs/exp/dreamer_v3_dmc_walker_walk.yaml,
+    algo: sac_decoupled). PARTIAL budget, trained in resumable chunks:
+    each chunk resumes the previous checkpoint with the replay buffer
+    inside it (buffer.checkpoint=True), then greedy-evals — producing a
+    return CURVE at budget points, not just a final number. A crash or
+    host reboot loses at most one chunk (state file under logs/).
+
+    action_repeat=2 is the PlaNet/SAC-AE convention for walker-walk, so
+    total_steps are policy steps over 2x env frames. The bar is a
+    partial-budget learning bar: walker-walk random ~ 25-45, solved ~ 950
+    at 1M+ steps; 150 at 150K policy steps is unambiguous learning."""
+    import json
+
+    _setup_jax(num_cpu_devices=2)
+    import jax
+    import numpy as np
+
+    from sheeprl_tpu.algos.sac.agent import build_agent
+    from sheeprl_tpu.algos.sac.utils import prepare_obs
+    from sheeprl_tpu.core.runtime import Runtime
+    from sheeprl_tpu.utils.checkpoint import load_checkpoint
+    from sheeprl_tpu.utils.env import make_env
+
+    state_path = os.path.join(_REPO, "logs", "walker_walk_curve_state.json")
+    try:
+        with open(state_path) as fp:
+            chunks = json.load(fp)["chunks"]
+    except (OSError, ValueError, KeyError):
+        chunks = []
+    # Drop records whose checkpoint vanished (logs cleaned): restart there.
+    while chunks and not os.path.exists(chunks[-1]["ckpt"]):
+        chunks.pop()
+
+    base_overrides = [
+        "exp=sac_decoupled",
+        "env=dmc",
+        "env.wrapper.domain_name=walker",
+        "env.wrapper.task_name=walk",
+        "env.wrapper.from_pixels=False",
+        "env.wrapper.from_vectors=True",
+        "env.action_repeat=2",
+        "env.num_envs=4",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "algo.replay_ratio=0.5",
+        "algo.run_test=False",
+        "algo.mlp_keys.encoder=[state]",
+        "buffer.size=200000",
+        "buffer.checkpoint=True",
+        "fabric.accelerator=cpu",
+        "metric.log_level=0",
+        f"checkpoint.every={chunk_steps}",
+        "checkpoint.save_last=True",
+        "seed=42",
+    ]
+
+    def eval_chunk(cfg, ckpt, n_episodes):
+        state = load_checkpoint(ckpt)
+        runtime = Runtime(devices=1, accelerator="cpu").launch()
+        runtime.seed_everything(cfg.seed)
+        env = make_env(cfg, None, 0, None, "probe", vector_env_idx=0)()
+        obs_space, act_space = env.observation_space, env.action_space
+        env.close()
+        agent, agent_state = build_agent(runtime, cfg, obs_space, act_space, state["agent"])
+        mlp_keys = list(cfg.algo.mlp_keys.encoder)
+        get_actions = jax.jit(lambda p, o: agent.get_actions(p, o, greedy=True))
+
+        def step(obs, _state):
+            np_obs = prepare_obs(obs, mlp_keys=mlp_keys, num_envs=1)
+            return np.asarray(get_actions(agent_state["actor"], np_obs)), None
+
+        return _greedy_episodes(step, cfg, n_episodes)
+
+    cfg = None
+    while (done := sum(c["steps"] for c in chunks)) < total_steps:
+        target = min(done + chunk_steps, total_steps)
+        root = f"validate_walker_c{len(chunks)}"
+        overrides = base_overrides + [
+            f"algo.total_steps={target}",
+            f"root_dir={root}",
+            # Chunk 0 prefills; resumed chunks restore the buffer instead.
+            f"algo.learning_starts={1000 if not chunks else 0}",
+        ]
+        if chunks:
+            overrides.append(f"checkpoint.resume_from={chunks[-1]['ckpt']}")
+        cfg = _compose(overrides)
+        t0 = time.time()
+        _run(cfg)
+        train_s = time.time() - t0
+        # Absolute: the state file outlives this process and must resume
+        # from any cwd (the _latest_ckpt glob is cwd-relative).
+        ckpt = os.path.abspath(_latest_ckpt(root))
+        mean, rews = eval_chunk(cfg, ckpt, chunk_episodes)
+        chunks.append({"steps": target - done, "cum_steps": target, "ckpt": ckpt,
+                       "train_seconds": round(train_s, 1), "mean_return": round(mean, 1),
+                       "returns": [round(x, 1) for x in rews]})
+        os.makedirs(os.path.dirname(state_path), exist_ok=True)
+        with open(state_path, "w") as fp:
+            json.dump({"chunks": chunks}, fp, indent=1)
+        print(f"walker-walk chunk -> {target}/{total_steps} steps: "
+              f"greedy mean {mean:.1f} ({train_s:.0f}s)", flush=True)
+
+    # Final eval over the full episode count on the newest checkpoint.
+    if cfg is None:  # fully cached: rebuild a cfg for the eval env
+        cfg = _compose(base_overrides + [f"algo.total_steps={total_steps}",
+                                         "root_dir=validate_walker_eval",
+                                         "algo.learning_starts=0"])
+    mean, rews = eval_chunk(cfg, chunks[-1]["ckpt"], episodes)
+    return {"algo": "sac_decoupled (walker-walk)", "env": "DMC walker-walk (state)",
+            "mean_return": mean, "returns": rews, "threshold": 150.0,
+            "untrained": 35.0, "train_seconds": round(sum(c["train_seconds"] for c in chunks), 1),
+            "total_steps": total_steps,
+            "curve": [[c["cum_steps"], c["mean_return"]] for c in chunks]}
 
 
 # ------------------------------------------------------ Dreamer family
@@ -690,14 +854,19 @@ VALIDATORS = {
     "sac": validate_sac,
     "sac_decoupled": validate_sac_decoupled,
     "droq": validate_droq,
+    # North-star DMC workload: hours (chunked + resumable), but required —
+    # the one published-scale reference workload this host can reach.
+    "sac_walker_walk": validate_sac_walker_walk,
     "dreamer_v1": validate_dreamer_v1,
     "dreamer_v2": validate_dreamer_v2,
     "dreamer_v2_bf16": validate_dreamer_v2_bf16,
     "dreamer_v3": validate_dreamer_v3,
     "dreamer_v3_bf16": validate_dreamer_v3_bf16,
     "p2e_dv3": validate_p2e_dv3,
-    # Last on purpose: hours on this host — a crash in any cheaper
-    # validator must surface before the pixel run starts.
+    # Pixel probes last on purpose: hours on this host — a crash in any
+    # cheaper validator must surface before a pixel run starts. The small
+    # probe is the host-affordable one; full-scale stays chip-gated.
+    "sac_ae_small": validate_sac_ae_small,
     "sac_ae": validate_sac_ae,
 }
 
@@ -706,13 +875,13 @@ VALIDATORS = {
 # prints their note when no recorded run exists.
 HW_GATED_NOTES = {
     "sac_ae": (
-        "sac_ae (SAC from 64×64 pixels through the conv autoencoder) has no "
-        "recorded run yet: measured at ~0.1 policy-steps/s on the 1-core "
-        "build host, the 10,240-step probe needs ~24 h of CPU — it is gated "
-        "on a faster host or the accelerator, not on missing code (its "
-        "dry-run e2e, checkpoint round-trip and pixel pipeline are all "
-        "exercised in the suite; record it with "
-        "`python scripts/validate_returns.py sac_ae`)."
+        "sac_ae at FULL scale (64×64, full-width conv stack) has no recorded "
+        "run: measured at ~0.1 policy-steps/s on the 1-core build host, the "
+        "10,240-step probe needs ~24 h of CPU — gated on a faster host or "
+        "the accelerator, not on missing code. The sac_ae_small row above is "
+        "the same algorithm's learning proof at a scale this host affords "
+        "(32×32, quarter-width conv); record full scale with "
+        "`python scripts/validate_returns.py sac_ae`."
     ),
 }
 
@@ -782,6 +951,9 @@ def _write_results(results, crashed=(), missing=()) -> None:
             lines.append(f"- **{r['algo']}**: (per-episode trace not retained for this row)")
         else:
             lines.append(f"- **{r['algo']}**: {[round(x, 1) for x in r['returns']]}")
+        if r.get("curve"):
+            pts = ", ".join(f"{s//1000}K→{m}" for s, m in r["curve"])
+            lines.append(f"  - greedy-eval curve over the chunked budget (steps→mean): {pts}")
     # Per-validator interpretation, emitted ONLY for rows present and
     # passing — the narrative must never outrun the table.
     notes = {
@@ -791,7 +963,9 @@ def _write_results(results, crashed=(), missing=()) -> None:
         "a2c": "A2C clears its 400 bar from 5-step rollouts",
         "sac": "SAC lands in Pendulum's solved band (optimal ~ -150, random ~ -1200)",
         "sac_decoupled": "SAC-decoupled proves the player/trainer split (weight mirror + buffer routing) LEARNS on a 2-device mesh",
+        "sac_decoupled (walker-walk)": "the north-star DMC workload (BASELINE.json driver workload) at partial budget: walker-walk greedy return climbs chunk over chunk (curve above) — the published-scale task class, not a toy",
         "sac_ae (pixels)": "SAC-AE learns Pendulum FROM PIXELS through the conv autoencoder",
+        "sac_ae_small": "SAC-AE learns Pendulum FROM PIXELS through the conv autoencoder at reduced scale (32x32, quarter-width conv — the 1-core-host-affordable probe; full scale queued for chip return)",
         "droq": "DroQ matches SAC with 33% fewer env steps — the dropout-Q sample-efficiency claim realized",
         "dreamer_v1": "DreamerV1's continuous-latent RSSM learns its native continuous-control class (its reward head reaches 0.999 correlation; the -800 bar is a learning bar — the 64-unit actor plateaus at ~-660/-700, short of solving, lacking DV2/DV3's return normalization)",
         "dreamer_v2": "DreamerV2 (discrete latents + KL balancing + target critic) reaches its bar from a micro world model on state obs",

@@ -40,6 +40,7 @@ Parameter-sync semantics (``fabric.player_sync``):
 from __future__ import annotations
 
 import contextlib
+import os
 import time
 from typing import Any, Optional
 
@@ -50,8 +51,17 @@ AUTO_LATENCY_THRESHOLD_S = 2e-3
 # Above this the host copy of the player parameters costs more than the
 # dispatch latency it saves (and compiles slowly on CPU): stay on the mesh.
 AUTO_MAX_PARAM_BYTES = 64 * 1024 * 1024
+# How long an `auto` placement trusts its latency probe before re-measuring.
+# A tunnel that degrades (or heals) MID-RUN — the observed failure mode of a
+# relayed chip — would otherwise keep the stale placement until restart.
+AUTO_REPROBE_TTL_S = float(os.environ.get("SHEEPRL_PLAYER_REPROBE_TTL_S", "300"))
 
-_latency_cache: dict[Any, float] = {}
+_latency_cache: dict[Any, tuple[float, float]] = {}  # device -> (seconds, measured_at)
+
+# On the CPU platform host and mesh are the same silicon, so `auto` skips the
+# probe entirely; tests flip this to exercise the placement switch with a
+# monkeypatched probe.
+_PROBE_CPU_MESH = False
 
 
 def host_device() -> jax.Device:
@@ -59,14 +69,18 @@ def host_device() -> jax.Device:
     return jax.devices("cpu")[0]
 
 
-def dispatch_latency(device: jax.Device, *, samples: int = 5) -> float:
+def dispatch_latency(device: jax.Device, *, samples: int = 5, max_age_s: Optional[float] = None) -> float:
     """Median round-trip seconds of a tiny jitted call on ``device``.
 
     Measures dispatch + completion + host fetch — the fixed cost every
-    per-env-step player call pays regardless of model size.
+    per-env-step player call pays regardless of model size. The measurement
+    is cached; ``max_age_s`` bounds how stale a cached value may be
+    (None = any age, the one-shot resolve path).
     """
-    if device in _latency_cache:
-        return _latency_cache[device]
+    now = time.monotonic()
+    hit = _latency_cache.get(device)
+    if hit is not None and (max_age_s is None or now - hit[1] < max_age_s):
+        return hit[0]
     f = jax.jit(lambda x: x + 1.0)
     x = jax.device_put(jnp.zeros((8,), jnp.float32), device)
     jax.device_get(f(x))  # compile + warm path
@@ -76,7 +90,7 @@ def dispatch_latency(device: jax.Device, *, samples: int = 5) -> float:
         jax.device_get(f(x))
         times.append(time.perf_counter() - t0)
     lat = sorted(times)[len(times) // 2]
-    _latency_cache[device] = lat
+    _latency_cache[device] = (lat, time.monotonic())
     return lat
 
 
@@ -89,15 +103,25 @@ def param_bytes(tree: Any) -> int:
     )
 
 
-def resolve_player_device(mode: str, mesh_device: jax.Device, *, params: Any = None) -> jax.Device:
-    """Pick the device the player runs on. ``mode``: auto | host | mesh."""
+def resolve_player_device(
+    mode: str,
+    mesh_device: jax.Device,
+    *,
+    params: Any = None,
+    probe_max_age_s: Optional[float] = None,
+) -> jax.Device:
+    """Pick the device the player runs on. ``mode``: auto | host | mesh.
+
+    ``probe_max_age_s`` bounds the latency-probe cache age (None = reuse any
+    cached measurement; 0.0 = force a fresh probe — the TTL re-probe path).
+    """
     mode = str(mode).lower()
     if mode not in ("auto", "host", "mesh"):
         raise ValueError(f"fabric.player_device must be one of auto|host|mesh, got {mode!r}")
     host = host_device()
     if mode == "host":
         return host
-    if mode == "mesh" or mesh_device.platform == "cpu":
+    if mode == "mesh" or (mesh_device.platform == "cpu" and not _PROBE_CPU_MESH):
         # On the CPU platform (tests, multichip dry runs) host and mesh are
         # the same silicon — nothing to win.
         return mesh_device
@@ -111,7 +135,8 @@ def resolve_player_device(mode: str, mesh_device: jax.Device, *, params: Any = N
     )
     if probe is None:
         return mesh_device
-    return host if dispatch_latency(probe) > AUTO_LATENCY_THRESHOLD_S else mesh_device
+    lat = dispatch_latency(probe, max_age_s=probe_max_age_s)
+    return host if lat > AUTO_LATENCY_THRESHOLD_S else mesh_device
 
 
 def _all_ready(tree: Any) -> bool:
@@ -271,6 +296,15 @@ class ParamMirror:
                 self._promote(wait=True)
         return self._current
 
+    def close(self) -> None:
+        """Retire this mirror: drop any in-flight transfer and stop the
+        worker thread. The served snapshot stays readable."""
+        self._transfer = None
+        self._next_packed = None
+        if self._executor is not None:
+            self._executor.shutdown(wait=False)
+            self._executor = None
+
 
 class PlayerPlacement:
     """Bundle of (player device, parameter mirror, default-device context).
@@ -288,10 +322,15 @@ class PlayerPlacement:
         placement.push(new_params)                    # after each train step
     """
 
-    def __init__(self, device: jax.Device, mesh_device: jax.Device, sync: str) -> None:
+    def __init__(self, device: jax.Device, mesh_device: jax.Device, sync: str, mode: str = "mesh") -> None:
         self.device = device
         self.on_mesh = device == mesh_device
         self.mirror = ParamMirror(None if self.on_mesh else device, sync=sync)
+        self._mode = str(mode).lower()
+        self._sync = sync
+        self._mesh_device = mesh_device
+        self._next_reprobe = time.monotonic() + AUTO_REPROBE_TTL_S
+        self.placement_switches = 0
 
     @classmethod
     def resolve(
@@ -308,7 +347,34 @@ class PlayerPlacement:
         if force_fresh:
             sync = "fresh"
         device = resolve_player_device(mode, mesh_device, params=params)
-        return cls(device, mesh_device, sync)
+        return cls(device, mesh_device, sync, mode=mode)
+
+    def _maybe_reprobe(self, params: Any = None) -> bool:
+        """TTL'd re-evaluation of an `auto` placement: a link that degrades
+        (or heals) mid-run flips the verdict at the next push past the TTL
+        instead of persisting until restart. ``params`` (the tree about to
+        be pushed) keeps the AUTO_MAX_PARAM_BYTES guard in force — an
+        oversized player must stay on-mesh however slow the link gets.
+        Returns True on a switch."""
+        if self._mode != "auto" or (self._mesh_device.platform == "cpu" and not _PROBE_CPU_MESH):
+            return False
+        now = time.monotonic()
+        if now < self._next_reprobe:
+            return False
+        self._next_reprobe = now + AUTO_REPROBE_TTL_S
+        new_device = resolve_player_device(
+            "auto", self._mesh_device, params=params, probe_max_age_s=0.0
+        )
+        if new_device == self.device:
+            return False
+        self.device = new_device
+        self.on_mesh = new_device == self._mesh_device
+        # A fresh mirror (old in-flight transfers target the old device); the
+        # caller's push right after this lands the current weights on it.
+        self.mirror.close()
+        self.mirror = ParamMirror(None if self.on_mesh else new_device, sync=self._sync)
+        self.placement_switches += 1
+        return True
 
     def ctx(self):
         """Context manager placing new arrays (obs, PRNG keys) player-side.
@@ -327,6 +393,9 @@ class PlayerPlacement:
         return jax.device_put(tree, self.device)
 
     def push(self, params: Any) -> None:
+        # Re-probe BEFORE the push so a switch never strands these (newest)
+        # weights in a mirror about to be replaced.
+        self._maybe_reprobe(params)
         self.mirror.push(params)
 
     def params(self) -> Any:

@@ -8,36 +8,50 @@ named streams; environment/numpy seeding stays host-side.
 from __future__ import annotations
 
 import random
-from typing import Dict, Iterator, Sequence
+import warnings
+from typing import Dict, Iterator, Optional, Sequence
 
 import jax
 import numpy as np
 
 
-def seed_everything(seed: int) -> jax.Array:
+def seed_everything(seed: int, rank: Optional[int] = None) -> jax.Array:
     """Seed python/numpy host RNGs and return the root JAX key.
 
     The HOST streams (python/numpy — replay sampling, env glue) fold in the
-    process index so multi-host ranks draw distinct sequences; the returned
-    JAX root key deliberately does NOT — model initialization must be
-    identical on every rank (algorithms derive per-rank jax streams
+    process ``rank`` so multi-host ranks draw distinct sequences; the
+    returned JAX root key deliberately does NOT — model initialization must
+    be identical on every rank (algorithms derive per-rank jax streams
     explicitly via fold_in where divergence is wanted).
-    """
-    # Never let this call INITIALIZE the backend: process_index() would run
-    # plugin discovery (hanging on a wedged accelerator relay) and then
-    # report rank 0 on every host anyway. If no backend exists yet, use
-    # single-process semantics — multi-host flows seed via Runtime AFTER
-    # launch(), when the real rank is known.
-    rank = 0
-    try:
-        from jax._src import xla_bridge as _xb
 
-        if _xb._backends:
-            rank = jax.process_index()
-    except Exception:
-        pass
-    random.seed(seed + rank)
-    np.random.seed(seed + rank)
+    Callers that already know their rank (Runtime.seed_everything runs after
+    launch(), when jax.process_index() is safe) pass it explicitly; with
+    ``rank=None`` the rank is probed without initializing the backend.
+    """
+    if rank is None:
+        # Never let this call INITIALIZE the backend: process_index() would
+        # run plugin discovery (hanging on a wedged accelerator relay) and
+        # then report rank 0 on every host anyway. If no backend exists yet,
+        # use single-process semantics — multi-host flows seed via Runtime
+        # AFTER launch(), when the real rank is known.
+        rank = 0
+        try:
+            from jax._src import xla_bridge as _xb
+
+            if _xb._backends:
+                rank = jax.process_index()
+        except Exception:
+            # Private-API drift: falling back to rank 0 would correlate the
+            # host streams (replay sampling) across every rank of a
+            # multi-host run — say so instead of silently degrading.
+            warnings.warn(
+                "seed_everything could not detect the process rank "
+                "(jax._src.xla_bridge drifted?); assuming rank 0. Multi-host "
+                "callers should pass rank=jax.process_index() explicitly.",
+                RuntimeWarning,
+            )
+    random.seed(seed + int(rank))
+    np.random.seed(seed + int(rank))
     return jax.random.PRNGKey(seed)
 
 

@@ -60,12 +60,16 @@ def force_cpu_platform(num_devices: Optional[int] = None, force: bool = False) -
 
             initialized = bool(_xb._backends)
         except Exception:
-            # Private-API drift: fall back to the public live-array census.
-            # No live arrays -> clearing can invalidate nothing (jit caches
-            # re-trace); live arrays -> backends exist, skip (the unsafe
-            # branch is clearing under live arrays, not hanging: a built
-            # backend already proved the plugin reachable).
-            initialized = bool(jax.live_arrays())
+            # Private-API drift: fall back to public signals. jax_platforms
+            # == "cpu" means the pin already happened (ours or the user's)
+            # and the dance is redundant; any OTHER value can be ambient
+            # environment (this host's sitecustomize exports
+            # JAX_PLATFORMS=axon) and must NOT count as initialized — that
+            # would skip the pin and re-expose the wedged-relay hang this
+            # function exists to prevent. Otherwise the live-array census:
+            # no live arrays -> clearing can invalidate nothing (jit caches
+            # re-trace).
+            initialized = jax.config.jax_platforms == "cpu" or bool(jax.live_arrays())
         if initialized:
             return
     global _PRE_PIN_JAX_PLATFORMS
@@ -99,6 +103,7 @@ def _unpin_cpu_platform_for_accelerator() -> None:
     arrays are alive (unpinning rebuilds backends); with live arrays the
     first launch's platform owns the process and the TPU launch fails with
     the ordinary 'no TPU devices visible' error."""
+    global _CPU_PIN_BY_US, _PRE_PIN_JAX_PLATFORMS
     if not _CPU_PIN_BY_US or jax.config.jax_platforms != "cpu" or jax.live_arrays():
         return
     if _PRE_PIN_JAX_PLATFORMS is None:
@@ -109,6 +114,11 @@ def _unpin_cpu_platform_for_accelerator() -> None:
 
     _jeb.clear_backends()
     jax.config.update("jax_platforms", _PRE_PIN_JAX_PLATFORMS or "")
+    # The pin is undone: reset the bookkeeping so a later force_cpu_platform
+    # records the (possibly different) pre-pin value afresh instead of
+    # replaying this one.
+    _CPU_PIN_BY_US = False
+    _PRE_PIN_JAX_PLATFORMS = None
 
 
 class DispatchThrottle:
@@ -354,7 +364,10 @@ class Runtime:
         # init: algorithms use root_key (identical) for params and
         # fold_in(rank) streams for env/sampling.
         self.seed = seed
-        self.root_key = seed_everything(seed)
+        # Post-launch the backend exists, so the rank is known here — pass it
+        # rather than having seed_everything re-probe via private API.
+        rank = jax.process_index() if self._launched else None
+        self.root_key = seed_everything(seed, rank=rank)
         return self.root_key
 
     def print(self, *args: Any, **kwargs: Any) -> None:

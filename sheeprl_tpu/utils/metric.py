@@ -180,6 +180,7 @@ class MetricAggregator:
     def __init__(self, metrics: Optional[Dict[str, Metric]] = None, raise_on_missing: bool = False):
         self.metrics: Dict[str, Metric] = metrics if metrics is not None else {}
         self._raise_on_missing = raise_on_missing
+        self._warned_local_fallback: set = set()
 
     def __iter__(self):
         return iter(self.metrics.keys())
@@ -249,7 +250,17 @@ class MetricAggregator:
                     try:
                         states[k] = np.asarray(m._state(), np.float64)
                     except NotImplementedError:
-                        pass  # falls back to m.compute() below (unbatched)
+                        # Falls back to m.compute() below — which is
+                        # RANK-LOCAL despite sync_on_compute. Say so once
+                        # per key instead of silently under-reporting.
+                        if k not in self._warned_local_fallback:
+                            self._warned_local_fallback.add(k)
+                            warnings.warn(
+                                f"Metric '{k}' requests sync_on_compute but implements only "
+                                "update/compute/reset (no _state()); under multiple processes "
+                                "its reported value is rank-local, not cross-rank reduced.",
+                                UserWarning,
+                            )
                 gathered = multihost_utils.process_allgather(states)
                 n = jax.process_count()
                 synced_rows = {

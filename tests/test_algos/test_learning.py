@@ -29,7 +29,9 @@ from scripts.validate_returns import (  # noqa: E402
     validate_ppo_recurrent,
     validate_sac,
     validate_sac_ae,
+    validate_sac_ae_small,
     validate_sac_decoupled,
+    validate_sac_walker_walk,
 )
 
 _RUN_SLOW = os.environ.get("SHEEPRL_SLOW_TESTS", "") == "1"
@@ -40,11 +42,17 @@ def _restore_virtual_mesh():
     """The validators force a fresh CPU platform sized for themselves
     (1 or 2 devices); restore the suite's 8-device virtual mesh afterwards
     so later-collected tests (test_core/test_mesh_runtime.py asserts 8,
-    ring attention needs 4+) see the conftest topology."""
+    ring attention needs 4+) see the conftest topology. Only when the
+    validator actually changed the topology: a force-clear invalidates any
+    jax arrays other fixtures hold, so a skipped test (slow gate) must not
+    pay it."""
     yield
-    from sheeprl_tpu.core.runtime import force_cpu_platform
+    import jax
 
-    force_cpu_platform(num_devices=8, force=True)
+    if len(jax.devices()) != 8:
+        from sheeprl_tpu.core.runtime import force_cpu_platform
+
+        force_cpu_platform(num_devices=8, force=True)
 
 
 def test_ppo_learns_cartpole():
@@ -128,10 +136,33 @@ def test_sac_decoupled_learns_pendulum():
 @pytest.mark.slow
 @pytest.mark.skipif(not _RUN_SLOW, reason="set SHEEPRL_SLOW_TESTS=1 to run")
 def test_sac_ae_learns_pendulum_pixels():
-    """SAC from pixels through the conv autoencoder (hours on CPU)."""
+    """SAC from pixels through the conv autoencoder (~24 h on this CPU;
+    the reduced-scale probe below is the host-affordable variant)."""
     r = validate_sac_ae()
     assert r["mean_return"] >= r["threshold"], (
         f"SAC-AE stopped learning: {r['mean_return']:.1f} < {r['threshold']} ({r['returns']})"
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _RUN_SLOW, reason="set SHEEPRL_SLOW_TESTS=1 to run")
+def test_sac_ae_small_learns_pendulum_pixels():
+    """Reduced-scale SAC-AE (32x32, quarter-width conv): the pixel
+    autoencoder pathway must clearly beat untrained within hours of CPU."""
+    r = validate_sac_ae_small()
+    assert r["mean_return"] >= r["threshold"], (
+        f"SAC-AE (small) stopped learning: {r['mean_return']:.1f} < {r['threshold']} ({r['returns']})"
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not _RUN_SLOW, reason="set SHEEPRL_SLOW_TESTS=1 to run")
+def test_sac_decoupled_learns_walker_walk():
+    """North-star DMC workload at partial budget: resumable chunked
+    training must produce a climbing greedy-return curve on walker-walk."""
+    r = validate_sac_walker_walk()
+    assert r["mean_return"] >= r["threshold"], (
+        f"walker-walk stopped learning: {r['mean_return']:.1f} < {r['threshold']} ({r['returns']})"
     )
 
 
