@@ -16,9 +16,10 @@
 #
 # Results: logs/on_chip/BENCH_TPU_<utc-stamp>.jsonl (one bench.py JSON line
 # per workload, each self-describing: metric/value/vs_baseline/backend/
-# precision) plus a DONE marker with the timestamp. BENCH_ALL.md is updated
-# BY HAND from that jsonl — a number lands in the table only after a human
-# (or the round's builder) checks backend=="tpu"/"axon" on every line.
+# precision) plus a DONE marker with the timestamp. On a fully-on-chip
+# sweep, scripts/update_bench_all.py then appends a dated ON-CHIP section
+# to BENCH_ALL.md (it refuses mixed/CPU-fallback captures, so a silent
+# fallback can never masquerade as a TPU record).
 #
 # Usage: sh scripts/on_chip_return.sh [--smoke]
 #   --smoke: plumbing test (CPU ok): ppo only, 5 s differencing window,
@@ -54,8 +55,10 @@ done
 
 if [ "${1:-}" != "--smoke" ] && [ "$failed" = 0 ]; then
     # Precision A/B leg: dreamer_v3 at 32-true next to the bf16 default row.
+    # Same empty-line check as the main loop: a crashed A/B leg must fail
+    # the sweep, not silently fold a 6-row capture as complete.
     echo "=== on_chip_return: dreamer_v3 (32-true A/B) ===" >&2
-    python - <<'EOF' 2>"$outdir/dreamer_v3_f32.$stamp.err" | tail -1 | tee -a "$out"
+    line=$(python - <<'EOF' 2>"$outdir/dreamer_v3_f32.$stamp.err" | tail -1
 import json
 import bench
 bench._setup_jax(None)
@@ -69,6 +72,18 @@ r = bench._timeboxed(
 r["backend"] = jax.default_backend()
 print(json.dumps(r))
 EOF
+    )
+    if [ -n "$line" ]; then
+        echo "$line" | tee -a "$out"
+    else
+        echo "WARNING: 32-true A/B leg produced no result — stderr tail:" >&2
+        tail -5 "$outdir/dreamer_v3_f32.$stamp.err" >&2
+        failed=1
+    fi
+fi
+
+if [ "${1:-}" != "--smoke" ] && [ "$failed" = 0 ]; then
+    python scripts/update_bench_all.py "$out" >&2 || failed=1
 fi
 
 echo "$stamp rc=$failed" >> "$outdir/DONE"

@@ -545,6 +545,10 @@ def validate_sac_walker_walk(
     base_overrides = [
         "exp=sac_decoupled",
         "env=dmc",
+        # The exp file's literal env.id (LunarLander, from exp=sac) merges
+        # AFTER the env group file — same as Hydra — so the id must be
+        # pinned as a dotted override, which applies last.
+        "env.id=walker_walk",
         "env.wrapper.domain_name=walker",
         "env.wrapper.task_name=walk",
         "env.wrapper.from_pixels=False",
@@ -558,6 +562,10 @@ def validate_sac_walker_walk(
         "algo.mlp_keys.encoder=[state]",
         "buffer.size=200000",
         "buffer.checkpoint=True",
+        # In-RAM buffer: the pickled-in-checkpoint restore must not carry
+        # memmap file handles into the next chunk's run directory (24-float
+        # state obs x 200K rows is ~80 MB — RAM is the right place).
+        "buffer.memmap=False",
         "fabric.accelerator=cpu",
         "metric.log_level=0",
         f"checkpoint.every={chunk_steps}",
@@ -899,12 +907,28 @@ def _load_cache() -> dict:
         return {}
 
 
-def _save_cache(cache: dict) -> None:
+def _save_cache(fresh: dict, evict: str = None) -> None:
+    """Persist ``fresh`` rows (ONLY rows produced by this run — persisting
+    a whole startup snapshot would resurrect rows another process evicted
+    meanwhile) into the on-disk cache, under an exclusive lock: validators
+    run in parallel processes (the multi-hour rows in the background while
+    cheaper subsets re-run), and an unlocked load-merge-replace could drop
+    a row recorded between our load and our save. ``evict`` removes one
+    key (a crashed validator's stale success)."""
+    import fcntl
     import json
 
-    with open(_CACHE_PATH, "w") as fp:
-        json.dump(cache, fp, indent=1, sort_keys=True)
-        fp.write("\n")
+    lock_path = _CACHE_PATH + ".lock"
+    with open(lock_path, "w") as lock_fp:
+        fcntl.flock(lock_fp, fcntl.LOCK_EX)
+        merged = {**_load_cache(), **fresh}
+        if evict is not None:
+            merged.pop(evict, None)
+        tmp = _CACHE_PATH + ".tmp"
+        with open(tmp, "w") as fp:
+            json.dump(merged, fp, indent=1, sort_keys=True)
+            fp.write("\n")
+        os.replace(tmp, _CACHE_PATH)
 
 
 def _write_results(results, crashed=(), missing=()) -> None:
@@ -1010,17 +1034,21 @@ def main() -> None:
             crashed.append(name)
             # Evict any stale success: the CRASHED row must not coexist
             # with an old PASS row for the same validator.
-            if cache.pop(name, None) is not None:
-                _save_cache(cache)
+            cache.pop(name, None)
+            _save_cache({}, evict=name)
             print(f"{name}: CRASHED ({type(e).__name__}: {e})", flush=True)
             continue
         status = "PASS" if r["mean_return"] >= r["threshold"] else "FAIL"
         print(f"{name}: mean_return={r['mean_return']:.1f} (threshold {r['threshold']}) {status}", flush=True)
         results.append(r)
         # Persist per-validator so a subset re-run (after a fix, or after a
-        # crash killed an `all` sweep) refreshes just its rows.
+        # crash killed an `all` sweep) refreshes just its rows. Only THIS
+        # row is written — the startup snapshot stays in memory only.
         cache[name] = r
-        _save_cache(cache)
+        _save_cache({name: r})
+    # Re-read the cache before deciding on regeneration: validators running
+    # in PARALLEL processes may have recorded rows while this one trained.
+    cache = {**_load_cache(), **{n: cache[n] for n in names if n in cache}}
     # Regenerate RESULTS.md from the union of everything validated so far
     # (canonical validator order). A subset run only regenerates when the
     # cache covers the FULL matrix — a partial cache must never clobber a

@@ -117,10 +117,29 @@ class MemmapArray:
         state["_array"] = None
         # Unpickled copies (e.g. in worker processes) never own the file.
         state["_has_ownership"] = False
+        # Being pickled means an external reference to the backing file now
+        # exists (a buffer-in-checkpoint, a worker): unlinking it when THIS
+        # object is collected would strand that reference — a resumed run
+        # would open a deleted file (observed: FileNotFoundError on the
+        # first post-resume add). Relinquish deletion; the file's lifetime
+        # now follows the run directory, not this process. (A transient
+        # pickle leaks the file — the lesser evil vs deleting data a
+        # checkpoint depends on; run dirs are user-collected anyway.)
+        self._has_ownership = False
         return state
 
     def __setstate__(self, state: dict) -> None:
         self.__dict__.update(state)
+
+    def __deepcopy__(self, memo: dict) -> "MemmapArray":
+        # Without this, deepcopy falls back to __getstate__ and its
+        # pickling side effect would strip ownership from the SOURCE for a
+        # mere in-process copy. A deepcopy is a non-owning view (two
+        # owners would double-delete); the source keeps its ownership.
+        clone = type(self)(self._filename, self._dtype, self._shape, self._mode)
+        clone._has_ownership = False
+        memo[id(self)] = clone
+        return clone
 
     # ---------------------------------------------------------- array-like
     def __array__(self, dtype: DTypeLike = None) -> np.ndarray:

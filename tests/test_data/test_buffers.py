@@ -300,6 +300,22 @@ class TestMemmapArray:
         del arr
         assert not filename.exists()
 
+    def test_pickling_relinquishes_source_ownership(self, tmp_path):
+        """A pickled mapping (buffer-in-checkpoint) must survive the source
+        process: collecting the ORIGINAL after pickling may not unlink the
+        backing file, or a resumed run would open a deleted file (observed
+        as FileNotFoundError on the first post-resume add)."""
+        arr = MemmapArray(tmp_path / "c.memmap", np.float32, (2, 2))
+        arr[:] = 3
+        blob = pickle.dumps(arr)
+        filename = arr.filename
+        del arr  # the "training process exits"
+        assert filename.exists()
+        restored = pickle.loads(blob)
+        np.testing.assert_array_equal(np.asarray(restored), 3)
+        restored[0, 0] = 9  # post-resume writes must work too
+        assert float(restored[0, 0]) == 9.0
+
     def test_from_array(self, tmp_path):
         src = np.arange(6, dtype=np.int32).reshape(2, 3)
         m = MemmapArray.from_array(src, tmp_path / "f.memmap")
@@ -311,3 +327,18 @@ class TestMemmapArray:
         assert m.ndim == 2
         assert m.size == 8
         assert len(m) == 4
+
+    def test_deepcopy_is_nonowning_view_source_keeps_ownership(self, tmp_path):
+        import copy
+
+        arr = MemmapArray(tmp_path / "dc.memmap", np.float32, (2,))
+        arr[:] = 5
+        clone = copy.deepcopy(arr)
+        assert not clone.has_ownership
+        assert arr.has_ownership  # the in-process copy must NOT strip it
+        np.testing.assert_array_equal(np.asarray(clone), 5)
+        filename = arr.filename
+        del clone  # non-owner: file stays
+        assert filename.exists()
+        del arr  # owner: file goes
+        assert not filename.exists()
