@@ -27,13 +27,14 @@ from sheeprl_tpu.algos.ppo.loss import entropy_loss
 from sheeprl_tpu.config.instantiate import instantiate, locate
 from sheeprl_tpu.core.mesh import DATA_AXIS
 from sheeprl_tpu.core.player import PlayerPlacement
+from sheeprl_tpu.core.rollout import fuse_gae_pool, ship_rollout
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.registry import register_algorithm
 from sheeprl_tpu.utils.checkpoint import load_checkpoint, restore_opt_state, save_checkpoint
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
-from sheeprl_tpu.utils.ops import gae, normalize_tensor
+from sheeprl_tpu.utils.ops import normalize_tensor
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
 
@@ -77,19 +78,13 @@ def make_train_step(agent: PPOAgent, tx: optax.GradientTransformation, cfg: Dict
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def train_step(params, opt_state, data, next_obs, key):
-        # data arrays are (T, E, ...) straight from the rollout buffer.
-        next_values = agent.get_values(params, next_obs)
-        returns, advantages = gae(
-            data["rewards"].astype(jnp.float32),
-            data["values"].astype(jnp.float32),
-            data["dones"].astype(jnp.float32),
-            next_values,
-            gamma,
-            gae_lambda,
+        # data is (T, E, ...) env-sharded (core/rollout.py); bootstrap +
+        # GAE + flattening happen in-graph via the shared prologue.
+        pool = fuse_gae_pool(
+            agent, params, data, next_obs, (*obs_keys, "actions"),
+            gamma, gae_lambda,
         )
-        full = {**data, "returns": returns, "advantages": advantages}
-        flat = {k: v.reshape(-1, *v.shape[2:]) for k, v in full.items()}
-        n = flat["actions"].shape[0]
+        n = pool["actions"].shape[0]
         next_key, key = jax.random.split(key)
         num_mb = max(1, -(-n // mb_size))
         perm = jax.random.permutation(key, n)
@@ -97,7 +92,7 @@ def make_train_step(agent: PPOAgent, tx: optax.GradientTransformation, cfg: Dict
         zero_grads = jax.tree_util.tree_map(jnp.zeros_like, params)
 
         def mb_body(grads_acc, mb_idx):
-            batch = {k: jnp.take(v, mb_idx, axis=0) for k, v in flat.items()}
+            batch = {k: jnp.take(v, mb_idx, axis=0) for k, v in pool.items()}
             batch = jax.lax.with_sharding_constraint(batch, {k: batch_sharding for k in batch})
             (_, (pg, vl)), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
             return jax.tree_util.tree_map(jnp.add, grads_acc, grads), jnp.stack([pg, vl])
@@ -285,35 +280,17 @@ def main(runtime, cfg: Dict[str, Any]):
                         aggregator.update("Game/ep_len_avg", ep_len)
                     runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
 
+        # Ship the rollout ((T, E) tensors env-sharded — core/rollout.py);
+        # the whole update is then ONE dispatch.
         local_data = rb.to_tensor()
-        train_keys = (*obs_keys, "actions", "rewards", "values", "dones")
-        data = {k: np.asarray(local_data[k]) for k in train_keys}  # (T, E, ...)
         next_obs_np = prepare_obs(next_obs, mlp_keys=obs_keys, num_envs=cfg.env.num_envs)
-        if cfg.buffer.get("share_data", False) and world_size > 1:
-            from jax.experimental import multihost_utils
-
-            # Gather raw rollouts over hosts along the env axis — GAE is
-            # independent per env column, so computing it in-jit after the
-            # gather is equivalent to gathering post-GAE tensors.
-            gathered = multihost_utils.process_allgather(data)
-            data = {k: np.moveaxis(v, 0, 1).reshape(v.shape[1], -1, *v.shape[3:])
-                    for k, v in gathered.items()}
-            g_next = multihost_utils.process_allgather(next_obs_np)
-            next_obs_np = jax.tree_util.tree_map(
-                lambda v: v.reshape(-1, *v.shape[2:]), g_next
-            )
-        n_env_cols = data["rewards"].shape[1]
-        if runtime.world_size > 1 and n_env_cols % runtime.world_size == 0:
-            # Shard the env axis (T is sequential under GAE's scan); the
-            # in-jit minibatch constraint reshards for the update phase.
-            data = runtime.shard_batch(data, axis=1)
-            jnp_next = runtime.shard_batch(next_obs_np, axis=0)
-        else:
-            # Replicate via a global device_put: plain jnp.asarray would
-            # hand process-local arrays to a jit spanning the whole mesh,
-            # which multi-process dispatch rejects.
-            data = runtime.replicate(data)
-            jnp_next = runtime.replicate(next_obs_np)
+        data, jnp_next = ship_rollout(
+            runtime,
+            local_data,
+            (*obs_keys, "actions"),
+            next_obs_np,
+            share_data=bool(cfg.buffer.get("share_data", False)),
+        )
 
         with timer("Time/train_time"):
             params, opt_state, train_metrics, train_key = train_fn(

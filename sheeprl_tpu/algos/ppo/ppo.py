@@ -37,20 +37,36 @@ from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
 from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
 from sheeprl_tpu.core.mesh import DATA_AXIS
 from sheeprl_tpu.core.player import PlayerPlacement
+from sheeprl_tpu.core.rollout import fuse_gae_pool, ship_rollout
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.registry import register_algorithm
 from sheeprl_tpu.utils.checkpoint import load_checkpoint, restore_opt_state, save_checkpoint
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
-from sheeprl_tpu.utils.ops import gae, normalize_tensor
+from sheeprl_tpu.utils.ops import normalize_tensor
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
 from sheeprl_tpu.config.instantiate import instantiate
 
 
-def make_train_step(agent: PPOAgent, tx: optax.GradientTransformation, cfg: Dict[str, Any], mesh):
-    """Build the jitted full-update function (epochs × minibatches in-graph)."""
+def make_train_step(
+    agent: PPOAgent,
+    tx: optax.GradientTransformation,
+    cfg: Dict[str, Any],
+    mesh,
+    fused_gae: bool = True,
+):
+    """Build the jitted full-update function (epochs × minibatches in-graph).
+
+    ``fused_gae=True`` (the coupled loop): the jit takes the raw rollout —
+    big tensors flat ``(T*E, ...)``, per-step scalars ``(T, E, 1)``, the
+    final obs — and runs bootstrap + GAE in-graph before the scans (see
+    core/rollout.py for the transfer layout). ``fused_gae=False``
+    (ppo_decoupled, which computes GAE on the PLAYER device and scatters
+    the finished pool to the trainer partition): the jit takes the flat
+    pool with returns/advantages already present.
+    """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     update_epochs = int(cfg.algo.update_epochs)
@@ -61,6 +77,9 @@ def make_train_step(agent: PPOAgent, tx: optax.GradientTransformation, cfg: Dict
     clip_vloss = bool(cfg.algo.clip_vloss)
     reduction = cfg.algo.loss_reduction
     vf_coef = float(cfg.algo.vf_coef)
+
+    gamma = float(cfg.algo.gamma)
+    gae_lambda = float(cfg.algo.gae_lambda)
 
     def loss_fn(params, batch, clip_coef, ent_coef):
         obs = normalize_obs({k: batch[k] for k in obs_keys}, cnn_keys, obs_keys)
@@ -76,9 +95,9 @@ def make_train_step(agent: PPOAgent, tx: optax.GradientTransformation, cfg: Dict
 
     batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
 
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def train_step(params, opt_state, data, key, clip_coef, ent_coef):
-        n = data["actions"].shape[0]
+    def update_pool(params, opt_state, pool, key, clip_coef, ent_coef):
+        """Epoch × minibatch scans over the flat sample pool."""
+        n = pool["actions"].shape[0]
         next_key, key = jax.random.split(key)
         num_mb = max(1, -(-n // mb_size))  # ceil
 
@@ -91,7 +110,7 @@ def make_train_step(agent: PPOAgent, tx: optax.GradientTransformation, cfg: Dict
 
             def mb_body(carry, mb_idx):
                 params, opt_state = carry
-                batch = {k: jnp.take(v, mb_idx, axis=0) for k, v in data.items()}
+                batch = {k: jnp.take(v, mb_idx, axis=0) for k, v in pool.items()}
                 batch = jax.lax.with_sharding_constraint(
                     batch, {k: batch_sharding for k in batch}
                 )
@@ -109,6 +128,24 @@ def make_train_step(agent: PPOAgent, tx: optax.GradientTransformation, cfg: Dict
         (params, opt_state), metrics = jax.lax.scan(epoch_body, (params, opt_state), keys)
         m = metrics.mean(0)
         return params, opt_state, {"policy_loss": m[0], "value_loss": m[1], "entropy_loss": m[2]}, next_key
+
+    if not fused_gae:
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def train_step(params, opt_state, pool, key, clip_coef, ent_coef):
+            return update_pool(params, opt_state, pool, key, clip_coef, ent_coef)
+
+        return train_step
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_state, data, next_obs, key, clip_coef, ent_coef):
+        # data is (T, E, ...) env-sharded (core/rollout.py); bootstrap +
+        # GAE + flattening happen in-graph via the shared prologue.
+        pool = fuse_gae_pool(
+            agent, params, data, next_obs, (*obs_keys, "actions", "logprobs"),
+            gamma, gae_lambda, include_values=True,
+        )
+        return update_pool(params, opt_state, pool, key, clip_coef, ent_coef)
 
     return train_step
 
@@ -236,12 +273,9 @@ def main(runtime, cfg: Dict[str, Any]):
 
     # ---------------------------------------------------------- jitted fns
     player_step_fn = jax.jit(agent.player_step)
+    # get_values_fn survives only for the (rare) mid-rollout truncation
+    # bootstrap; end-of-rollout bootstrap + GAE live inside train_fn.
     get_values_fn = jax.jit(agent.get_values)
-    gae_fn = jax.jit(
-        lambda rewards, values, dones, next_values: gae(
-            rewards, values, dones, next_values, cfg.algo.gamma, cfg.algo.gae_lambda
-        )
-    )
     train_fn = make_train_step(agent, tx, cfg, mesh)
 
     # Latency-aware player placement: the per-step policy forward runs where
@@ -301,9 +335,8 @@ def main(runtime, cfg: Dict[str, Any]):
             step_data["actions"] = actions[np.newaxis]
             step_data["logprobs"] = logprobs[np.newaxis]
             step_data["rewards"] = rewards[np.newaxis]
-            if cfg.buffer.memmap:
-                step_data["returns"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
-                step_data["advantages"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
+            # returns/advantages are computed INSIDE the train jit — no
+            # buffer placeholders, no host round-trip.
 
             rb.add(step_data, validate_args=cfg.buffer.validate_args)
 
@@ -323,34 +356,20 @@ def main(runtime, cfg: Dict[str, Any]):
                         aggregator.update("Game/ep_len_avg", ep_len)
                     runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
 
-        # ------------------------------------------------- GAE + flatten
+        # ------------------------- ship rollout; bootstrap+GAE run in-jit
+        # ((T, E) tensors env-sharded over `data`, pixels uint8 —
+        # core/rollout.py). share_data is the reference's
+        # every-process-trains-on-the-union mode (fabric.all_gather,
+        # ppo.py:363-367), a DCN-level host gather along the env axis.
         local_data = rb.to_tensor()
-        with placement.ctx():
-            jnp_obs = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
-            next_values = get_values_fn(placement.params(), jnp_obs)
-            returns, advantages = gae_fn(
-                jnp.asarray(np.asarray(local_data["rewards"]), jnp.float32),
-                jnp.asarray(np.asarray(local_data["values"]), jnp.float32),
-                jnp.asarray(np.asarray(local_data["dones"]), jnp.float32),
-                next_values,
-            )
-        local_data["returns"] = np.asarray(returns)
-        local_data["advantages"] = np.asarray(advantages)
-
-        # Flatten [T, N_envs] → [T·N_envs] and ship to the mesh, batch
-        # sharded over `data` (pixels stay uint8 until inside jit).
-        flat = {
-            k: np.asarray(v).reshape(-1, *np.asarray(v).shape[2:]) for k, v in local_data.items()
-        }
-        if cfg.buffer.get("share_data", False) and world_size > 1:
-            # Every process trains on the union of all rollouts
-            # (reference: fabric.all_gather, ppo.py:363-367) — DCN-level
-            # host gather; within one process the mesh already sees all data.
-            from jax.experimental import multihost_utils
-
-            gathered = multihost_utils.process_allgather(flat)
-            flat = {k: v.reshape(-1, *v.shape[2:]) for k, v in gathered.items()}
-        sharded = runtime.shard_batch(flat)
+        next_obs_np = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
+        data, jnp_next = ship_rollout(
+            runtime,
+            local_data,
+            (*obs_keys, "actions", "logprobs"),
+            next_obs_np,
+            share_data=bool(cfg.buffer.get("share_data", False)),
+        )
 
         with timer("Time/train_time"):
             # PRNG split runs inside the jit (an eager split on a remote
@@ -358,7 +377,8 @@ def main(runtime, cfg: Dict[str, Any]):
             params, opt_state, train_metrics, train_key = train_fn(
                 params,
                 opt_state,
-                sharded,
+                data,
+                jnp_next,
                 train_key,
                 np.asarray(cfg.algo.clip_coef, np.float32),
                 np.asarray(cfg.algo.ent_coef, np.float32),

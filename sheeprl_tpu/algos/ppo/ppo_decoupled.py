@@ -205,7 +205,9 @@ def main(runtime, cfg: Dict[str, Any]):
             rewards, values, dones, next_values, cfg.algo.gamma, cfg.algo.gae_lambda
         )
     )
-    train_fn = make_train_step(agent, tx, cfg, trainer_mesh)
+    # fused_gae=False: decoupled keeps GAE on the PLAYER device (it owns
+    # the rollout) and scatters the finished flat pool to the trainers.
+    train_fn = make_train_step(agent, tx, cfg, trainer_mesh, fused_gae=False)
     batch_sharding = mesh_lib.batch_sharding(trainer_mesh)
 
     rollout_key, train_key = jax.random.split(jax.random.fold_in(runtime.root_key, rank))
