@@ -769,7 +769,7 @@ def validate_dreamer_v1(total_steps: int = 16384, episodes: int = 10):
     return r
 
 
-def validate_dreamer_v2(total_steps: int = 16384, episodes: int = 10):
+def validate_dreamer_v2(total_steps: int = 32768, episodes: int = 10):
     """DreamerV2 micro model (discrete latents, KL balancing, target
     critic) on CartPole-v1 state obs: random ~20, bar 150."""
     _setup_jax()
@@ -780,14 +780,14 @@ def validate_dreamer_v2(total_steps: int = 16384, episodes: int = 10):
     )
 
 
-def validate_dreamer_v3(total_steps: int = 16384, episodes: int = 10):
+def validate_dreamer_v3(total_steps: int = 32768, episodes: int = 10):
     """DreamerV3 micro model (symlog, two-hot heads) on CartPole-v1 state
     obs: random ~20, bar 150."""
     _setup_jax()
     return _dreamer_family_validate("dreamer_v3", "dreamer_v3", total_steps, episodes)
 
 
-def validate_dreamer_v3_bf16(total_steps: int = 16384, episodes: int = 10):
+def validate_dreamer_v3_bf16(total_steps: int = 32768, episodes: int = 10):
     """DreamerV3 under bf16-mixed — the TPU recipe default. Same bar as the
     32-true run: the precision default must preserve learning at returns,
     not just match loss curves over a short window (loss-parity discipline
@@ -800,7 +800,7 @@ def validate_dreamer_v3_bf16(total_steps: int = 16384, episodes: int = 10):
     return r
 
 
-def validate_dreamer_v2_bf16(total_steps: int = 16384, episodes: int = 10):
+def validate_dreamer_v2_bf16(total_steps: int = 32768, episodes: int = 10):
     """DreamerV2 under bf16-mixed: DV2's KL-balanced objective (no symlog)
     is numerically more fragile than DV3's, so the DV2 recipes' bf16-mixed
     default gets its own learning proof rather than inheriting DV3's."""
@@ -878,10 +878,22 @@ VALIDATORS = {
     "sac_ae": validate_sac_ae,
 }
 
-# Validators whose runtime exceeds this host class (documented, not skipped
-# silently): subset-run regeneration treats them as optional, and the report
-# prints their note when no recorded run exists.
+# Validators whose recorded run is PENDING for a documented reason — runtime
+# beyond this host class, or awaiting a re-run after a budget change. Not
+# skipped silently: subset-run regeneration treats them as optional and the
+# report prints the note whenever no recorded run exists. Remove an entry
+# once its row is recorded and trustworthy again.
 HW_GATED_NOTES = {
+    "dreamer_v2_bf16": (
+        "dreamer_v2 (bf16-mixed) is pending a re-run at the 32K budget: "
+        "round 4's deterministic seeding changed the data streams, and the "
+        "16K micro budget turned out to sit at DV2's learning knee (fresh "
+        "16K runs: 26.5 at 32-true, 87.4 at bf16 — above random ~20, below "
+        "the 150 bar; at 32K, 32-true reaches 383.0). The earlier 16K-era "
+        "299.1 record predated the deterministic streams and was evicted "
+        "rather than kept as evidence. Record with "
+        "`python scripts/validate_returns.py dreamer_v2_bf16` (~1 h CPU)."
+    ),
     "sac_ae": (
         "sac_ae at FULL scale (64×64, full-width conv stack) has no recorded "
         "run: measured at ~0.1 policy-steps/s on the 1-core build host, the "
