@@ -884,9 +884,23 @@ VALIDATORS = {
 # report prints the note whenever no recorded run exists. Remove an entry
 # once its row is recorded and trustworthy again.
 HW_GATED_NOTES = {
+    "sac_ae_small": (
+        "sac_ae_small (the REDUCED-scale pixel probe: 32×32, quarter-width "
+        "conv, 6,144-step budget, beats-untrained bar −900) was launched "
+        "this round and consumed 4.5+ hours of PURE CPU (the process was "
+        "metered) without reaching its first checkpoint at 4,096 policy "
+        "steps (1,000 of them prefill) — an effective ≲0.2 trained-steps/s "
+        "of dedicated core, putting the full probe at roughly 8 h of "
+        "dedicated 1-core compute. The run was left training at round end; "
+        "it checkpoints at 4,096 and saves on completion, after which "
+        "`python scripts/validate_returns.py sac_ae_small` records a fresh "
+        "deterministic run (same seed ⇒ same numbers) on a less starved "
+        "host. Every cheaper layer of SAC-AE evidence is in the suite: "
+        "dry-run e2e, pixel pipeline, checkpoint round-trip."
+    ),
     "dreamer_v3_bf16": (
         "dreamer_v3 (bf16-mixed) is pending a re-run at the 32K budget "
-        "(same story as dreamer_v2_bf16 below: the fresh 16K run reached "
+        "(same story as dreamer_v2_bf16: the fresh 16K run reached "
         "117.6 — above random ~20, below the 150 bar — at the learning-knee "
         "budget; the stale 16K-era 162.5 predated the deterministic streams "
         "and was evicted). The 32-true dreamer_v3 row IS freshly recorded "
