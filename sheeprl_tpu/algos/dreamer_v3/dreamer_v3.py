@@ -557,6 +557,11 @@ def main(runtime, cfg: Dict[str, Any]):
     # Bound async in-flight train dispatches (core/runtime.py: an
     # unbounded queue pins every pending call's sampled batch on host).
     dispatch_throttle = DispatchThrottle()
+    # Train losses stay device-resident between log intervals; ONE coalesced
+    # jax.device_get per interval replaces the per-train-call fetch (each
+    # fetch is a full round trip over a tunneled chip). Scalars only, so the
+    # pinned device memory is negligible.
+    pending_train_metrics = []
     for iter_num in range(start_iter, total_iters + 1):
         policy_step += policy_steps_per_iter
 
@@ -580,8 +585,11 @@ def main(runtime, cfg: Dict[str, Any]):
                     )
                 # One host fetch for both arrays: each separate np.asarray
                 # is a full device->host roundtrip (painful over a tunneled
-                # chip); jax.device_get of the tuple costs one.
-                actions, real_actions = jax.device_get((actions_cat, real_actions_j))
+                # chip); jax.device_get of the tuple costs one. Structural
+                # per-step sync: the actions must reach env.step on host.
+                actions, real_actions = jax.device_get(  # graftlint: disable=GL002
+                    (actions_cat, real_actions_j)
+                )
 
             step_data["actions"] = actions.reshape((1, cfg.env.num_envs, -1))
             rb.add(step_data, validate_args=cfg.buffer.validate_args)
@@ -690,7 +698,8 @@ def main(runtime, cfg: Dict[str, Any]):
                     # with metrics off the dispatch stays fully async, so the
                     # H2D infeed + train overlap the next env steps.
                     if not timer.disabled:
-                        jax.block_until_ready(agent_state["world_model"])
+                        # Deliberate: the train timer needs an accurate stop.
+                        jax.block_until_ready(agent_state["world_model"])  # graftlint: disable=GL002
                     # One mirror refresh per train call (the player only acts
                     # again after the whole gradient-step loop, so this is
                     # exactly the reference's tied-weights freshness).
@@ -704,20 +713,25 @@ def main(runtime, cfg: Dict[str, Any]):
 
                 # Feed EVERY gradient step's losses to the aggregator (the
                 # reference updates per step; only sampling the last one
-                # under-reports the training signal).
-                if aggregator and not aggregator.disabled:
-                    # One host fetch for every metric of every gradient step
-                    # (each np.asarray would be its own roundtrip).
-                    for m in jax.device_get(per_step_metrics):
-                        for k, v in m.items():
-                            if k in aggregator:
-                                aggregator.update(k, v)
+                # under-reports the training signal). No fetch here: the
+                # scalars queue device-side until the log-interval flush.
+                if aggregator and not aggregator.disabled and cfg.metric.log_level > 0:
+                    pending_train_metrics.extend(per_step_metrics)
 
         # -------------------------------------------------------- logging
         should_log = cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
         )
         if should_log and aggregator and not aggregator.disabled:
+            if pending_train_metrics:
+                # The whole interval's losses in ONE device->host transfer —
+                # the coalesced pattern GL002 asks for (hence the explicit
+                # opt-out on a deliberate inside-the-loop sync).
+                for m in jax.device_get(pending_train_metrics):  # graftlint: disable=GL002
+                    for k, v in m.items():
+                        if k in aggregator:
+                            aggregator.update(k, v)
+                pending_train_metrics.clear()
             # Collective when sync_on_compute is on: every rank joins;
             # only rank 0 (the only rank with a logger) writes.
             aggregator.log_and_reset(logger, policy_step)

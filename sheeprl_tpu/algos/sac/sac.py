@@ -257,6 +257,11 @@ def main(runtime, cfg: Dict[str, Any]):
     # Bound async in-flight train dispatches (core/runtime.py: an
     # unbounded queue pins every pending call's sampled batch on host).
     dispatch_throttle = DispatchThrottle()
+    # Train losses stay device-resident between log intervals; ONE coalesced
+    # jax.device_get per interval replaces the per-iteration fetch (each
+    # fetch is a full round trip over a tunneled chip). Scalars only, so the
+    # pinned device memory is negligible.
+    pending_train_metrics = []
     for iter_num in range(start_iter, total_iters + 1):
         policy_step += policy_steps_per_iter
 
@@ -335,22 +340,30 @@ def main(runtime, cfg: Dict[str, Any]):
                     # with metrics off the dispatch stays fully async, so the
                     # H2D infeed + train overlap the next env steps.
                     if not timer.disabled:
-                        jax.block_until_ready(agent_state["actor"])
+                        # Deliberate: the train timer needs an accurate stop.
+                        jax.block_until_ready(agent_state["actor"])  # graftlint: disable=GL002
                     placement.push(agent_state["actor"])
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                 train_step_count += world_size
 
-                if aggregator and not aggregator.disabled:
-                    # One host fetch for the whole metrics dict (single roundtrip).
-                    tm = jax.device_get(train_metrics)
-                    aggregator.update("Loss/value_loss", tm["value_loss"])
-                    aggregator.update("Loss/policy_loss", tm["policy_loss"])
-                    aggregator.update("Loss/alpha_loss", tm["alpha_loss"])
+                if aggregator and not aggregator.disabled and cfg.metric.log_level > 0:
+                    # No fetch here: the loss scalars queue device-side until
+                    # the log-interval flush below.
+                    pending_train_metrics.append(train_metrics)
 
         should_log = cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
         )
         if should_log and aggregator and not aggregator.disabled:
+            if pending_train_metrics:
+                # The whole interval's losses in ONE device->host transfer —
+                # the coalesced pattern GL002 asks for (hence the explicit
+                # opt-out on a deliberate inside-the-loop sync).
+                for tm in jax.device_get(pending_train_metrics):  # graftlint: disable=GL002
+                    aggregator.update("Loss/value_loss", tm["value_loss"])
+                    aggregator.update("Loss/policy_loss", tm["policy_loss"])
+                    aggregator.update("Loss/alpha_loss", tm["alpha_loss"])
+                pending_train_metrics.clear()
             # Collective when sync_on_compute is on: every rank joins;
             # only rank 0 (the only rank with a logger) writes.
             aggregator.log_and_reset(logger, policy_step)

@@ -1,0 +1,32 @@
+"""graftlint: a first-party JAX correctness linter for sheeprl-tpu.
+
+The TPU-native rewrite moved the correctness hazards from torch semantics to
+JAX semantics: PRNG key reuse, silent host<->device syncs inside hot loops,
+jit recompilation traps, and version-fragile `jax.*` import surfaces. This
+subsystem machine-checks those bug classes over the package source so later
+perf/sharding PRs cannot silently reintroduce them.
+
+Usage:
+    python -m sheeprl_tpu.analysis [paths] [--json] [--baseline FILE]
+
+Rules (each suppressible per line with ``# graftlint: disable=<ID>``):
+    GL001  PRNG key reuse without an intervening split/fold_in
+    GL002  host-device sync inside jit-compiled code
+    GL003  version-fragile `from jax import ...` surface
+    GL004  jit recompilation hazards (traced branching, unhashable statics)
+    GL005  donated-buffer read after donation
+"""
+
+from sheeprl_tpu.analysis.finding import Finding
+from sheeprl_tpu.analysis.registry import RULES, all_rules, register_rule
+from sheeprl_tpu.analysis.runner import lint_file, lint_paths, lint_source
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "all_rules",
+    "register_rule",
+    "lint_file",
+    "lint_paths",
+    "lint_source",
+]

@@ -1,0 +1,95 @@
+"""CLI: `python -m sheeprl_tpu.analysis [paths] [options]`.
+
+Exit codes: 0 = clean (after baseline/suppressions), 1 = new findings,
+2 = usage error. Deliberately imports no jax — the linter must run in
+environments where the accelerator stack is absent or broken.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from sheeprl_tpu.analysis.baseline import (
+    BASELINE_FILENAME,
+    apply_baseline,
+    discover_baseline,
+    load_baseline,
+    save_baseline,
+)
+from sheeprl_tpu.analysis.registry import all_rules
+from sheeprl_tpu.analysis.reporter import render_json, render_text
+from sheeprl_tpu.analysis.runner import lint_paths
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m sheeprl_tpu.analysis",
+        description="graftlint: JAX correctness linter for sheeprl-tpu",
+    )
+    parser.add_argument("paths", nargs="*", default=["sheeprl_tpu"], help="files or directories to lint")
+    parser.add_argument("--json", action="store_true", help="emit the stable JSON report instead of text")
+    parser.add_argument("--baseline", default=None, help=f"baseline file (default: nearest {BASELINE_FILENAME})")
+    parser.add_argument("--no-baseline", action="store_true", help="ignore any baseline file")
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="write the current findings as the new baseline and exit 0",
+    )
+    parser.add_argument(
+        "--select",
+        default=None,
+        help="comma-separated rule IDs to run (default: all)",
+    )
+    parser.add_argument("--list-rules", action="store_true", help="print the rule table and exit")
+    return parser
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.id}  {rule.name}: {rule.rationale}")
+        return 0
+
+    for path in args.paths:
+        if not os.path.exists(path):
+            print(f"graftlint: path does not exist: {path}", file=sys.stderr)
+            return 2
+
+    rules = None
+    if args.select:
+        rules = [r.strip().upper() for r in args.select.split(",") if r.strip()]
+        known = {r.id for r in all_rules()}
+        unknown = sorted(set(rules) - known)
+        if unknown:
+            print(f"graftlint: unknown rule(s): {', '.join(unknown)}", file=sys.stderr)
+            return 2
+
+    baseline_path = args.baseline
+    if baseline_path is None and not args.no_baseline:
+        baseline_path = discover_baseline(os.path.abspath(args.paths[0]))
+    root = os.path.dirname(os.path.abspath(baseline_path)) if baseline_path else os.getcwd()
+
+    findings, files_scanned, suppressed = lint_paths(args.paths, root=root, rules=rules)
+
+    if args.write_baseline:
+        target = baseline_path or os.path.join(os.getcwd(), BASELINE_FILENAME)
+        save_baseline(target, findings)
+        print(f"graftlint: wrote {len(findings)} baseline entr(ies) to {target}")
+        return 0
+
+    baselined = 0
+    if baseline_path and not args.no_baseline:
+        findings, baselined = apply_baseline(findings, load_baseline(baseline_path))
+
+    render = render_json if args.json else render_text
+    print(render(findings, files_scanned, baselined=baselined, suppressed=suppressed))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,79 @@
+"""Baseline: grandfathered findings whose count can only go down.
+
+The baseline is a checked-in JSON file. Entries match on
+(rule, path, stripped source line) rather than line numbers, so edits above
+a grandfathered finding don't invalidate it, while *any* new violation —
+including a second copy of an already-baselined line — fails. Matching
+consumes entries one-for-one.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter
+from typing import Dict, List, Optional, Tuple
+
+from sheeprl_tpu.analysis.finding import Finding
+
+BASELINE_FILENAME = ".graftlint-baseline.json"
+BASELINE_SCHEMA_VERSION = 1
+
+
+def discover_baseline(start: str) -> Optional[str]:
+    """Walk up from `start` looking for the repo baseline file."""
+    current = os.path.abspath(start)
+    if os.path.isfile(current):
+        current = os.path.dirname(current)
+    while True:
+        candidate = os.path.join(current, BASELINE_FILENAME)
+        if os.path.isfile(candidate):
+            return candidate
+        parent = os.path.dirname(current)
+        if parent == current:
+            return None
+        current = parent
+
+
+def load_baseline(path: str) -> Counter:
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    entries = data.get("entries", [])
+    return Counter((e["rule"], e["path"], e["snippet"]) for e in entries)
+
+
+def save_baseline(path: str, findings: List[Finding]) -> None:
+    entries = [
+        {"rule": f.rule, "path": f.path, "snippet": f.snippet}
+        for f in sorted(findings, key=lambda f: (f.path, f.line, f.col, f.rule))
+    ]
+    payload = {
+        "schema_version": BASELINE_SCHEMA_VERSION,
+        "tool": "graftlint",
+        "note": (
+            "Grandfathered findings. This count may only decrease: fix a "
+            "finding, then regenerate with "
+            "`python -m sheeprl_tpu.analysis sheeprl_tpu/ --write-baseline`."
+        ),
+        "entries": entries,
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+
+
+def apply_baseline(
+    findings: List[Finding], baseline: Counter
+) -> Tuple[List[Finding], int]:
+    """Split into (new findings, matched count), consuming baseline entries."""
+    remaining = Counter(baseline)
+    new: List[Finding] = []
+    matched = 0
+    for finding in findings:
+        key = finding.baseline_key()
+        if remaining[key] > 0:
+            remaining[key] -= 1
+            matched += 1
+        else:
+            new.append(finding)
+    return new, matched

@@ -1,0 +1,287 @@
+"""Shared AST infrastructure: import resolution, suppressions, jit tracking.
+
+Every rule runs against one `LintContext` per file. The context owns the
+parsed tree, an import-alias resolver (so `@partial(jit, ...)` and
+`@functools.partial(jax.jit, ...)` resolve to the same dotted path), the
+per-line suppression table, and the jit index: which function bodies execute
+under a trace (directly jitted, referenced by `jax.jit(f)`, or passed as a
+body to `lax.scan` / `lax.cond` / `lax.while_loop` / `lax.fori_loop`).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from sheeprl_tpu.analysis.finding import Finding
+
+_SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+# lax control-flow entry points whose callable arguments trace under jit
+# semantics even when the enclosing function is not itself jitted.
+_TRACING_CALLABLES = {
+    "jax.lax.scan": (0,),
+    "jax.lax.cond": (1, 2),
+    "jax.lax.switch": None,  # every arg past the index may be a branch
+    "jax.lax.while_loop": (0, 1),
+    "jax.lax.fori_loop": (2,),
+    "jax.lax.map": (0,),
+    "jax.lax.associative_scan": (0,),
+}
+
+_JIT_PATHS = {"jax.jit", "jax.pmap"}
+_PARTIAL_PATHS = {"functools.partial"}
+
+
+class ImportResolver(ast.NodeVisitor):
+    """Maps local names to canonical dotted paths via the file's imports."""
+
+    def __init__(self) -> None:
+        self.aliases: Dict[str, str] = {}
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            local = alias.asname or alias.name.split(".")[0]
+            target = alias.name if alias.asname else alias.name.split(".")[0]
+            self.aliases[local] = target
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.level or not node.module:
+            return
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            local = alias.asname or alias.name
+            self.aliases[local] = f"{node.module}.{alias.name}"
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Dotted path for a Name/Attribute chain, or None if unresolvable."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        base = self.aliases.get(node.id, node.id)
+        parts.append(base)
+        return ".".join(reversed(parts))
+
+
+@dataclass
+class JitFunction:
+    """A function body that traces under jit, plus its decorator metadata."""
+
+    node: ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+    reason: str  # "jit" | "lax-body" | "nested"
+    static_argnames: Set[str] = field(default_factory=set)
+    static_argnums: Tuple[int, ...] = ()
+    donate_argnums: Tuple[int, ...] = ()
+
+    @property
+    def name(self) -> str:
+        return getattr(self.node, "name", "<lambda>")
+
+    def params(self) -> List[str]:
+        args = self.node.args
+        names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+        if args.vararg:
+            names.append(args.vararg.arg)
+        if args.kwarg:
+            names.append(args.kwarg.arg)
+        return names
+
+    def traced_params(self) -> Set[str]:
+        """Parameter names that arrive as tracers (non-static positions)."""
+        args = self.node.args
+        positional = [a.arg for a in args.posonlyargs + args.args]
+        static = set(self.static_argnames)
+        for i in self.static_argnums:
+            if 0 <= i < len(positional):
+                static.add(positional[i])
+        return {p for p in self.params() if p not in static}
+
+
+def _const_ints(node: Optional[ast.AST]) -> Tuple[int, ...]:
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+        return tuple(out)
+    return ()
+
+
+def _const_strs(node: Optional[ast.AST]) -> Set[str]:
+    if node is None:
+        return set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return {e.value for e in node.elts if isinstance(e, ast.Constant) and isinstance(e.value, str)}
+    return set()
+
+
+def parse_jit_call(call: ast.Call, resolver: ImportResolver) -> Optional[JitFunction]:
+    """If `call` is jax.jit(...) or partial(jax.jit, ...), extract metadata.
+
+    Returns a JitFunction with node=None-like placeholder metadata holder;
+    the caller attaches the actual function node.
+    """
+    path = resolver.resolve(call.func)
+    keywords = {k.arg: k.value for k in call.keywords if k.arg}
+    if path in _PARTIAL_PATHS and call.args:
+        inner = resolver.resolve(call.args[0])
+        if inner not in _JIT_PATHS:
+            return None
+    elif path not in _JIT_PATHS:
+        return None
+    meta = JitFunction(node=None, reason="jit")  # type: ignore[arg-type]
+    meta.static_argnums = _const_ints(keywords.get("static_argnums"))
+    meta.static_argnames = _const_strs(keywords.get("static_argnames"))
+    meta.donate_argnums = _const_ints(keywords.get("donate_argnums"))
+    return meta
+
+
+class _JitIndexBuilder(ast.NodeVisitor):
+    """Finds every function body that runs under a trace."""
+
+    def __init__(self, resolver: ImportResolver) -> None:
+        self.resolver = resolver
+        self.jitted: List[JitFunction] = []
+        self._local_defs: Dict[str, ast.AST] = {}
+        self._claimed: Set[ast.AST] = set()
+
+    def build(self, tree: ast.Module) -> List[JitFunction]:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._local_defs.setdefault(node.name, node)
+        self.visit(tree)
+        return self.jitted
+
+    def _claim(self, fn_node: ast.AST, meta: JitFunction) -> None:
+        if fn_node in self._claimed:
+            return
+        self._claimed.add(fn_node)
+        meta.node = fn_node
+        self.jitted.append(meta)
+
+    def _resolve_callable_arg(self, arg: ast.AST) -> Optional[ast.AST]:
+        if isinstance(arg, ast.Lambda):
+            return arg
+        if isinstance(arg, ast.Name) and arg.id in self._local_defs:
+            return self._local_defs[arg.id]
+        return None
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_def(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._visit_def(node)
+
+    def _visit_def(self, node) -> None:
+        for dec in node.decorator_list:
+            if isinstance(dec, ast.Call):
+                meta = parse_jit_call(dec, self.resolver)
+                if meta is not None:
+                    self._claim(node, meta)
+            elif self.resolver.resolve(dec) in _JIT_PATHS:
+                self._claim(node, JitFunction(node=node, reason="jit"))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        path = self.resolver.resolve(node.func)
+        # f = jax.jit(g[, static_argnums=...]) — g's body traces.
+        meta = parse_jit_call(node, self.resolver)
+        if meta is not None:
+            args = node.args
+            # partial(jax.jit, ...) wraps later; jax.jit(g) names g first.
+            candidates = args[1:] if self.resolver.resolve(node.func) in _PARTIAL_PATHS else args[:1]
+            for arg in candidates:
+                fn_node = self._resolve_callable_arg(arg)
+                if fn_node is not None:
+                    self._claim(fn_node, meta)
+        # lax.scan(body, ...) etc. — body traces even outside any jit.
+        if path in _TRACING_CALLABLES:
+            positions = _TRACING_CALLABLES[path]
+            args = node.args if positions is None else [
+                node.args[i] for i in positions if i < len(node.args)
+            ]
+            for arg in args:
+                fn_node = self._resolve_callable_arg(arg)
+                if fn_node is not None:
+                    self._claim(fn_node, JitFunction(node=fn_node, reason="lax-body"))
+        self.generic_visit(node)
+
+
+def parse_suppressions(source: str) -> Dict[int, Set[str]]:
+    """Per-line `# graftlint: disable=GL001[,GL002|all]` table (1-indexed)."""
+    table: Dict[int, Set[str]] = {}
+    for lineno, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            ids = {part.strip().upper() for part in m.group(1).split(",") if part.strip()}
+            table[lineno] = {("ALL" if i == "ALL" else i) for i in ids}
+    return table
+
+
+class LintContext:
+    """Everything a rule needs to analyze one file."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module) -> None:
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.resolver = ImportResolver()
+        self.resolver.visit(tree)
+        self.suppressions = parse_suppressions(source)
+        self._jit_index: Optional[List[JitFunction]] = None
+        self.findings: List[Finding] = []
+        self.suppressed_count = 0
+
+    def jitted_functions(self) -> List[JitFunction]:
+        if self._jit_index is None:
+            self._jit_index = _JitIndexBuilder(self.resolver).build(self.tree)
+        return self._jit_index
+
+    def iter_jit_bodies(self) -> Iterator[Tuple[JitFunction, ast.AST]]:
+        """(jit metadata, body node) pairs, including nested defs: anything
+        lexically inside a jitted function traces with it."""
+        seen: Set[ast.AST] = set()
+        for jf in self.jitted_functions():
+            if jf.node in seen:
+                continue
+            seen.add(jf.node)
+            yield jf, jf.node
+
+    def snippet(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def is_suppressed(self, rule: str, lineno: int) -> bool:
+        ids = self.suppressions.get(lineno, set())
+        return "ALL" in ids or rule.upper() in ids
+
+    def report(self, rule: str, node: ast.AST, message: str) -> None:
+        lineno = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        if self.is_suppressed(rule, lineno):
+            self.suppressed_count += 1
+            return
+        finding = Finding(
+            rule=rule,
+            path=self.path,
+            line=lineno,
+            col=col + 1,
+            message=message,
+            snippet=self.snippet(lineno),
+        )
+        if finding not in self.findings:
+            self.findings.append(finding)

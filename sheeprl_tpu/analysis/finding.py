@@ -1,0 +1,32 @@
+"""Finding record shared by every rule, the reporters, and the baseline."""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One violation at a source location.
+
+    ``snippet`` is the stripped source line: the baseline matches on
+    (rule, path, snippet) rather than line numbers, so unrelated edits above
+    a grandfathered finding do not invalidate the baseline.
+    """
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    snippet: str = field(default="", compare=False)
+
+    def format_text(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def to_json(self) -> Dict[str, Any]:
+        return asdict(self)
+
+    def baseline_key(self) -> tuple:
+        return (self.rule, self.path, self.snippet)

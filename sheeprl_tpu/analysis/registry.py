@@ -1,0 +1,40 @@
+"""Rule registry: stable IDs, one instance per rule, deterministic order."""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Dict, List, Type
+
+from sheeprl_tpu.analysis.context import LintContext
+
+_RULE_ID_RE = re.compile(r"^GL\d{3}$")
+
+RULES: Dict[str, "Rule"] = {}
+
+
+class Rule:
+    """Base class. Subclasses set `id`, `name`, `rationale` and implement
+    `check(ctx)`, reporting through `ctx.report(self.id, node, message)`."""
+
+    id: str = ""
+    name: str = ""
+    rationale: str = ""
+
+    def check(self, ctx: LintContext) -> None:
+        raise NotImplementedError
+
+
+def register_rule(cls: Type[Rule]) -> Type[Rule]:
+    if not _RULE_ID_RE.match(cls.id):
+        raise ValueError(f"rule id {cls.id!r} must match GLnnn")
+    if cls.id in RULES:
+        raise ValueError(f"duplicate rule id {cls.id}")
+    RULES[cls.id] = cls()
+    return cls
+
+
+def all_rules() -> List[Rule]:
+    # Import for side effect: each rule module registers itself on import.
+    import sheeprl_tpu.analysis.rules  # noqa: F401
+
+    return [RULES[k] for k in sorted(RULES)]

@@ -1,0 +1,56 @@
+"""Text and JSON reporters. The JSON schema is stable and covered by tests."""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+from sheeprl_tpu.analysis.finding import Finding
+
+JSON_SCHEMA_VERSION = 1
+
+
+def render_text(
+    findings: List[Finding],
+    files_scanned: int,
+    baselined: int = 0,
+    suppressed: int = 0,
+) -> str:
+    lines = [f.format_text() for f in findings]
+    by_rule: Dict[str, int] = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    summary = ", ".join(f"{rule}: {n}" for rule, n in sorted(by_rule.items()))
+    tail = (
+        f"graftlint: {len(findings)} finding(s) in {files_scanned} file(s)"
+        + (f" [{summary}]" if summary else "")
+        + (f"; {baselined} baselined" if baselined else "")
+        + (f"; {suppressed} suppressed" if suppressed else "")
+    )
+    lines.append(tail)
+    return "\n".join(lines)
+
+
+def render_json(
+    findings: List[Finding],
+    files_scanned: int,
+    baselined: int = 0,
+    suppressed: int = 0,
+) -> str:
+    payload: Dict[str, Any] = {
+        "schema_version": JSON_SCHEMA_VERSION,
+        "tool": "graftlint",
+        "files_scanned": files_scanned,
+        "baselined": baselined,
+        "suppressed": suppressed,
+        "findings": [f.to_json() for f in findings],
+        "counts": _counts(findings),
+    }
+    return json.dumps(payload, indent=2, sort_keys=False)
+
+
+def _counts(findings: List[Finding]) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    return counts

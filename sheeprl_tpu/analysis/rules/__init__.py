@@ -1,0 +1,9 @@
+"""Rule modules register themselves on import; keep this list exhaustive."""
+
+from sheeprl_tpu.analysis.rules import (  # noqa: F401
+    gl001_key_reuse,
+    gl002_host_sync,
+    gl003_import_surface,
+    gl004_recompile,
+    gl005_donation,
+)

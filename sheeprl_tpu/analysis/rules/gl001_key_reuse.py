@@ -1,0 +1,205 @@
+"""GL001: PRNG key reuse.
+
+JAX PRNG keys are values, not stateful generators: feeding the same key to
+two `jax.random.*` consumers yields *identical* randomness — on TPU this
+silently correlates exploration noise, dropout masks, and minibatch shuffles
+across consumers instead of raising. The fix is always an intervening
+`jax.random.split` or a `jax.random.fold_in` derivation.
+
+Analysis: per-scope linear scan with branch merging. A variable becomes a
+tracked key when assigned from a key-producing call (`PRNGKey`, `key`,
+`split`, `fold_in`, `clone`, `wrap_key_data`) or when it is a parameter whose
+name contains ``key``/``rng``. Every `jax.random.*` call that consumes the
+key (everything except the deriving functions) increments its use count;
+the second consumption without reassignment is flagged. `fold_in(key, i)` is
+deliberately non-consuming: deriving many streams from one parent with
+varying data is the recommended idiom.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from sheeprl_tpu.analysis.context import LintContext
+from sheeprl_tpu.analysis.registry import Rule, register_rule
+
+_CREATORS = {
+    "jax.random.PRNGKey",
+    "jax.random.key",
+    "jax.random.split",
+    "jax.random.fold_in",
+    "jax.random.clone",
+    "jax.random.wrap_key_data",
+}
+# jax.random.* functions that do NOT consume the key passed to them.
+_NON_CONSUMING = {"fold_in", "PRNGKey", "key", "clone", "wrap_key_data", "key_data", "key_impl"}
+
+_KEYLIKE_PARAM = re.compile(r"(key|rng)", re.IGNORECASE)
+
+# state: var name -> (uses, last_consumer_line, last_consumer_fn)
+_State = Dict[str, Tuple[int, int, str]]
+
+
+@register_rule
+class KeyReuseRule(Rule):
+    id = "GL001"
+    name = "prng-key-reuse"
+    rationale = (
+        "The same PRNG key fed to two jax.random consumers produces identical "
+        "randomness; split or fold_in before reusing."
+    )
+
+    def check(self, ctx: LintContext) -> None:
+        self._ctx = ctx
+        self._scan_scope(ctx.tree.body, params=[])
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_scope(node.body, params=_param_names(node))
+
+    # ------------------------------------------------------------- scope scan
+    def _scan_scope(self, body: List[ast.stmt], params: List[str]) -> None:
+        state: _State = {p: (0, 0, "") for p in params if _KEYLIKE_PARAM.search(p)}
+        self._process_block(body, state)
+
+    def _process_block(self, body: List[ast.stmt], state: _State) -> None:
+        for stmt in body:
+            self._process_stmt(stmt, state)
+
+    def _process_stmt(self, stmt: ast.stmt, state: _State) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return  # separate scope, scanned by check()
+        if isinstance(stmt, ast.If):
+            then_state, else_state = dict(state), dict(state)
+            self._process_block(stmt.body, then_state)
+            self._process_block(stmt.orelse, else_state)
+            # A branch that leaves the scope (return/raise/...) contributes
+            # nothing to the fall-through state.
+            if _terminates(stmt.body):
+                then_state = None
+            if stmt.orelse and _terminates(stmt.orelse):
+                else_state = None
+            _merge_branches(state, then_state, else_state)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            # Two passes: the second catches keys consumed each iteration
+            # without being re-derived (state flows around the back edge).
+            loop_state = dict(state)
+            self._process_block(stmt.body, loop_state)
+            self._process_block(stmt.body, loop_state)
+            self._process_block(stmt.orelse, loop_state)
+            state.clear()
+            state.update(loop_state)
+            return
+        if isinstance(stmt, ast.Try):
+            self._process_block(stmt.body, state)
+            for handler in stmt.handlers:
+                branch = dict(state)
+                self._process_block(handler.body, branch)
+            self._process_block(stmt.orelse, state)
+            self._process_block(stmt.finalbody, state)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            self._process_block(stmt.body, state)
+            return
+        self._process_simple(stmt, state)
+
+    # -------------------------------------------------------- simple statement
+    def _process_simple(self, stmt: ast.stmt, state: _State) -> None:
+        resolver = self._ctx.resolver
+        for call in _calls_in_order(stmt):
+            path = resolver.resolve(call.func)
+            if not path or not path.startswith("jax.random."):
+                continue
+            fn = path.rsplit(".", 1)[1]
+            consuming = fn not in _NON_CONSUMING
+            args = list(call.args) + [kw.value for kw in call.keywords]
+            for arg in args:
+                if not isinstance(arg, ast.Name) or arg.id not in state:
+                    continue
+                uses, last_line, last_fn = state[arg.id]
+                if consuming:
+                    if uses >= 1:
+                        self._ctx.report(
+                            self.id,
+                            call,
+                            f"PRNG key `{arg.id}` reused: already consumed by "
+                            f"jax.random.{last_fn} at line {last_line}; "
+                            "split or fold_in before reusing",
+                        )
+                    state[arg.id] = (uses + 1, call.lineno, fn)
+        _apply_stores(stmt, state, resolver)
+
+
+def _param_names(node) -> List[str]:
+    args = node.args
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return names
+
+
+def _calls_in_order(stmt: ast.stmt) -> List[ast.Call]:
+    calls = [n for n in ast.walk(stmt) if isinstance(n, ast.Call)]
+    calls.sort(key=lambda n: (n.lineno, n.col_offset))
+    return calls
+
+
+def _target_names(target: ast.expr) -> List[str]:
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for elt in target.elts:
+            out.extend(_target_names(elt))
+        return out
+    return []
+
+
+def _apply_stores(stmt: ast.stmt, state: _State, resolver) -> None:
+    """Assignment targets become fresh keys (creator RHS) or untracked."""
+    targets: List[ast.expr] = []
+    value: Optional[ast.expr] = None
+    if isinstance(stmt, ast.Assign):
+        targets, value = stmt.targets, stmt.value
+    elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+        targets, value = [stmt.target], stmt.value
+    elif isinstance(stmt, ast.AugAssign):
+        targets, value = [stmt.target], stmt.value
+    else:
+        return
+    is_creator = (
+        isinstance(value, ast.Call) and resolver.resolve(value.func) in _CREATORS
+    )
+    for target in targets:
+        for name in _target_names(target):
+            if is_creator:
+                state[name] = (0, 0, "")
+            else:
+                state.pop(name, None)
+
+
+def _terminates(body: List[ast.stmt]) -> bool:
+    return bool(body) and isinstance(
+        body[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue)
+    )
+
+
+def _merge_branches(
+    state: _State, then_state: Optional[_State], else_state: Optional[_State]
+) -> None:
+    """Path-max merge: a var survives only if tracked on every live path; its
+    use count is the max over paths (uses never add across exclusive
+    branches). A terminated branch (None) is not a live path."""
+    live = [s for s in (then_state, else_state) if s is not None]
+    state.clear()
+    if not live:
+        return
+    names = set(live[0])
+    for s in live[1:]:
+        names &= set(s)
+    for name in names:
+        state[name] = max((s[name] for s in live), key=lambda t: t[0])

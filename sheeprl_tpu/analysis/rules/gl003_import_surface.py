@@ -1,0 +1,97 @@
+"""GL003: version-fragile `from jax import ...` surface.
+
+The jax top-level namespace churns between releases: names graduate out of
+`jax.experimental`, get deprecated, or move under submodules. An import of a
+name that does not exist in the pinned minimum jax fails at *import* time and
+takes the whole module (and every test that imports it) down — the seed
+shipped exactly this with `from jax import shard_map`, which only exists
+top-level in newer jax and broke test collection.
+
+Analysis: every `from jax import <name>` is validated against the frozen
+allowlist below (the exact public `dir(jax)` of the pinned jax 0.4.37).
+Known relocations get a fix-it hint pointing at the version-stable path.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from sheeprl_tpu.analysis.context import LintContext
+from sheeprl_tpu.analysis.registry import Rule, register_rule
+
+# Frozen from `sorted(n for n in dir(jax) if not n.startswith("_"))` on the
+# pinned minimum jax (0.4.37). Regenerate when the floor moves.
+ALLOWED_JAX_TOPLEVEL = frozenset({
+    "Array", "Device", "NamedSharding", "ShapeDtypeStruct", "Shard",
+    "api_util", "block_until_ready", "check_tracer_leaks", "checking_leaks",
+    "checkpoint", "checkpoint_policies", "clear_caches", "closure_convert",
+    "config", "core", "custom_batching", "custom_derivatives",
+    "custom_gradient", "custom_jvp", "custom_transpose", "custom_vjp",
+    "debug", "debug_infs", "debug_key_reuse", "debug_nans",
+    "default_backend", "default_device", "default_matmul_precision",
+    "default_prng_impl", "device_count", "device_get", "device_put",
+    "device_put_replicated", "device_put_sharded", "devices", "disable_jit",
+    "distributed", "dlpack", "dtypes", "effects_barrier", "enable_checks",
+    "enable_custom_prng", "enable_custom_vjp_by_custom_transpose",
+    "ensure_compile_time_eval", "errors", "eval_shape", "experimental",
+    "float0", "grad", "hessian", "host_count", "host_id", "host_ids",
+    "image", "interpreters", "jacfwd", "jacobian", "jacrev", "jax", "jit",
+    "jvp", "lax", "legacy_prng_key", "lib", "linear_transpose", "linearize",
+    "live_arrays", "local_device_count", "local_devices", "log_compiles",
+    "make_array_from_callback", "make_array_from_process_local_data",
+    "make_array_from_single_device_arrays", "make_jaxpr", "make_mesh",
+    "monitoring", "named_call", "named_scope", "nn", "no_tracing", "numpy",
+    "numpy_dtype_promotion", "numpy_rank_promotion", "ops", "pmap",
+    "print_environment_info", "process_count", "process_index",
+    "process_indices", "profiler", "pure_callback", "random", "remat",
+    "scipy", "sharding", "softmax_custom_jvp", "spmd_mode", "stages",
+    "threefry_partitionable", "transfer_guard",
+    "transfer_guard_device_to_device", "transfer_guard_device_to_host",
+    "transfer_guard_host_to_device", "tree", "tree_util", "typing", "util",
+    "value_and_grad", "version", "vjp", "vmap",
+})
+
+# Version-stable homes for names people reach for at jax top level.
+RELOCATIONS = {
+    "shard_map": "jax.experimental.shard_map",
+    "pjit": "jax.experimental.pjit",
+    "maps": "jax.experimental.maps",
+    "multihost_utils": "jax.experimental.multihost_utils",
+    "mesh_utils": "jax.experimental.mesh_utils",
+    "checkify": "jax.experimental.checkify",
+    "P": "jax.sharding (PartitionSpec)",
+    "PartitionSpec": "jax.sharding",
+    "Mesh": "jax.sharding",
+    "tree_map": "jax.tree_util (tree_map was removed from jax top level)",
+    "tree_leaves": "jax.tree_util",
+    "tree_flatten": "jax.tree_util",
+    "tree_unflatten": "jax.tree_util",
+}
+
+
+@register_rule
+class ImportSurfaceRule(Rule):
+    id = "GL003"
+    name = "fragile-jax-import"
+    rationale = (
+        "Importing a name absent from the pinned minimum jax fails at import "
+        "time and breaks test collection."
+    )
+
+    def check(self, ctx: LintContext) -> None:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ImportFrom):
+                continue
+            if node.level or node.module != "jax":
+                continue
+            for alias in node.names:
+                if alias.name == "*" or alias.name in ALLOWED_JAX_TOPLEVEL:
+                    continue
+                hint = RELOCATIONS.get(alias.name)
+                fix = f"; import it from `{hint}`" if hint else ""
+                ctx.report(
+                    self.id,
+                    node,
+                    f"`from jax import {alias.name}` does not exist in the "
+                    f"pinned minimum jax (0.4.37){fix}",
+                )

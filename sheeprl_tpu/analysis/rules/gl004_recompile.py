@@ -1,0 +1,143 @@
+"""GL004: jit recompilation hazards.
+
+Two trap classes that compile fine on the first call and then bite later:
+
+1. Python `if`/`while` on a traced argument inside a jitted body. Branching
+   needs a concrete bool, so tracing either raises
+   `TracerBoolConversionError` or — when the value sneaks in as a weakly-typed
+   python scalar — burns a silent recompile for every new value. The in-graph
+   forms are `lax.cond` / `lax.select` / `jnp.where`.
+
+2. Unhashable values (list/dict/set literals) passed for parameters declared
+   `static_argnums`/`static_argnames`. Static arguments key the jit cache by
+   hash, so every such call raises `ValueError: unhashable type` — or, with
+   tuple-coerced workarounds, recompiles per call.
+
+Comparisons that are static at trace time (`x is None`, `x is not None`,
+`isinstance(...)`) are exempt: tracers answer those without concretizing.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional
+
+from sheeprl_tpu.analysis.context import (
+    JitFunction,
+    LintContext,
+    parse_jit_call,
+)
+from sheeprl_tpu.analysis.registry import Rule, register_rule
+
+_UNHASHABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.DictComp, ast.ListComp, ast.SetComp)
+
+
+def _is_trace_static_test(test: ast.expr) -> bool:
+    """`x is None`-style tests resolve statically during tracing."""
+    if isinstance(test, ast.Compare) and all(
+        isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops
+    ):
+        return True
+    if isinstance(test, ast.Call) and isinstance(test.func, ast.Name) and test.func.id in (
+        "isinstance",
+        "hasattr",
+        "callable",
+    ):
+        return True
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _is_trace_static_test(test.operand)
+    return False
+
+
+def _names_in(node: ast.expr) -> set:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def jit_callables_by_name(ctx: LintContext) -> Dict[str, JitFunction]:
+    """Local name -> jit metadata, covering both `@jax.jit def f` (callable
+    as `f`) and `g = jax.jit(f, ...)` (callable as `g`)."""
+    out: Dict[str, JitFunction] = {}
+    for jf in ctx.jitted_functions():
+        if jf.reason == "jit" and hasattr(jf.node, "name"):
+            out[jf.node.name] = jf
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)):
+            continue
+        meta = parse_jit_call(node.value, ctx.resolver)
+        if meta is None:
+            continue
+        meta.node = node.value
+        for target in node.targets:
+            if isinstance(target, ast.Name):
+                out[target.id] = meta
+    return out
+
+
+@register_rule
+class RecompileRule(Rule):
+    id = "GL004"
+    name = "jit-recompile-hazard"
+    rationale = (
+        "Python branching on traced values and unhashable static arguments "
+        "either fail at trace time or recompile on every call."
+    )
+
+    def check(self, ctx: LintContext) -> None:
+        self._check_traced_branching(ctx)
+        self._check_unhashable_statics(ctx)
+
+    def _check_traced_branching(self, ctx: LintContext) -> None:
+        for jf, body in ctx.iter_jit_bodies():
+            traced = jf.traced_params()
+            for node in ast.walk(body):
+                test: Optional[ast.expr] = None
+                kind = ""
+                if isinstance(node, ast.If):
+                    test, kind = node.test, "if"
+                elif isinstance(node, ast.While):
+                    test, kind = node.test, "while"
+                if test is None or _is_trace_static_test(test):
+                    continue
+                offenders = _names_in(test) & traced
+                if offenders:
+                    names = ", ".join(f"`{n}`" for n in sorted(offenders))
+                    ctx.report(
+                        self.id,
+                        node,
+                        f"Python `{kind}` on traced argument(s) {names} of "
+                        f"`{jf.name}`: tracing cannot branch on device values; "
+                        "use lax.cond/jnp.where or mark the argument static",
+                    )
+
+    def _check_unhashable_statics(self, ctx: LintContext) -> None:
+        jitted = {
+            name: jf
+            for name, jf in jit_callables_by_name(ctx).items()
+            if jf.static_argnames or jf.static_argnums
+        }
+        if not jitted:
+            return
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)):
+                continue
+            jf = jitted.get(node.func.id)
+            if jf is None:
+                continue
+            for kw in node.keywords:
+                if kw.arg in jf.static_argnames and isinstance(kw.value, _UNHASHABLE_LITERALS):
+                    ctx.report(
+                        self.id,
+                        kw.value,
+                        f"unhashable literal for static argument `{kw.arg}` of "
+                        f"`{node.func.id}`: static args key the jit cache by "
+                        "hash; pass a tuple or hashable config object",
+                    )
+            for i in jf.static_argnums:
+                if i < len(node.args) and isinstance(node.args[i], _UNHASHABLE_LITERALS):
+                    ctx.report(
+                        self.id,
+                        node.args[i],
+                        f"unhashable literal at static position {i} of "
+                        f"`{node.func.id}`: static args key the jit cache by "
+                        "hash; pass a tuple or hashable config object",
+                    )

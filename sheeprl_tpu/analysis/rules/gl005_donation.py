@@ -1,0 +1,143 @@
+"""GL005: donated-buffer use-after-donate.
+
+`donate_argnums` hands an input buffer to XLA for in-place reuse — the big
+memory win for optimizer-state updates on TPU. But the python-side array is
+invalidated the moment the jitted call dispatches: reading it afterwards
+raises `RuntimeError: Array has been deleted` on device backends, while on
+CPU it often *works silently*, so the bug only fires when the code first
+touches real hardware. The safe pattern is rebinding the result over the
+donated name (`state = step(state, ...)`).
+
+Analysis: for every locally visible jitted callable with `donate_argnums`,
+each call site's donated positional arguments (plain names) are tracked
+through the remainder of the enclosing scope in source order; a read before
+any rebind is flagged. Rebinding via the call's own assignment targets
+(`state, aux = step(state)`) clears the name immediately.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from sheeprl_tpu.analysis.context import LintContext
+from sheeprl_tpu.analysis.registry import Rule, register_rule
+from sheeprl_tpu.analysis.rules.gl004_recompile import jit_callables_by_name
+
+_SCOPE_BARRIERS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+
+
+def _walk_scope(node: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk that stays in the current scope (no nested def/class/lambda)."""
+    stack = [node]
+    while stack:
+        current = stack.pop()
+        yield current
+        for child in ast.iter_child_nodes(current):
+            if isinstance(child, _SCOPE_BARRIERS):
+                continue
+            stack.append(child)
+
+
+def _scopes(tree: ast.Module) -> Iterator[List[ast.stmt]]:
+    yield tree.body
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node.body
+
+
+def _stmt_containing(body: List[ast.stmt], call: ast.Call) -> Optional[ast.stmt]:
+    for stmt in body:
+        if any(n is call for n in _walk_scope(stmt)):
+            return stmt
+    return None
+
+
+@register_rule
+class DonationRule(Rule):
+    id = "GL005"
+    name = "use-after-donate"
+    rationale = (
+        "Buffers donated to a jitted call are invalidated at dispatch; "
+        "reading one afterwards crashes on device backends."
+    )
+
+    def check(self, ctx: LintContext) -> None:
+        donating = {
+            name: jf
+            for name, jf in jit_callables_by_name(ctx).items()
+            if jf.donate_argnums
+        }
+        if not donating:
+            return
+        for body in _scopes(ctx.tree):
+            self._check_scope(ctx, donating, body)
+
+    def _check_scope(self, ctx: LintContext, donating: Dict, body: List[ast.stmt]) -> None:
+        calls = [
+            n
+            for stmt in body
+            for n in _walk_scope(stmt)
+            if isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Name)
+            and n.func.id in donating
+        ]
+        for call in calls:
+            jf = donating[call.func.id]
+            donated: Set[str] = {
+                call.args[i].id
+                for i in jf.donate_argnums
+                if i < len(call.args) and isinstance(call.args[i], ast.Name)
+            }
+            if not donated:
+                continue
+            stmt = _stmt_containing(body, call)
+            if stmt is None:
+                continue
+            # Rebinding through the call's own assignment targets is the
+            # sanctioned pattern: those names are alive again immediately.
+            # Search the innermost enclosing Assign (the call may sit inside
+            # an `if`/`with` block of this scope).
+            for node in _walk_scope(stmt):
+                if isinstance(node, ast.Assign) and any(
+                    n is call for n in _walk_scope(node.value)
+                ):
+                    for target in node.targets:
+                        donated -= {
+                            n.id for n in ast.walk(target) if isinstance(n, ast.Name)
+                        }
+                    break
+            if not donated:
+                continue
+            self._scan_after(ctx, call, donated, body, stmt)
+
+    def _scan_after(
+        self,
+        ctx: LintContext,
+        call: ast.Call,
+        donated: Set[str],
+        body: List[ast.stmt],
+        call_stmt: ast.stmt,
+    ) -> None:
+        end = (call.end_lineno or call.lineno, call.end_col_offset or call.col_offset)
+        start_idx = body.index(call_stmt)
+        events: List[Tuple[int, int, str, str, ast.Name]] = []
+        for stmt in body[start_idx:]:
+            for node in _walk_scope(stmt):
+                if isinstance(node, ast.Name) and node.id in donated:
+                    kind = "store" if isinstance(node.ctx, (ast.Store, ast.Del)) else "load"
+                    events.append((node.lineno, node.col_offset, kind, node.id, node))
+        events.sort(key=lambda e: (e[0], e[1]))
+        decided: Set[str] = set()
+        for lineno, col, kind, name, node in events:
+            if (lineno, col) <= end or name in decided:
+                continue
+            decided.add(name)
+            if kind == "load":
+                ctx.report(
+                    self.id,
+                    node,
+                    f"`{name}` was donated to `{call.func.id}` at line "
+                    f"{call.lineno} (donate_argnums) and is read afterwards; "
+                    "the buffer is invalidated on device — rebind the result",
+                )

@@ -1,0 +1,102 @@
+"""Orchestration: walk paths, run every rule per file, collect findings."""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, List, Optional, Tuple
+
+from sheeprl_tpu.analysis.context import LintContext
+from sheeprl_tpu.analysis.finding import Finding
+from sheeprl_tpu.analysis.registry import all_rules
+
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules", "build", "dist"}
+
+
+def lint_source(
+    source: str, path: str = "<string>", rules: Optional[Iterable[str]] = None
+) -> Tuple[List[Finding], int]:
+    """Lint one source blob. Returns (findings, suppressed count).
+
+    A syntax error surfaces as a GL000 parse finding rather than an
+    exception: the linter must be able to report on a broken tree-in-progress
+    without taking CI down with a traceback.
+    """
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as exc:
+        return (
+            [
+                Finding(
+                    rule="GL000",
+                    path=path,
+                    line=exc.lineno or 1,
+                    col=(exc.offset or 0) + 1,
+                    message=f"syntax error: {exc.msg}",
+                    snippet=(exc.text or "").strip(),
+                )
+            ],
+            0,
+        )
+    ctx = LintContext(path=path, source=source, tree=tree)
+    selected = set(rules) if rules is not None else None
+    for rule in all_rules():
+        if selected is not None and rule.id not in selected:
+            continue
+        rule.check(ctx)
+    ctx.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return ctx.findings, ctx.suppressed_count
+
+
+def lint_file(
+    path: str, display_path: Optional[str] = None, rules: Optional[Iterable[str]] = None
+) -> Tuple[List[Finding], int]:
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    return lint_source(source, path=display_path or path, rules=rules)
+
+
+def iter_python_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for path in paths:
+        if os.path.isfile(path):
+            if path.endswith(".py"):
+                out.append(path)
+            continue
+        for root, dirnames, filenames in os.walk(path):
+            dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    out.append(os.path.join(root, name))
+    return out
+
+
+def lint_paths(
+    paths: Iterable[str],
+    root: Optional[str] = None,
+    rules: Optional[Iterable[str]] = None,
+) -> Tuple[List[Finding], int, int]:
+    """Lint every .py under `paths`. Returns (findings, files, suppressed).
+
+    Finding paths are made relative to `root` (default: cwd) so they are
+    stable across machines and match the checked-in baseline.
+    """
+    root = os.path.abspath(root or os.getcwd())
+    files = iter_python_files(paths)
+    findings: List[Finding] = []
+    suppressed = 0
+    for file_path in files:
+        abs_path = os.path.abspath(file_path)
+        try:
+            display = os.path.relpath(abs_path, root)
+        except ValueError:  # different drive (windows)
+            display = abs_path
+        if display.startswith(".."):
+            display = abs_path
+        file_findings, file_suppressed = lint_file(
+            abs_path, display_path=display.replace(os.sep, "/"), rules=rules
+        )
+        findings.extend(file_findings)
+        suppressed += file_suppressed
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings, len(files), suppressed

@@ -22,7 +22,12 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+
+try:
+    # Version-stable home on the pinned minimum jax (0.4.37).
+    from jax.experimental.shard_map import shard_map
+except ImportError:  # newer jax graduated it to the top level
+    from jax import shard_map  # graftlint: disable=GL003
 from jax.sharding import Mesh, PartitionSpec as P
 
 
@@ -49,10 +54,11 @@ def _block_attention(q, k, v, q_pos, k_pos, scale, causal):
     return out, m_safe, l
 
 
-def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
+def _ring_attention_local(q, k, v, *, axis_name: str, n: int, causal: bool, scale: float):
     """Per-device body under shard_map: q/k/v are the LOCAL sequence shards
-    [B, Tl, H, D]."""
-    n = jax.lax.axis_size(axis_name)
+    [B, Tl, H, D]. ``n`` is the static mesh axis size, passed from the
+    wrapper: `lax.axis_size` only exists in newer jax, and the ring loop
+    needs a Python int to unroll at trace time anyway."""
     my = jax.lax.axis_index(axis_name)
     t_local = q.shape[1]
     q_pos = my * t_local + jnp.arange(t_local)
@@ -110,7 +116,13 @@ def ring_attention(
         scale = q.shape[-1] ** -0.5
     spec = P(None, axis_name, None, None)
     fn = shard_map(
-        functools.partial(_ring_attention_local, axis_name=axis_name, causal=causal, scale=scale),
+        functools.partial(
+            _ring_attention_local,
+            axis_name=axis_name,
+            n=mesh.shape[axis_name],
+            causal=causal,
+            scale=scale,
+        ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

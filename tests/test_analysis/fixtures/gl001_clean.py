@@ -1,0 +1,27 @@
+"""GL001 clean: split/fold_in between consumers, plus one suppressed site."""
+
+import jax
+
+
+def split_between(key):
+    k1, k2 = jax.random.split(key)
+    return jax.random.normal(k1, (4,)) + jax.random.uniform(k2, (4,))
+
+
+def fold_in_loop(key):
+    total = 0.0
+    for i in range(8):
+        total += jax.random.normal(jax.random.fold_in(key, i), ())
+    return total
+
+
+def branches_are_exclusive(key, flag):
+    if flag:
+        return jax.random.normal(key, (4,))
+    return jax.random.uniform(key, (4,))
+
+
+def deliberate_common_noise(key):
+    a = jax.random.normal(key, (4,))
+    b = jax.random.normal(key, (4,))  # graftlint: disable=GL001
+    return a, b

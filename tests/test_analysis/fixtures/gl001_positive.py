@@ -1,0 +1,23 @@
+"""GL001 true positives: the same key feeds two consumers."""
+
+import jax
+
+
+def double_consume(key):
+    a = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,))  # <- GL001: key already consumed
+    return a + b
+
+
+def parent_after_split(key):
+    k1, k2 = jax.random.split(key)
+    x = jax.random.normal(k1, (4,))
+    y = jax.random.normal(key, (4,))  # <- GL001: parent consumed by split
+    return x + y + jax.random.normal(k2, (4,))
+
+
+def reuse_in_loop(key):
+    total = 0.0
+    for _ in range(8):
+        total += jax.random.normal(key, ())  # <- GL001 across iterations
+    return total

@@ -1,0 +1,27 @@
+"""GL002 clean: device-resident metrics, one coalesced fetch per interval."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def loss_stays_on_device(x):
+    return jnp.mean(x**2)
+
+
+def train_loop(step_fn, state, batches, log_every=100):
+    pending = []
+    for i, batch in enumerate(batches):
+        state, loss = step_fn(state, batch)
+        pending.append(loss)
+        if (i + 1) % log_every == 0:
+            # One coalesced transfer for the whole interval: the sanctioned
+            # pattern, opted out explicitly.
+            fetched = jax.device_get(pending)  # graftlint: disable=GL002
+            pending.clear()
+            yield fetched
+    return state
+
+
+def fetch_after_loop(outputs):
+    return jax.device_get(outputs)

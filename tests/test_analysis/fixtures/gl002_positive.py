@@ -1,0 +1,41 @@
+"""GL002 true positives: syncs inside jit and per-step syncs in host loops."""
+
+from functools import partial
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def item_in_jit(x):
+    return x.sum().item()  # <- GL002: sync inside jit
+
+
+@partial(jax.jit, static_argnames=("n",))
+def float_on_traced(x, n):
+    return float(x) * n  # <- GL002: float() concretizes traced x
+
+
+@jax.jit
+def asarray_in_jit(x):
+    return np.asarray(x)  # <- GL002: host materialization inside jit
+
+
+def scan_body(carry, x):
+    return carry + x.item(), x  # <- GL002: sync inside lax.scan body
+
+
+def run(xs):
+    return jax.lax.scan(scan_body, 0.0, xs)
+
+
+def train_loop(step_fn, state, batches):
+    losses = []
+    for batch in batches:
+        state, loss = step_fn(state, batch)
+        losses.append(jax.device_get(loss))  # <- GL002: per-iteration fetch
+    return state, losses
+
+
+def env_boundary(action):
+    return action.squeeze().item()  # <- GL002: host-side scalar fetch

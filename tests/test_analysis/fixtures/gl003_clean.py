@@ -1,0 +1,10 @@
+"""GL003 clean: version-stable imports, plus one suppressed forward-compat."""
+
+from jax import jit, vmap  # allowlisted on the pinned minimum jax
+from jax.experimental.shard_map import shard_map  # stable home
+from jax.sharding import Mesh, PartitionSpec
+
+try:
+    from jax.experimental.shard_map import shard_map as _sm
+except ImportError:
+    from jax import shard_map as _sm  # graftlint: disable=GL003

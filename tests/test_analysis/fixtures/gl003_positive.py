@@ -1,0 +1,5 @@
+"""GL003 true positives, including the exact seed bug from
+sheeprl_tpu/parallel/ring_attention.py:25 (pre-fix)."""
+
+from jax import shard_map  # <- GL003: not in pinned jax 0.4.37
+from jax import tree_map  # <- GL003: removed from jax top level

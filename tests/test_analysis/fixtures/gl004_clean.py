@@ -1,0 +1,38 @@
+"""GL004 clean: in-graph control flow, hashable statics, one suppressed."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def branch_in_graph(x, threshold):
+    return jnp.where(threshold > 0, x + 1, x - 1)
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def static_branch(x, mode):
+    if mode == "double":  # static arg: branch resolves at trace time
+        return x * 2
+    return x
+
+
+@jax.jit
+def none_check_is_static(x, mask):
+    if mask is None:  # `is None` resolves without concretizing
+        return x
+    return x * mask
+
+
+@partial(jax.jit, static_argnames=("sizes",))
+def reshape_to(x, sizes):
+    return x.reshape(sizes)
+
+
+def caller(x):
+    return reshape_to(x, sizes=(2, 2))
+
+
+def caller_suppressed(x):
+    return reshape_to(x, sizes=[2, 2])  # graftlint: disable=GL004

@@ -1,0 +1,36 @@
+"""GL004 true positives: traced branching and unhashable static args."""
+
+from functools import partial
+
+import jax
+
+
+@jax.jit
+def branch_on_traced(x, threshold):
+    if threshold > 0:  # <- GL004: python branch on traced value
+        return x + 1
+    return x - 1
+
+
+@jax.jit
+def loop_on_traced(x, steps):
+    while steps > 0:  # <- GL004: python while on traced value
+        x = x * 2
+        steps = steps - 1
+    return x
+
+
+@partial(jax.jit, static_argnames=("sizes",))
+def reshape_to(x, sizes):
+    return x.reshape(sizes)
+
+
+def caller(x):
+    return reshape_to(x, sizes=[2, 2])  # <- GL004: unhashable static literal
+
+
+resize = jax.jit(lambda x, shape: x.reshape(shape), static_argnums=(1,))
+
+
+def caller_positional(x):
+    return resize(x, [4, 1])  # <- GL004: unhashable at static position

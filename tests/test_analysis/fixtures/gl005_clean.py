@@ -1,0 +1,27 @@
+"""GL005 clean: rebind the result over the donated name, one suppressed."""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def step(state, batch):
+    return state + batch
+
+
+def rebind(state, batches):
+    for batch in batches:
+        state = step(state, batch)
+    return state
+
+
+def copy_kept_alive(state, batch):
+    snapshot = state + 0  # materialize a copy before donating
+    state = step(state, batch)
+    return state, snapshot
+
+
+def checked_on_cpu_only(state, batch):
+    out = step(state, batch)
+    return out, state.shape  # graftlint: disable=GL005

@@ -1,0 +1,16 @@
+"""GL005 true positive: donated buffer read after the jitted call."""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def step(state, batch):
+    return state + batch
+
+
+def use_after_donate(state, batch):
+    new_state = step(state, batch)
+    drift = new_state - state  # <- GL005: `state` was donated to step()
+    return new_state, drift

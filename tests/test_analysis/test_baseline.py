@@ -1,0 +1,72 @@
+"""Baseline semantics: entries match on content, consume one-for-one, and the
+checked-in file only ever shrinks."""
+
+import json
+import os
+
+from sheeprl_tpu.analysis.baseline import (
+    BASELINE_FILENAME,
+    apply_baseline,
+    discover_baseline,
+    load_baseline,
+    save_baseline,
+)
+from sheeprl_tpu.analysis.finding import Finding
+
+
+def _finding(line=1, snippet="x.item()", rule="GL002", path="a.py"):
+    return Finding(rule=rule, path=path, line=line, col=1, message="m", snippet=snippet)
+
+
+def test_roundtrip_and_line_number_independence(tmp_path):
+    baseline_file = str(tmp_path / BASELINE_FILENAME)
+    save_baseline(baseline_file, [_finding(line=10)])
+    # Same content at a different line still matches: edits above a
+    # grandfathered finding must not invalidate the baseline.
+    new, matched = apply_baseline([_finding(line=99)], load_baseline(baseline_file))
+    assert new == [] and matched == 1
+
+
+def test_entries_consume_one_for_one(tmp_path):
+    baseline_file = str(tmp_path / BASELINE_FILENAME)
+    save_baseline(baseline_file, [_finding()])
+    # A second identical violation is NEW even though one is baselined.
+    new, matched = apply_baseline(
+        [_finding(line=5), _finding(line=50)], load_baseline(baseline_file)
+    )
+    assert matched == 1
+    assert len(new) == 1
+
+
+def test_different_rule_or_path_does_not_match(tmp_path):
+    baseline_file = str(tmp_path / BASELINE_FILENAME)
+    save_baseline(baseline_file, [_finding()])
+    baseline = load_baseline(baseline_file)
+    assert apply_baseline([_finding(rule="GL001")], baseline)[0] != []
+    assert apply_baseline([_finding(path="b.py")], baseline)[0] != []
+
+
+def test_discover_walks_up(tmp_path):
+    root = tmp_path / "repo"
+    nested = root / "pkg" / "sub"
+    nested.mkdir(parents=True)
+    save_baseline(str(root / BASELINE_FILENAME), [])
+    assert discover_baseline(str(nested)) == str(root / BASELINE_FILENAME)
+
+
+def test_baseline_file_schema(tmp_path):
+    baseline_file = str(tmp_path / BASELINE_FILENAME)
+    save_baseline(baseline_file, [_finding()])
+    with open(baseline_file, "r", encoding="utf-8") as fh:
+        payload = json.load(fh)
+    assert payload["schema_version"] == 1
+    assert payload["tool"] == "graftlint"
+    assert payload["entries"] == [{"rule": "GL002", "path": "a.py", "snippet": "x.item()"}]
+
+
+def test_repo_baseline_exists_and_is_wellformed():
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    baseline_path = os.path.join(repo_root, BASELINE_FILENAME)
+    assert os.path.isfile(baseline_path), "checked-in graftlint baseline is missing"
+    baseline = load_baseline(baseline_path)
+    assert all(rule.startswith("GL") for rule, _, _ in baseline)

@@ -1,0 +1,73 @@
+"""CLI contract: exit codes, JSON mode, rule selection."""
+
+import json
+import os
+
+import pytest
+
+from sheeprl_tpu.analysis.__main__ import main
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_fixture_corpus_exits_nonzero(capsys):
+    assert main([FIXTURES, "--no-baseline"]) == 1
+    out = capsys.readouterr().out
+    assert "GL003" in out and "finding(s)" in out
+
+
+def test_clean_file_exits_zero(tmp_path, capsys):
+    clean = tmp_path / "clean.py"
+    clean.write_text("import jax\n\n\ndef f(x):\n    return x\n")
+    assert main([str(clean), "--no-baseline"]) == 0
+
+
+def test_repo_scan_with_baseline_exits_zero(capsys):
+    """Acceptance: `python -m sheeprl_tpu.analysis sheeprl_tpu/` is clean."""
+    package_dir = os.path.join(REPO_ROOT, "sheeprl_tpu")
+    assert main([package_dir]) == 0
+    assert "0 finding(s)" in capsys.readouterr().out
+
+
+def test_json_output_parses(capsys):
+    positive = os.path.join(FIXTURES, "gl003_positive.py")
+    assert main([positive, "--json", "--no-baseline"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["tool"] == "graftlint"
+    assert payload["counts"].get("GL003", 0) >= 1
+
+
+def test_select_restricts_rules(capsys):
+    assert main([FIXTURES, "--no-baseline", "--select", "GL005"]) == 1
+    payload_rules = {
+        line.split()[1]
+        for line in capsys.readouterr().out.splitlines()
+        if ": GL" in line
+    }
+    assert payload_rules == {"GL005"}
+
+
+def test_unknown_rule_and_missing_path_are_usage_errors():
+    assert main([FIXTURES, "--select", "GL999"]) == 2
+    assert main([os.path.join(FIXTURES, "no_such_file.py")]) == 2
+
+
+def test_list_rules_names_all_five(capsys):
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in ("GL001", "GL002", "GL003", "GL004", "GL005"):
+        assert rule_id in out
+
+
+def test_write_baseline_then_clean(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("from jax import shard_map\n")
+    baseline = tmp_path / "baseline.json"
+    assert main([str(bad), "--baseline", str(baseline), "--write-baseline"]) == 0
+    assert baseline.is_file()
+    # Grandfathered: same scan is now clean...
+    assert main([str(bad), "--baseline", str(baseline)]) == 0
+    # ...but a NEW violation still fails.
+    bad.write_text("from jax import shard_map\nfrom jax import pjit\n")
+    assert main([str(bad), "--baseline", str(baseline)]) == 1

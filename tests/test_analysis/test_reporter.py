@@ -1,0 +1,67 @@
+"""The JSON report is a stable interface: CI and tooling parse it."""
+
+import json
+
+from sheeprl_tpu.analysis import lint_source
+from sheeprl_tpu.analysis.reporter import JSON_SCHEMA_VERSION, render_json, render_text
+
+_BAD = "from jax import shard_map\n"
+
+
+def _report(source=_BAD):
+    findings, suppressed = lint_source(source, path="sample.py")
+    return json.loads(render_json(findings, files_scanned=1, suppressed=suppressed))
+
+
+def test_json_schema_top_level_keys_and_types():
+    payload = _report()
+    assert payload["schema_version"] == JSON_SCHEMA_VERSION == 1
+    assert payload["tool"] == "graftlint"
+    assert isinstance(payload["files_scanned"], int)
+    assert isinstance(payload["baselined"], int)
+    assert isinstance(payload["suppressed"], int)
+    assert isinstance(payload["findings"], list)
+    assert isinstance(payload["counts"], dict)
+    # The key set itself is part of the contract.
+    assert set(payload) == {
+        "schema_version",
+        "tool",
+        "files_scanned",
+        "baselined",
+        "suppressed",
+        "findings",
+        "counts",
+    }
+
+
+def test_json_finding_shape():
+    finding = _report()["findings"][0]
+    assert set(finding) == {"rule", "path", "line", "col", "message", "snippet"}
+    assert finding["rule"] == "GL003"
+    assert finding["path"] == "sample.py"
+    assert finding["line"] == 1
+    assert isinstance(finding["col"], int) and finding["col"] >= 1
+    assert finding["snippet"] == "from jax import shard_map"
+
+
+def test_json_counts_aggregate_by_rule():
+    payload = _report(_BAD + "from jax import pjit\n")
+    assert payload["counts"] == {"GL003": 2}
+
+
+def test_empty_report_is_clean():
+    payload = _report("x = 1\n")
+    assert payload["findings"] == []
+    assert payload["counts"] == {}
+
+
+def test_text_report_has_clickable_locations_and_summary():
+    findings, _ = lint_source(_BAD, path="sample.py")
+    text = render_text(findings, files_scanned=1)
+    assert text.splitlines()[0].startswith("sample.py:1:1: GL003 ")
+    assert "1 finding(s) in 1 file(s)" in text
+
+
+def test_syntax_error_becomes_gl000_not_a_crash():
+    findings, _ = lint_source("def broken(:\n", path="broken.py")
+    assert [f.rule for f in findings] == ["GL000"]

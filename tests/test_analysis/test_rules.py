@@ -1,0 +1,80 @@
+"""Per-rule fixture corpus: every rule has a true-positive file that must
+fire and a clean/suppressed file that must stay silent."""
+
+import os
+
+import pytest
+
+from sheeprl_tpu.analysis import lint_file
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+ALL_RULE_IDS = ("GL001", "GL002", "GL003", "GL004", "GL005")
+
+
+def _lint_fixture(name):
+    return lint_file(os.path.join(FIXTURES, name))
+
+
+@pytest.mark.parametrize("rule_id", ALL_RULE_IDS)
+def test_positive_fixture_fires(rule_id):
+    findings, _ = _lint_fixture(f"{rule_id.lower()}_positive.py")
+    fired = {f.rule for f in findings}
+    assert rule_id in fired, f"{rule_id} did not fire on its positive fixture"
+
+
+@pytest.mark.parametrize("rule_id", ALL_RULE_IDS)
+def test_clean_fixture_is_silent(rule_id):
+    findings, suppressed = _lint_fixture(f"{rule_id.lower()}_clean.py")
+    assert findings == [], [f.format_text() for f in findings]
+    # Every clean fixture demonstrates the suppression syntax at least once.
+    assert suppressed >= 1
+
+
+def test_every_positive_line_is_annotated():
+    """Positive fixtures mark expected violations with `<- GLnnn`; the rule
+    must flag each annotated line (keeps fixtures and rules honest)."""
+    for rule_id in ALL_RULE_IDS:
+        name = f"{rule_id.lower()}_positive.py"
+        path = os.path.join(FIXTURES, name)
+        with open(path, "r", encoding="utf-8") as fh:
+            expected = {
+                lineno
+                for lineno, line in enumerate(fh, start=1)
+                if f"<- {rule_id}" in line
+            }
+        findings, _ = _lint_fixture(name)
+        flagged = {f.line for f in findings if f.rule == rule_id}
+        missing = expected - flagged
+        assert not missing, f"{name}: annotated lines not flagged: {sorted(missing)}"
+
+
+def test_gl001_split_consumes_parent():
+    findings, _ = _lint_fixture("gl001_positive.py")
+    assert any("split" in f.message for f in findings if f.rule == "GL001")
+
+
+def test_gl002_distinguishes_jit_and_host_tiers():
+    findings, _ = _lint_fixture("gl002_positive.py")
+    messages = [f.message for f in findings if f.rule == "GL002"]
+    assert any("jit-traced" in m for m in messages)
+    assert any("host loop" in m or "host-side" in m for m in messages)
+
+
+def test_gl003_flags_the_seed_bug_line():
+    """The exact pre-fix line from sheeprl_tpu/parallel/ring_attention.py:25."""
+    from sheeprl_tpu.analysis import lint_source
+
+    findings, _ = lint_source("from jax import shard_map\n", path="ring_attention.py")
+    assert [f.rule for f in findings] == ["GL003"]
+    assert "jax.experimental.shard_map" in findings[0].message
+
+
+def test_gl004_static_argnames_branching_is_allowed():
+    findings, _ = _lint_fixture("gl004_clean.py")
+    assert findings == []
+
+
+def test_gl005_rebinding_result_is_allowed():
+    findings, _ = _lint_fixture("gl005_clean.py")
+    assert findings == []

@@ -1,0 +1,56 @@
+"""Tier-1 self-check: graftlint over the whole package.
+
+Fails on any new, unsuppressed, non-baselined violation — this is the
+machine-checked floor under every later perf/sharding PR. The second test is
+the ratchet: the baseline may only shrink, so fixing a grandfathered finding
+requires regenerating the file (and a PR that *adds* a finding cannot hide it
+by regenerating, because this first test would still fail on its machine).
+"""
+
+import os
+
+import pytest
+
+from sheeprl_tpu.analysis import lint_paths
+from sheeprl_tpu.analysis.baseline import (
+    BASELINE_FILENAME,
+    apply_baseline,
+    load_baseline,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+PACKAGE_DIR = os.path.join(REPO_ROOT, "sheeprl_tpu")
+BASELINE_PATH = os.path.join(REPO_ROOT, BASELINE_FILENAME)
+
+
+@pytest.fixture(scope="module")
+def scan():
+    findings, files_scanned, suppressed = lint_paths([PACKAGE_DIR], root=REPO_ROOT)
+    assert files_scanned > 100, "scan did not cover the package"
+    return findings
+
+
+@pytest.mark.graftlint
+def test_no_new_violations(scan):
+    baseline = load_baseline(BASELINE_PATH)
+    new, _ = apply_baseline(scan, baseline)
+    assert new == [], (
+        "graftlint found new violation(s):\n"
+        + "\n".join(f.format_text() for f in new)
+        + "\nFix them, add a justified `# graftlint: disable=<ID>`, or (for "
+        "pre-existing debt only) regenerate the baseline with "
+        "`python -m sheeprl_tpu.analysis sheeprl_tpu/ --write-baseline`."
+    )
+
+
+@pytest.mark.graftlint
+def test_baseline_only_shrinks(scan):
+    baseline = load_baseline(BASELINE_PATH)
+    _, matched = apply_baseline(scan, baseline)
+    total = sum(baseline.values())
+    stale = total - matched
+    assert stale == 0, (
+        f"{stale} baseline entr(ies) no longer match any finding — debt was "
+        "paid down. Shrink the file: "
+        "`python -m sheeprl_tpu.analysis sheeprl_tpu/ --write-baseline`."
+    )
