@@ -21,4 +21,10 @@ fi
 echo "== graftlint =="
 python -m sheeprl_tpu.analysis sheeprl_tpu/ || rc=1
 
+# The telemetry package is the audited home for host syncs, so it holds a
+# stricter bar: zero findings with NO baseline. A sync added there must be
+# restructured (coalesced, out-of-loop), never grandfathered.
+echo "== graftlint (telemetry, no baseline) =="
+python -m sheeprl_tpu.analysis --no-baseline sheeprl_tpu/telemetry/ || rc=1
+
 exit "$rc"

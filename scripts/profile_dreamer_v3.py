@@ -101,27 +101,38 @@ def build(cfg_overrides):
 
 
 def time_step(train_fn, agent_state, opt_states, moments, data, iters=100):
+    """Donated-chain step timing through the telemetry StepTimer.
+
+    The hand-rolled pattern this used to inline now lives in
+    sheeprl_tpu/telemetry/step_timer.py: per-step dispatch walls accumulate
+    async, and ONE flush bounds the chain — the flush's coalesced metric
+    fetch is a host fetch of every step's loss, which (unlike
+    block_until_ready on the tunneled backend) reliably drains the queue.
+    """
     import jax
     import jax.numpy as jnp
     import numpy as np
+
+    from sheeprl_tpu.telemetry import StepTimer
 
     key = jax.random.PRNGKey(1)
     tau = jnp.asarray(0.02, jnp.float32)
     # Warmup / compile. The step donates its inputs, so thread the state.
     # TWO warmup calls: the second call's inputs are donated outputs of the
     # first and can trigger one more compile (layout change) — keep it out
-    # of the timed loop. Each measurement fetches a scalar from the LAST step
-    # of the chain: on the tunneled TPU backend block_until_ready does not
-    # reliably flush the execution queue, a host fetch does.
+    # of the timed loop (the trap telemetry's recompile-after-warmup counter
+    # now watches for in real runs).
     s, o, m, mt, key = train_fn(agent_state, opt_states, moments, data, key, tau)
     float(np.asarray(mt["Loss/world_model_loss"]))
     s, o, m, mt, key = train_fn(s, o, m, data, key, tau)
     float(np.asarray(mt["Loss/world_model_loss"]))
-    t0 = time.perf_counter()
+    st = StepTimer(name="profile")
     for _ in range(iters):
-        s, o, m, mt, key = train_fn(s, o, m, data, key, tau)
-    float(np.asarray(mt["Loss/world_model_loss"]))  # force the whole chain
-    return (time.perf_counter() - t0) / iters, (s, o, m)
+        with st.step():
+            s, o, m, mt, key = train_fn(s, o, m, data, key, tau)
+        st.pend(s["world_model"], mt["Loss/world_model_loss"])
+    st.flush()  # ONE bound + ONE coalesced fetch ends the donated chain
+    return st.seconds_per_step, (s, o, m)
 
 
 # ---------------------------------------------------------------- phases
@@ -319,9 +330,15 @@ def main():
             summary["mfu_f32_peak"] = round(flops / dt / PEAK_FLOPS["f32"], 4) if flops else None
             summary["mfu_bf16_peak"] = round(flops / dt / PEAK_FLOPS["bf16"], 4) if flops else None
             if args.trace_dir:
-                with jax.profiler.trace(args.trace_dir):
-                    s, o, m, _, _ = train_fn(*carry, data, key, tau)
-                    jax.block_until_ready(s["world_model"])
+                # One-step XLA trace window through the telemetry profiler
+                # (the same machinery `telemetry.profiler.*` drives in runs).
+                from sheeprl_tpu.telemetry import ProfilerWindow
+
+                window = ProfilerWindow(trace_dir=args.trace_dir, start_step=0, stop_step=1)
+                window.advance(0)
+                s, o, m, _, _ = train_fn(*carry, data, key, tau)
+                jax.block_until_ready(s["world_model"])
+                window.close()
                 summary["trace_dir"] = args.trace_dir
 
             if args.phases:

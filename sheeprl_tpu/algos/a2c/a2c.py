@@ -119,6 +119,7 @@ def main(runtime, cfg: Dict[str, Any]):
     if logger is not None:
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
+    telemetry = runtime.telemetry.open(log_dir, rank_zero=runtime.is_global_zero, device=runtime.device)
     runtime.print(f"Log dir: {log_dir}")
 
     envs = make_vector_env(cfg, rank, log_dir)
@@ -218,12 +219,17 @@ def main(runtime, cfg: Dict[str, Any]):
     rollout_key, train_key = jax.random.split(jax.random.fold_in(runtime.root_key, rank))
     rollout_key = placement.put(rollout_key)
 
+    # Coalesced loss fetch + interval bounding (telemetry/step_timer.py):
+    # ONE block_until_ready + ONE device_get per log interval.
+    train_timer = telemetry.step_timer("train", timer_key="Time/train_time")
+    keep_train_metrics = aggregator is not None and not aggregator.disabled
     step_data = {}
     next_obs = envs.reset(seed=cfg.seed)[0]
     for k in obs_keys:
         step_data[k] = next_obs[k][np.newaxis]
 
     for iter_num in range(start_iter, total_iters + 1):
+        telemetry.advance(policy_step)
         for _ in range(0, cfg.algo.rollout_steps):
             policy_step += cfg.env.num_envs * world_size
 
@@ -235,7 +241,11 @@ def main(runtime, cfg: Dict[str, Any]):
                     *step_out, rollout_key = player_step_fn(
                         placement.params(), np_obs, rollout_key
                     )
-                    actions, real_actions_np, logprobs, values = jax.device_get(step_out)
+                    # Structural per-step sync (actions feed env.step):
+                    # accounted through the telemetry fetch.
+                    actions, real_actions_np, logprobs, values = telemetry.fetch(
+                        step_out, label="player_actions"
+                    )
 
                 obs, rewards, terminated, truncated, info = envs.step(
                     real_actions_np.reshape(envs.action_space.shape)
@@ -293,30 +303,31 @@ def main(runtime, cfg: Dict[str, Any]):
         )
 
         with timer("Time/train_time"):
-            params, opt_state, train_metrics, train_key = train_fn(
-                params, opt_state, data, jnp_next, train_key
-            )
-            # Block only when the train timer needs an accurate stop;
-            # with metrics off the dispatch stays fully async, so the
-            # H2D infeed + train overlap the next env steps.
-            if not timer.disabled:
-                jax.block_until_ready(params)
+            with train_timer.step():
+                params, opt_state, train_metrics, train_key = train_fn(
+                    params, opt_state, data, jnp_next, train_key
+                )
+            # No sync here: the StepTimer queues the loss scalars device-side
+            # and bounds the interval with ONE block at the flush below.
+            train_timer.pend(params, train_metrics if keep_train_metrics else None)
         placement.push(params)
         train_step_count += world_size
-
-        if aggregator and not aggregator.disabled:
-            # One host fetch for the whole metrics dict (single roundtrip).
-            tm = jax.device_get(train_metrics)
-            aggregator.update("Loss/policy_loss", tm["policy_loss"])
-            aggregator.update("Loss/value_loss", tm["value_loss"])
 
         should_log = cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
         )
-        if should_log and aggregator and not aggregator.disabled:
-            # Collective when sync_on_compute is on: every rank joins;
-            # only rank 0 (the only rank with a logger) writes.
-            aggregator.log_and_reset(logger, policy_step)
+        if should_log:
+            # ONE bounding block + ONE device->host transfer for the whole
+            # interval (StepTimer.flush) — the coalesced GL002 pattern.
+            fetched_train_metrics = train_timer.flush()
+            if aggregator and not aggregator.disabled:
+                for tm in fetched_train_metrics:
+                    aggregator.update("Loss/policy_loss", tm["policy_loss"])
+                    aggregator.update("Loss/value_loss", tm["value_loss"])
+                # Collective when sync_on_compute is on: every rank joins;
+                # only rank 0 (the only rank with a logger) writes.
+                aggregator.log_and_reset(logger, policy_step)
+            telemetry.log_counters(logger, policy_step)
         if cfg.metric.log_level > 0 and logger is not None:
             if should_log:
                 if not timer.disabled:
@@ -363,5 +374,6 @@ def main(runtime, cfg: Dict[str, Any]):
     if runtime.is_global_zero and cfg.algo.run_test:
         test(agent, params, runtime, cfg, log_dir, logger)
 
+    telemetry.close()
     if logger is not None:
         logger.close()

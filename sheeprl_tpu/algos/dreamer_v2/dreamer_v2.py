@@ -318,6 +318,7 @@ def main(runtime, cfg: Dict[str, Any]):
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
     runtime.print(f"Log dir: {log_dir}")
+    telemetry = runtime.telemetry.open(log_dir, rank_zero=runtime.is_global_zero, device=runtime.device)
 
     envs = make_vector_env(cfg, rank, log_dir)
     action_space = envs.single_action_space
@@ -492,8 +493,13 @@ def main(runtime, cfg: Dict[str, Any]):
     # Bound async in-flight train dispatches (core/runtime.py: an
     # unbounded queue pins every pending call's sampled batch on host).
     dispatch_throttle = DispatchThrottle()
+    # Coalesced loss fetch + interval bounding (telemetry/step_timer.py):
+    # ONE block_until_ready + ONE device_get per log interval.
+    train_timer = telemetry.step_timer("train", timer_key="Time/train_time")
+    keep_train_metrics = aggregator is not None and not aggregator.disabled and cfg.metric.log_level > 0
     for iter_num in range(start_iter, total_iters + 1):
         policy_step += policy_steps_per_iter
+        telemetry.advance(policy_step)
 
         with timer("Time/env_interaction_time"):
             if iter_num <= learning_starts and cfg.checkpoint.resume_from is None:
@@ -515,8 +521,11 @@ def main(runtime, cfg: Dict[str, Any]):
                     )
                 # One host fetch for both arrays: each separate np.asarray
                 # is a full device->host roundtrip (painful over a tunneled
-                # chip); jax.device_get of the tuple costs one.
-                actions, real_actions = jax.device_get((actions_cat, real_actions_j))
+                # chip). Structural per-step sync: accounted through the
+                # telemetry fetch (one device_get, span + byte count).
+                actions, real_actions = telemetry.fetch(
+                    (actions_cat, real_actions_j), label="player_actions"
+                )
 
             step_data["is_first"] = copy.deepcopy(
                 np.logical_or(step_data["terminated"], step_data["truncated"]).astype(np.float32)
@@ -587,7 +596,6 @@ def main(runtime, cfg: Dict[str, Any]):
             per_rank_gradient_steps = ratio(ratio_steps / world_size)
             if per_rank_gradient_steps > 0:
                 batches = infeed.take_or_sample(per_rank_gradient_steps)
-                per_step_metrics = []
                 with timer("Time/train_time"):
                     for i in range(per_rank_gradient_steps):
                         if (
@@ -601,17 +609,19 @@ def main(runtime, cfg: Dict[str, Any]):
                                 jnp.copy, agent_state["critic"]
                             )
                         batch = batches[i]
-                        agent_state, opt_states, train_metrics, train_key = train_fn(
-                            agent_state, opt_states, batch, train_key
+                        with train_timer.step():
+                            agent_state, opt_states, train_metrics, train_key = train_fn(
+                                agent_state, opt_states, batch, train_key
+                            )
+                        # No sync here: the StepTimer queues the loss scalars
+                        # device-side and bounds the interval with ONE block
+                        # at the log-interval flush.
+                        train_timer.pend(
+                            agent_state["world_model"],
+                            train_metrics if keep_train_metrics else None,
                         )
-                        per_step_metrics.append(train_metrics)
                         dispatch_throttle.add(train_metrics)
                         cumulative_per_rank_gradient_steps += 1
-                    # Block only when the train timer needs an accurate stop;
-                    # with metrics off the dispatch stays fully async, so the
-                    # H2D infeed + train overlap the next env steps.
-                    if not timer.disabled:
-                        jax.block_until_ready(agent_state["world_model"])
                     placement.push(
                         {"world_model": agent_state["world_model"], "actor": agent_state["actor"]}
                     )
@@ -620,22 +630,24 @@ def main(runtime, cfg: Dict[str, Any]):
                 # copies to overlap the next env-step phase.
                 infeed.stage(per_rank_gradient_steps)
 
-                if aggregator and not aggregator.disabled:
-                    # One host fetch for every metric of every gradient step
-                    # (each np.asarray would be its own roundtrip).
-                    for m in jax.device_get(per_step_metrics):
-                        for k, v in m.items():
-                            if k in aggregator:
-                                aggregator.update(k, v)
-
         # -------------------------------------------------------- logging
         should_log = cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
         )
-        if should_log and aggregator and not aggregator.disabled:
-            # Collective when sync_on_compute is on: every rank joins;
-            # only rank 0 (the only rank with a logger) writes.
-            aggregator.log_and_reset(logger, policy_step)
+        if should_log:
+            # The interval's losses in ONE bounding block + ONE device->host
+            # transfer (StepTimer.flush) — the coalesced pattern GL002 asks
+            # for, now owned by telemetry.
+            fetched_train_metrics = train_timer.flush()
+            if aggregator and not aggregator.disabled:
+                for m in fetched_train_metrics:
+                    for k, v in m.items():
+                        if k in aggregator:
+                            aggregator.update(k, v)
+                # Collective when sync_on_compute is on: every rank joins;
+                # only rank 0 (the only rank with a logger) writes.
+                aggregator.log_and_reset(logger, policy_step)
+            telemetry.log_counters(logger, policy_step)
         if should_log and logger is not None:
             if policy_step > 0:
                 logger.log(
@@ -693,5 +705,6 @@ def main(runtime, cfg: Dict[str, Any]):
     if runtime.is_global_zero and cfg.algo.run_test:
         test(agent, agent_state, runtime, cfg, log_dir, logger)
 
+    telemetry.close()
     if logger is not None:
         logger.close()

@@ -380,6 +380,7 @@ def main(runtime, cfg: Dict[str, Any]):
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
     runtime.print(f"Log dir: {log_dir}")
+    telemetry = runtime.telemetry.open(log_dir, rank_zero=runtime.is_global_zero, device=runtime.device)
 
     envs = make_vector_env(cfg, rank, log_dir, restart_on_exception=True)
     action_space = envs.single_action_space
@@ -557,13 +558,16 @@ def main(runtime, cfg: Dict[str, Any]):
     # Bound async in-flight train dispatches (core/runtime.py: an
     # unbounded queue pins every pending call's sampled batch on host).
     dispatch_throttle = DispatchThrottle()
-    # Train losses stay device-resident between log intervals; ONE coalesced
-    # jax.device_get per interval replaces the per-train-call fetch (each
-    # fetch is a full round trip over a tunneled chip). Scalars only, so the
-    # pinned device memory is negligible.
-    pending_train_metrics = []
+    # Train losses stay device-resident between log intervals; the StepTimer
+    # coalesces them into ONE jax.device_get per interval and bounds the
+    # interval's wall-clock with ONE block_until_ready (each sync is a full
+    # round trip over a tunneled chip). Scalars only, so the pinned device
+    # memory is negligible.
+    train_timer = telemetry.step_timer("train", timer_key="Time/train_time")
+    keep_train_metrics = aggregator is not None and not aggregator.disabled and cfg.metric.log_level > 0
     for iter_num in range(start_iter, total_iters + 1):
         policy_step += policy_steps_per_iter
+        telemetry.advance(policy_step)
 
         with timer("Time/env_interaction_time"):
             if iter_num <= learning_starts and cfg.checkpoint.resume_from is None:
@@ -585,10 +589,11 @@ def main(runtime, cfg: Dict[str, Any]):
                     )
                 # One host fetch for both arrays: each separate np.asarray
                 # is a full device->host roundtrip (painful over a tunneled
-                # chip); jax.device_get of the tuple costs one. Structural
-                # per-step sync: the actions must reach env.step on host.
-                actions, real_actions = jax.device_get(  # graftlint: disable=GL002
-                    (actions_cat, real_actions_j)
+                # chip). This per-step sync is structural (the actions must
+                # reach env.step on host), so it goes through the telemetry
+                # fetch — one device_get, accounted with a span + byte count.
+                actions, real_actions = telemetry.fetch(
+                    (actions_cat, real_actions_j), label="player_actions"
                 )
 
             step_data["actions"] = actions.reshape((1, cfg.env.num_envs, -1))
@@ -675,7 +680,6 @@ def main(runtime, cfg: Dict[str, Any]):
             per_rank_gradient_steps = ratio(ratio_steps / world_size)
             if per_rank_gradient_steps > 0:
                 batches = infeed.take_or_sample(per_rank_gradient_steps)
-                per_step_metrics = []
                 with timer("Time/train_time"):
                     for i in range(per_rank_gradient_steps):
                         if (
@@ -687,19 +691,23 @@ def main(runtime, cfg: Dict[str, Any]):
                         else:
                             tau = 0.0
                         batch = batches[i]
-                        agent_state, opt_states, moments_state, train_metrics, train_key = train_fn(
-                            agent_state, opt_states, moments_state, batch, train_key,
-                            np.asarray(tau, np.float32),
+                        with train_timer.step():
+                            agent_state, opt_states, moments_state, train_metrics, train_key = train_fn(
+                                agent_state, opt_states, moments_state, batch, train_key,
+                                np.asarray(tau, np.float32),
+                            )
+                        # Feed EVERY gradient step's losses toward the log
+                        # (only sampling the last one under-reports the
+                        # training signal). No sync here: the dispatch stays
+                        # fully async — the StepTimer queues the scalars
+                        # device-side and bounds the interval's wall-clock
+                        # with ONE block at the log-interval flush.
+                        train_timer.pend(
+                            agent_state["world_model"],
+                            train_metrics if keep_train_metrics else None,
                         )
-                        per_step_metrics.append(train_metrics)
                         dispatch_throttle.add(train_metrics)
                         cumulative_per_rank_gradient_steps += 1
-                    # Block only when the train timer needs an accurate stop;
-                    # with metrics off the dispatch stays fully async, so the
-                    # H2D infeed + train overlap the next env steps.
-                    if not timer.disabled:
-                        # Deliberate: the train timer needs an accurate stop.
-                        jax.block_until_ready(agent_state["world_model"])  # graftlint: disable=GL002
                     # One mirror refresh per train call (the player only acts
                     # again after the whole gradient-step loop, so this is
                     # exactly the reference's tied-weights freshness).
@@ -711,30 +719,24 @@ def main(runtime, cfg: Dict[str, Any]):
                 # copies to overlap the next env-step phase.
                 infeed.stage(per_rank_gradient_steps)
 
-                # Feed EVERY gradient step's losses to the aggregator (the
-                # reference updates per step; only sampling the last one
-                # under-reports the training signal). No fetch here: the
-                # scalars queue device-side until the log-interval flush.
-                if aggregator and not aggregator.disabled and cfg.metric.log_level > 0:
-                    pending_train_metrics.extend(per_step_metrics)
-
         # -------------------------------------------------------- logging
         should_log = cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
         )
-        if should_log and aggregator and not aggregator.disabled:
-            if pending_train_metrics:
-                # The whole interval's losses in ONE device->host transfer —
-                # the coalesced pattern GL002 asks for (hence the explicit
-                # opt-out on a deliberate inside-the-loop sync).
-                for m in jax.device_get(pending_train_metrics):  # graftlint: disable=GL002
+        if should_log:
+            # The interval's ONE bounding block + ONE coalesced device->host
+            # transfer of every queued loss tree (StepTimer.flush) — the
+            # pattern GL002 asks for, now owned by telemetry.
+            fetched_train_metrics = train_timer.flush()
+            if aggregator and not aggregator.disabled:
+                for m in fetched_train_metrics:
                     for k, v in m.items():
                         if k in aggregator:
                             aggregator.update(k, v)
-                pending_train_metrics.clear()
-            # Collective when sync_on_compute is on: every rank joins;
-            # only rank 0 (the only rank with a logger) writes.
-            aggregator.log_and_reset(logger, policy_step)
+                # Collective when sync_on_compute is on: every rank joins;
+                # only rank 0 (the only rank with a logger) writes.
+                aggregator.log_and_reset(logger, policy_step)
+            telemetry.log_counters(logger, policy_step)
         if should_log and logger is not None:
             if policy_step > 0:
                 logger.log(
@@ -793,5 +795,6 @@ def main(runtime, cfg: Dict[str, Any]):
     if runtime.is_global_zero and cfg.algo.run_test:
         test(agent, agent_state, runtime, cfg, log_dir, logger)
 
+    telemetry.close()
     if logger is not None:
         logger.close()

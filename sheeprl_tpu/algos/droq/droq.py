@@ -152,6 +152,7 @@ def main(runtime, cfg: Dict[str, Any]):
     if logger is not None:
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
+    telemetry = runtime.telemetry.open(log_dir, rank_zero=runtime.is_global_zero, device=runtime.device)
     runtime.print(f"Log dir: {log_dir}")
 
     envs = make_vector_env(cfg, rank, log_dir)
@@ -269,8 +270,13 @@ def main(runtime, cfg: Dict[str, Any]):
     # Bound async in-flight train dispatches (core/runtime.py: an
     # unbounded queue pins every pending call's sampled batch on host).
     dispatch_throttle = DispatchThrottle()
+    # Coalesced loss fetch + interval bounding (telemetry/step_timer.py):
+    # ONE block_until_ready + ONE device_get per log interval.
+    train_timer = telemetry.step_timer("train", timer_key="Time/train_time")
+    keep_train_metrics = aggregator is not None and not aggregator.disabled
     for iter_num in range(start_iter, total_iters + 1):
         policy_step += policy_steps_per_iter
+        telemetry.advance(policy_step)
 
         with timer("Time/env_interaction_time"):
             if iter_num <= learning_starts:
@@ -279,7 +285,9 @@ def main(runtime, cfg: Dict[str, Any]):
                 with placement.ctx():
                     np_obs = prepare_obs(obs, mlp_keys=mlp_keys, num_envs=cfg.env.num_envs)
                     actions_j, rollout_key = player_fn(placement.params(), np_obs, rollout_key)
-                    actions = np.asarray(actions_j)
+                    # Structural per-step sync (actions feed env.step):
+                    # accounted through the telemetry fetch.
+                    actions = telemetry.fetch(actions_j, label="player_actions")
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 actions.reshape(envs.action_space.shape)
             )
@@ -340,33 +348,37 @@ def main(runtime, cfg: Dict[str, Any]):
                     for k, v in actor_sample.items()
                 }
                 with timer("Time/train_time"):
-                    agent_state, opt_states, train_metrics, train_key = train_fn(
-                        agent_state, opt_states, critic_data, actor_data, train_key
+                    with train_timer.step():
+                        agent_state, opt_states, train_metrics, train_key = train_fn(
+                            agent_state, opt_states, critic_data, actor_data, train_key
+                        )
+                    # No sync here: the StepTimer queues the loss scalars
+                    # device-side and bounds the interval with ONE block at
+                    # the log-interval flush.
+                    train_timer.pend(
+                        agent_state["actor"], train_metrics if keep_train_metrics else None
                     )
                     dispatch_throttle.add(train_metrics)
-                    # Block only when the train timer needs an accurate stop;
-                    # with metrics off the dispatch stays fully async, so the
-                    # H2D infeed + train overlap the next env steps.
-                    if not timer.disabled:
-                        jax.block_until_ready(agent_state["actor"])
                     placement.push(agent_state["actor"])
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                 train_step_count += world_size
 
-                if aggregator and not aggregator.disabled:
-                    # One host fetch for the whole metrics dict (single roundtrip).
-                    tm = jax.device_get(train_metrics)
-                    aggregator.update("Loss/value_loss", tm["value_loss"])
-                    aggregator.update("Loss/policy_loss", tm["policy_loss"])
-                    aggregator.update("Loss/alpha_loss", tm["alpha_loss"])
-
         should_log = cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
         )
-        if should_log and aggregator and not aggregator.disabled:
-            # Collective when sync_on_compute is on: every rank joins;
-            # only rank 0 (the only rank with a logger) writes.
-            aggregator.log_and_reset(logger, policy_step)
+        if should_log:
+            # ONE bounding block + ONE device->host transfer for the whole
+            # interval (StepTimer.flush) — the coalesced GL002 pattern.
+            fetched_train_metrics = train_timer.flush()
+            if aggregator and not aggregator.disabled:
+                for tm in fetched_train_metrics:
+                    aggregator.update("Loss/value_loss", tm["value_loss"])
+                    aggregator.update("Loss/policy_loss", tm["policy_loss"])
+                    aggregator.update("Loss/alpha_loss", tm["alpha_loss"])
+                # Collective when sync_on_compute is on: every rank joins;
+                # only rank 0 (the only rank with a logger) writes.
+                aggregator.log_and_reset(logger, policy_step)
+            telemetry.log_counters(logger, policy_step)
         if should_log and logger is not None:
             logger.log(
                 "Params/replay_ratio", cumulative_per_rank_gradient_steps * world_size / policy_step, policy_step
@@ -423,5 +435,6 @@ def main(runtime, cfg: Dict[str, Any]):
     if runtime.is_global_zero and cfg.algo.run_test:
         test(agent, agent_state, runtime, cfg, log_dir, logger)
 
+    telemetry.close()
     if logger is not None:
         logger.close()

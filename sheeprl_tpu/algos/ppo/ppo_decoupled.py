@@ -62,6 +62,7 @@ def main(runtime, cfg: Dict[str, Any]):
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
     runtime.print(f"Log dir: {log_dir}")
+    telemetry = runtime.telemetry.open(log_dir, rank_zero=runtime.is_global_zero, device=runtime.device)
 
     # ----------------------------------------------------------------- envs
     envs = make_vector_env(cfg, rank, log_dir)
@@ -214,12 +215,17 @@ def main(runtime, cfg: Dict[str, Any]):
     rollout_key = jax.device_put(rollout_key, player_device)
 
     # --------------------------------------------------------------- loop
+    # Coalesced loss fetch + interval bounding (telemetry/step_timer.py):
+    # ONE block_until_ready + ONE device_get per log interval.
+    train_timer = telemetry.step_timer("train", timer_key="Time/train_time")
+    keep_train_metrics = aggregator is not None and not aggregator.disabled
     step_data = {}
     next_obs = envs.reset(seed=cfg.seed)[0]
     for k in obs_keys:
         step_data[k] = next_obs[k][np.newaxis]
 
     for iter_num in range(start_iter, total_iters + 1):
+        telemetry.advance(policy_step)
         for _ in range(0, cfg.algo.rollout_steps):
             policy_step += cfg.env.num_envs
 
@@ -231,7 +237,11 @@ def main(runtime, cfg: Dict[str, Any]):
                     *step_out, rollout_key = player_step_fn(
                         params_mirror.get(), np_obs, rollout_key
                     )
-                actions, real_actions_np, logprobs, values = jax.device_get(step_out)
+                # Structural per-step sync (actions feed env.step): accounted
+                # through the telemetry fetch.
+                actions, real_actions_np, logprobs, values = telemetry.fetch(
+                    step_out, label="player_actions"
+                )
 
                 obs, rewards, terminated, truncated, info = envs.step(
                     real_actions_np.reshape(envs.action_space.shape)
@@ -303,37 +313,40 @@ def main(runtime, cfg: Dict[str, Any]):
         }
 
         with timer("Time/train_time"):
-            params, opt_state, train_metrics, train_key = train_fn(
-                params,
-                opt_state,
-                flat,
-                train_key,
-                np.asarray(cfg.algo.clip_coef, np.float32),
-                np.asarray(cfg.algo.ent_coef, np.float32),
-            )
+            with train_timer.step():
+                params, opt_state, train_metrics, train_key = train_fn(
+                    params,
+                    opt_state,
+                    flat,
+                    train_key,
+                    np.asarray(cfg.algo.clip_coef, np.float32),
+                    np.asarray(cfg.algo.ent_coef, np.float32),
+                )
             # The broadcast back: the player's next rollout waits on this copy.
             params_mirror.push(params)
-            # PPO is lockstep anyway (the next rollout waits on this copy);
-            # block only when the timer needs an accurate stop.
-            if not timer.disabled:
-                jax.block_until_ready(params_mirror.get())
+            # No sync here (PPO is lockstep anyway — the next rollout waits on
+            # the mirror copy): the StepTimer queues the loss scalars and
+            # bounds the interval with ONE block at the flush below.
+            train_timer.pend(params, train_metrics if keep_train_metrics else None)
         train_step_count += n_trainers
-
-        if aggregator and not aggregator.disabled:
-            # One host fetch for the whole metrics dict (single roundtrip).
-            tm = jax.device_get(train_metrics)
-            aggregator.update("Loss/policy_loss", tm["policy_loss"])
-            aggregator.update("Loss/value_loss", tm["value_loss"])
-            aggregator.update("Loss/entropy_loss", tm["entropy_loss"])
 
         # ------------------------------------------------------- logging
         should_log = cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
         )
-        if should_log and aggregator and not aggregator.disabled:
-            # Collective when sync_on_compute is on: every rank joins;
-            # only rank 0 (the only rank with a logger) writes.
-            aggregator.log_and_reset(logger, policy_step)
+        if should_log:
+            # ONE bounding block + ONE device->host transfer for the whole
+            # interval (StepTimer.flush) — the coalesced GL002 pattern.
+            fetched_train_metrics = train_timer.flush()
+            if aggregator and not aggregator.disabled:
+                for tm in fetched_train_metrics:
+                    aggregator.update("Loss/policy_loss", tm["policy_loss"])
+                    aggregator.update("Loss/value_loss", tm["value_loss"])
+                    aggregator.update("Loss/entropy_loss", tm["entropy_loss"])
+                # Collective when sync_on_compute is on: every rank joins;
+                # only rank 0 (the only rank with a logger) writes.
+                aggregator.log_and_reset(logger, policy_step)
+            telemetry.log_counters(logger, policy_step)
         if cfg.metric.log_level > 0 and logger is not None:
             logger.log("Info/learning_rate", _current_lr(opt_state, base_lr), policy_step)
             logger.log("Info/clip_coef", cfg.algo.clip_coef, policy_step)
@@ -394,5 +407,6 @@ def main(runtime, cfg: Dict[str, Any]):
     if runtime.is_global_zero and cfg.algo.run_test:
         test(agent, params_mirror.get(), runtime, cfg, log_dir, logger)
 
+    telemetry.close()
     if logger is not None:
         logger.close()

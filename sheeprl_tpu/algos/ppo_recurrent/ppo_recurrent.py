@@ -154,6 +154,7 @@ def main(runtime, cfg: Dict[str, Any]):
     if logger is not None:
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
+    telemetry = runtime.telemetry.open(log_dir, rank_zero=runtime.is_global_zero, device=runtime.device)
     runtime.print(f"Log dir: {log_dir}")
 
     rank = runtime.global_rank
@@ -275,7 +276,12 @@ def main(runtime, cfg: Dict[str, Any]):
         carry = agent.initial_states(cfg.env.num_envs)
     prev_actions = np.zeros((cfg.env.num_envs, int(np.sum(actions_dim))), np.float32)
 
+    # Coalesced loss fetch + interval bounding (telemetry/step_timer.py):
+    # ONE block_until_ready + ONE device_get per log interval.
+    train_timer = telemetry.step_timer("train", timer_key="Time/train_time")
+    keep_train_metrics = aggregator is not None and not aggregator.disabled
     for iter_num in range(start_iter, total_iters + 1):
+        telemetry.advance(policy_step)
         for _ in range(0, cfg.algo.rollout_steps):
             policy_step += cfg.env.num_envs * world_size
 
@@ -289,8 +295,11 @@ def main(runtime, cfg: Dict[str, Any]):
                 # Single host fetch for the step outputs AND the pre-step
                 # carry snapshot the buffer stores (the post-step carry stays
                 # on device) — one device->host roundtrip instead of six.
-                actions, real_actions_np, logprobs, values, prev_cx_np, prev_hx_np = jax.device_get(
-                    (actions_j, real_actions_j, logprobs_j, values_j, prev_carry[0], prev_carry[1])
+                # Structural per-step sync: accounted through the telemetry
+                # fetch (span + byte count).
+                actions, real_actions_np, logprobs, values, prev_cx_np, prev_hx_np = telemetry.fetch(
+                    (actions_j, real_actions_j, logprobs_j, values_j, prev_carry[0], prev_carry[1]),
+                    label="player_actions",
                 )
 
                 obs, rewards, terminated, truncated, info = envs.step(
@@ -407,37 +416,38 @@ def main(runtime, cfg: Dict[str, Any]):
         seq_data["cx0"] = cx[:, 0].reshape(chunks * n_envs, -1)
 
         with timer("Time/train_time"):
-            params, opt_state, train_metrics, train_key = train_fn(
-                params,
-                opt_state,
-                seq_data,
-                train_key,
-                np.asarray(cfg.algo.clip_coef, np.float32),
-                np.asarray(cfg.algo.ent_coef, np.float32),
-            )
-            # Block only when the train timer needs an accurate stop;
-            # with metrics off the dispatch stays fully async, so the
-            # H2D infeed + train overlap the next env steps.
-            if not timer.disabled:
-                jax.block_until_ready(params)
+            with train_timer.step():
+                params, opt_state, train_metrics, train_key = train_fn(
+                    params,
+                    opt_state,
+                    seq_data,
+                    train_key,
+                    np.asarray(cfg.algo.clip_coef, np.float32),
+                    np.asarray(cfg.algo.ent_coef, np.float32),
+                )
+            # No sync here: the StepTimer queues the loss scalars device-side
+            # and bounds the interval with ONE block at the flush below.
+            train_timer.pend(params, train_metrics if keep_train_metrics else None)
         placement.push(params)
         train_step_count += world_size
-
-        if aggregator and not aggregator.disabled:
-            # One host fetch for the whole metrics dict (single roundtrip).
-            tm = jax.device_get(train_metrics)
-            aggregator.update("Loss/policy_loss", tm["policy_loss"])
-            aggregator.update("Loss/value_loss", tm["value_loss"])
-            aggregator.update("Loss/entropy_loss", tm["entropy_loss"])
 
         # ------------------------------------------------------- logging
         should_log = cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
         )
-        if should_log and aggregator and not aggregator.disabled:
-            # Collective when sync_on_compute is on: every rank joins;
-            # only rank 0 (the only rank with a logger) writes.
-            aggregator.log_and_reset(logger, policy_step)
+        if should_log:
+            # ONE bounding block + ONE device->host transfer for the whole
+            # interval (StepTimer.flush) — the coalesced GL002 pattern.
+            fetched_train_metrics = train_timer.flush()
+            if aggregator and not aggregator.disabled:
+                for tm in fetched_train_metrics:
+                    aggregator.update("Loss/policy_loss", tm["policy_loss"])
+                    aggregator.update("Loss/value_loss", tm["value_loss"])
+                    aggregator.update("Loss/entropy_loss", tm["entropy_loss"])
+                # Collective when sync_on_compute is on: every rank joins;
+                # only rank 0 (the only rank with a logger) writes.
+                aggregator.log_and_reset(logger, policy_step)
+            telemetry.log_counters(logger, policy_step)
         if cfg.metric.log_level > 0 and logger is not None:
             logger.log("Info/learning_rate", _current_lr(opt_state, base_lr), policy_step)
             logger.log("Info/clip_coef", cfg.algo.clip_coef, policy_step)
@@ -498,5 +508,6 @@ def main(runtime, cfg: Dict[str, Any]):
     if runtime.is_global_zero and cfg.algo.run_test:
         test(agent, params, runtime, cfg, log_dir, logger)
 
+    telemetry.close()
     if logger is not None:
         logger.close()

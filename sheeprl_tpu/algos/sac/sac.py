@@ -138,6 +138,7 @@ def main(runtime, cfg: Dict[str, Any]):
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
     runtime.print(f"Log dir: {log_dir}")
+    telemetry = runtime.telemetry.open(log_dir, rank_zero=runtime.is_global_zero, device=runtime.device)
 
     envs = make_vector_env(cfg, rank, log_dir)
     action_space = envs.single_action_space
@@ -257,13 +258,16 @@ def main(runtime, cfg: Dict[str, Any]):
     # Bound async in-flight train dispatches (core/runtime.py: an
     # unbounded queue pins every pending call's sampled batch on host).
     dispatch_throttle = DispatchThrottle()
-    # Train losses stay device-resident between log intervals; ONE coalesced
-    # jax.device_get per interval replaces the per-iteration fetch (each
-    # fetch is a full round trip over a tunneled chip). Scalars only, so the
-    # pinned device memory is negligible.
-    pending_train_metrics = []
+    # Train losses stay device-resident between log intervals; the StepTimer
+    # coalesces them into ONE jax.device_get per interval and bounds the
+    # interval's wall-clock with ONE block_until_ready (each sync is a full
+    # round trip over a tunneled chip). Scalars only, so the pinned device
+    # memory is negligible.
+    train_timer = telemetry.step_timer("train", timer_key="Time/train_time")
+    keep_train_metrics = aggregator is not None and not aggregator.disabled and cfg.metric.log_level > 0
     for iter_num in range(start_iter, total_iters + 1):
         policy_step += policy_steps_per_iter
+        telemetry.advance(policy_step)
 
         with timer("Time/env_interaction_time"):
             if iter_num <= learning_starts:
@@ -272,7 +276,9 @@ def main(runtime, cfg: Dict[str, Any]):
                 with placement.ctx():
                     np_obs = prepare_obs(obs, mlp_keys=mlp_keys, num_envs=cfg.env.num_envs)
                     actions_j, rollout_key = player_fn(placement.params(), np_obs, rollout_key)
-                    actions = np.asarray(actions_j)
+                    # Structural per-step sync (actions must reach env.step on
+                    # host): accounted through the telemetry fetch.
+                    actions = telemetry.fetch(actions_j, label="player_actions")
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 actions.reshape(envs.action_space.shape)
             )
@@ -328,45 +334,42 @@ def main(runtime, cfg: Dict[str, Any]):
                     do_ema = iter_num % target_freq_iters == 0
                     # tau as numpy (an eager jnp.asarray would dispatch);
                     # the PRNG split happens inside the jit.
-                    agent_state, opt_states, train_metrics, train_key = train_fn(
-                        agent_state,
-                        opt_states,
-                        data,
-                        train_key,
-                        np.asarray(agent.tau if do_ema else 0.0, np.float32),
+                    with train_timer.step():
+                        agent_state, opt_states, train_metrics, train_key = train_fn(
+                            agent_state,
+                            opt_states,
+                            data,
+                            train_key,
+                            np.asarray(agent.tau if do_ema else 0.0, np.float32),
+                        )
+                    # No sync here: the dispatch stays fully async — the
+                    # StepTimer queues the loss scalars device-side and
+                    # bounds the interval with ONE block at the flush below.
+                    train_timer.pend(
+                        agent_state["actor"], train_metrics if keep_train_metrics else None
                     )
                     dispatch_throttle.add(train_metrics)
-                    # Block only when the train timer needs an accurate stop;
-                    # with metrics off the dispatch stays fully async, so the
-                    # H2D infeed + train overlap the next env steps.
-                    if not timer.disabled:
-                        # Deliberate: the train timer needs an accurate stop.
-                        jax.block_until_ready(agent_state["actor"])  # graftlint: disable=GL002
                     placement.push(agent_state["actor"])
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                 train_step_count += world_size
 
-                if aggregator and not aggregator.disabled and cfg.metric.log_level > 0:
-                    # No fetch here: the loss scalars queue device-side until
-                    # the log-interval flush below.
-                    pending_train_metrics.append(train_metrics)
-
         should_log = cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
         )
-        if should_log and aggregator and not aggregator.disabled:
-            if pending_train_metrics:
-                # The whole interval's losses in ONE device->host transfer —
-                # the coalesced pattern GL002 asks for (hence the explicit
-                # opt-out on a deliberate inside-the-loop sync).
-                for tm in jax.device_get(pending_train_metrics):  # graftlint: disable=GL002
+        if should_log:
+            # The interval's ONE bounding block + ONE coalesced device->host
+            # transfer of every queued loss tree (StepTimer.flush) — the
+            # pattern GL002 asks for, now owned by telemetry.
+            fetched_train_metrics = train_timer.flush()
+            if aggregator and not aggregator.disabled:
+                for tm in fetched_train_metrics:
                     aggregator.update("Loss/value_loss", tm["value_loss"])
                     aggregator.update("Loss/policy_loss", tm["policy_loss"])
                     aggregator.update("Loss/alpha_loss", tm["alpha_loss"])
-                pending_train_metrics.clear()
-            # Collective when sync_on_compute is on: every rank joins;
-            # only rank 0 (the only rank with a logger) writes.
-            aggregator.log_and_reset(logger, policy_step)
+                # Collective when sync_on_compute is on: every rank joins;
+                # only rank 0 (the only rank with a logger) writes.
+                aggregator.log_and_reset(logger, policy_step)
+            telemetry.log_counters(logger, policy_step)
         if should_log and logger is not None:
             logger.log(
                 "Params/replay_ratio", cumulative_per_rank_gradient_steps * world_size / policy_step, policy_step
@@ -426,5 +429,6 @@ def main(runtime, cfg: Dict[str, Any]):
     if runtime.is_global_zero and cfg.algo.run_test:
         test(agent, agent_state, runtime, cfg, log_dir, logger)
 
+    telemetry.close()
     if logger is not None:
         logger.close()

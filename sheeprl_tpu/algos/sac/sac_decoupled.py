@@ -72,6 +72,7 @@ def main(runtime, cfg: Dict[str, Any]):
     if logger is not None:
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
+    telemetry = runtime.telemetry.open(log_dir, rank_zero=runtime.is_global_zero, device=runtime.device)
     runtime.print(f"Log dir: {log_dir}")
 
     # ------------------------------------------------------------ environment
@@ -224,8 +225,13 @@ def main(runtime, cfg: Dict[str, Any]):
     # Bound async in-flight train dispatches (core/runtime.py: an
     # unbounded queue pins every pending call's sampled batch on host).
     dispatch_throttle = DispatchThrottle()
+    # Coalesced loss fetch + interval bounding (telemetry/step_timer.py):
+    # ONE block_until_ready + ONE device_get per log interval.
+    train_timer = telemetry.step_timer("train", timer_key="Time/train_time")
+    keep_train_metrics = aggregator is not None and not aggregator.disabled
     for iter_num in range(start_iter, total_iters + 1):
         policy_step += policy_steps_per_iter
+        telemetry.advance(policy_step)
 
         with timer("Time/env_interaction_time"):
             if iter_num <= learning_starts:
@@ -234,7 +240,9 @@ def main(runtime, cfg: Dict[str, Any]):
                 with jax.default_device(player_device):
                     np_obs = prepare_obs(obs, mlp_keys=mlp_keys, num_envs=cfg.env.num_envs)
                     actions_j, rollout_key = player_fn(actor_mirror.get(), np_obs, rollout_key)
-                actions = np.asarray(actions_j)
+                # Structural per-step sync (actions feed env.step): accounted
+                # through the telemetry fetch.
+                actions = telemetry.fetch(actions_j, label="player_actions")
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 actions.reshape(envs.action_space.shape)
             )
@@ -295,38 +303,44 @@ def main(runtime, cfg: Dict[str, Any]):
                 }
                 with timer("Time/train_time"):
                     do_ema = iter_num % target_freq_iters == 0
-                    agent_state, opt_states, train_metrics, train_key = train_fn(
-                        agent_state,
-                        opt_states,
-                        data,
-                        train_key,
-                        np.asarray(agent.tau if do_ema else 0.0, np.float32),
+                    with train_timer.step():
+                        agent_state, opt_states, train_metrics, train_key = train_fn(
+                            agent_state,
+                            opt_states,
+                            data,
+                            train_key,
+                            np.asarray(agent.tau if do_ema else 0.0, np.float32),
+                        )
+                    # No sync here: the StepTimer queues the loss scalars
+                    # device-side and bounds the interval with ONE block at
+                    # the log-interval flush.
+                    train_timer.pend(
+                        agent_state["actor"], train_metrics if keep_train_metrics else None
                     )
                     dispatch_throttle.add(train_metrics)
                     # The broadcast back: enqueue the packed weight copy and
                     # return to env stepping.
                     actor_mirror.push(agent_state["actor"])
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
-                    if aggregator and not aggregator.disabled:
-                        # np.asarray blocks on the train step, making
-                        # Time/train_time (and sps_train) meaningful whenever
-                        # they are actually reported; with metrics off the
-                        # dispatch stays fully async.
-                        # One host fetch for the whole metrics dict (single roundtrip).
-                        tm = jax.device_get(train_metrics)
-                        aggregator.update("Loss/value_loss", tm["value_loss"])
-                        aggregator.update("Loss/policy_loss", tm["policy_loss"])
-                        aggregator.update("Loss/alpha_loss", tm["alpha_loss"])
                 train_step_count += n_trainers
 
         # ------------------------------------------------------------ logging
         should_log = cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
         )
-        if should_log and aggregator and not aggregator.disabled:
-            # Collective when sync_on_compute is on: every rank joins;
-            # only rank 0 (the only rank with a logger) writes.
-            aggregator.log_and_reset(logger, policy_step)
+        if should_log:
+            # ONE bounding block + ONE device->host transfer for the whole
+            # interval (StepTimer.flush) — the coalesced GL002 pattern.
+            fetched_train_metrics = train_timer.flush()
+            if aggregator and not aggregator.disabled:
+                for tm in fetched_train_metrics:
+                    aggregator.update("Loss/value_loss", tm["value_loss"])
+                    aggregator.update("Loss/policy_loss", tm["policy_loss"])
+                    aggregator.update("Loss/alpha_loss", tm["alpha_loss"])
+                # Collective when sync_on_compute is on: every rank joins;
+                # only rank 0 (the only rank with a logger) writes.
+                aggregator.log_and_reset(logger, policy_step)
+            telemetry.log_counters(logger, policy_step)
         if should_log and logger is not None:
             if policy_step > 0:
                 logger.log(
@@ -392,5 +406,6 @@ def main(runtime, cfg: Dict[str, Any]):
         # flush: serve the final trained weights, not a stale async snapshot
         test(agent, {"actor": actor_mirror.flush()}, runtime, cfg, log_dir, logger)
 
+    telemetry.close()
     if logger is not None:
         logger.close()

@@ -195,6 +195,7 @@ def main(runtime, cfg: Dict[str, Any]):
     if logger is not None:
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
+    telemetry = runtime.telemetry.open(log_dir, rank_zero=runtime.is_global_zero, device=runtime.device)
     runtime.print(f"Log dir: {log_dir}")
 
     envs = make_vector_env(cfg, rank, log_dir)
@@ -341,8 +342,13 @@ def main(runtime, cfg: Dict[str, Any]):
     # Bound async in-flight train dispatches (core/runtime.py: an
     # unbounded queue pins every pending call's sampled batch on host).
     dispatch_throttle = DispatchThrottle()
+    # Coalesced loss fetch + interval bounding (telemetry/step_timer.py):
+    # ONE block_until_ready + ONE device_get per log interval.
+    train_timer = telemetry.step_timer("train", timer_key="Time/train_time")
+    keep_train_metrics = aggregator is not None and not aggregator.disabled
     for iter_num in range(start_iter, total_iters + 1):
         policy_step += policy_steps_per_iter
+        telemetry.advance(policy_step)
 
         with timer("Time/env_interaction_time"):
             if iter_num <= learning_starts:
@@ -351,7 +357,9 @@ def main(runtime, cfg: Dict[str, Any]):
                 with placement.ctx():
                     np_obs = prepare_obs(obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=cfg.env.num_envs)
                     actions_j, rollout_key = player_fn(placement.params(), np_obs, rollout_key)
-                    actions = np.asarray(actions_j)
+                    # Structural per-step sync (actions feed env.step):
+                    # accounted through the telemetry fetch.
+                    actions = telemetry.fetch(actions_j, label="player_actions")
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 actions.reshape(envs.action_space.shape)
             )
@@ -405,7 +413,6 @@ def main(runtime, cfg: Dict[str, Any]):
                     k: v if k.removeprefix("next_") in cnn_keys else v.astype(np.float32)
                     for k, v in data.items()
                 }
-                per_step_metrics = []
                 with timer("Time/train_time"):
                     for i in range(per_rank_gradient_steps):
                         batch = {k: jnp.asarray(v[i]) for k, v in data.items()}
@@ -420,40 +427,46 @@ def main(runtime, cfg: Dict[str, Any]):
                         update_decoder = (
                             cumulative_per_rank_gradient_steps % cfg.algo.decoder.per_rank_update_freq == 0
                         )
-                        agent_state, opt_states, train_metrics, train_key = train_fn(
-                            agent_state, opt_states, batch, train_key, update_actor, update_ema, update_decoder
+                        with train_timer.step():
+                            agent_state, opt_states, train_metrics, train_key = train_fn(
+                                agent_state, opt_states, batch, train_key, update_actor, update_ema, update_decoder
+                            )
+                        # No sync here: the StepTimer queues the loss scalars
+                        # (plus the which-updates-ran flags, which device_get
+                        # passes through) and bounds the interval with ONE
+                        # block at the log-interval flush.
+                        train_timer.pend(
+                            agent_state["actor"],
+                            (train_metrics, update_actor, update_decoder)
+                            if keep_train_metrics
+                            else None,
                         )
-                        per_step_metrics.append((train_metrics, update_actor, update_decoder))
                         dispatch_throttle.add(train_metrics)
                         cumulative_per_rank_gradient_steps += 1
-                    # Block only when the train timer needs an accurate stop;
-                    # with metrics off the dispatch stays fully async, so the
-                    # H2D infeed + train overlap the next env steps.
-                    if not timer.disabled:
-                        jax.block_until_ready(agent_state["actor"])
                     placement.push(_player_view(agent_state))
                 train_step_count += world_size
-
-                # Only feed losses whose update actually ran this step — the
-                # skipped branches report placeholder zeros.
-                if aggregator and not aggregator.disabled:
-                    # One host fetch for all gradient steps' metrics.
-                    fetched = jax.device_get([m for m, _, _ in per_step_metrics])
-                    for m, (_, did_actor, did_decoder) in zip(fetched, per_step_metrics):
-                        aggregator.update("Loss/value_loss", m["value_loss"])
-                        if did_actor:
-                            aggregator.update("Loss/policy_loss", m["policy_loss"])
-                            aggregator.update("Loss/alpha_loss", m["alpha_loss"])
-                        if did_decoder:
-                            aggregator.update("Loss/reconstruction_loss", m["reconstruction_loss"])
 
         should_log = cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
         )
-        if should_log and aggregator and not aggregator.disabled:
-            # Collective when sync_on_compute is on: every rank joins;
-            # only rank 0 (the only rank with a logger) writes.
-            aggregator.log_and_reset(logger, policy_step)
+        if should_log:
+            # ONE bounding block + ONE device->host transfer for the whole
+            # interval (StepTimer.flush) — the coalesced GL002 pattern. Only
+            # losses whose update actually ran are fed to the aggregator —
+            # the skipped branches report placeholder zeros.
+            fetched_train_metrics = train_timer.flush()
+            if aggregator and not aggregator.disabled:
+                for m, did_actor, did_decoder in fetched_train_metrics:
+                    aggregator.update("Loss/value_loss", m["value_loss"])
+                    if did_actor:
+                        aggregator.update("Loss/policy_loss", m["policy_loss"])
+                        aggregator.update("Loss/alpha_loss", m["alpha_loss"])
+                    if did_decoder:
+                        aggregator.update("Loss/reconstruction_loss", m["reconstruction_loss"])
+                # Collective when sync_on_compute is on: every rank joins;
+                # only rank 0 (the only rank with a logger) writes.
+                aggregator.log_and_reset(logger, policy_step)
+            telemetry.log_counters(logger, policy_step)
         if should_log and logger is not None:
             logger.log(
                 "Params/replay_ratio", cumulative_per_rank_gradient_steps * world_size / policy_step, policy_step
@@ -512,5 +525,6 @@ def main(runtime, cfg: Dict[str, Any]):
     if runtime.is_global_zero and cfg.algo.run_test:
         test(agent, agent_state, runtime, cfg, log_dir, logger)
 
+    telemetry.close()
     if logger is not None:
         logger.close()

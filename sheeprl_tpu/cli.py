@@ -93,6 +93,15 @@ def check_configs(cfg: dotdict) -> None:
     player_sync = str(cfg.fabric.get("player_sync", "fresh") or "fresh").lower()
     if player_sync not in ("fresh", "async"):
         raise ValueError(f"Unknown fabric.player_sync '{player_sync}'. Valid: fresh | async")
+    tele = cfg.get("telemetry")
+    if tele is not None and tele.get("profiler") is not None:
+        start = int(tele.profiler.get("start_step", -1))
+        stop = int(tele.profiler.get("stop_step", -1))
+        if (start >= 0) != (stop >= 0) or (start >= 0 and stop <= start):
+            raise ValueError(
+                "telemetry.profiler window must satisfy 0 <= start_step < stop_step "
+                f"(or both -1 to disable); got [{start}, {stop})"
+            )
     entry = algorithm_registry[cfg.algo.name]
     if (
         entry.decoupled
@@ -223,6 +232,11 @@ def run_algorithm(cfg: dotdict) -> None:
     runtime = instantiate(cfg.fabric)
     runtime.launch()
     runtime.seed_everything(cfg.seed)
+    # The run's observability surface: every algorithm opens it against its
+    # log dir and threads it through the train loop (howto/observability.md).
+    from sheeprl_tpu.telemetry import Telemetry
+
+    runtime.telemetry = Telemetry.from_config(cfg)
     import jax
 
     # Eager ops and un-sharded jits must land on the chosen accelerator (the

@@ -47,6 +47,13 @@ def ship_rollout(
     """
     import jax
 
+    from sheeprl_tpu.telemetry.tracer import current as _current_tracer
+
+    with _current_tracer().span("rollout/ship", "transfer"):
+        return _ship_rollout(runtime, local_data, flat_keys, next_obs_np, share_data, jax)
+
+
+def _ship_rollout(runtime, local_data, flat_keys, next_obs_np, share_data, jax):
     data = {k: np.asarray(local_data[k]) for k in (*flat_keys, *_SEQ_KEYS)}
     if share_data and jax.process_count() > 1:
         from jax.experimental import multihost_utils

@@ -27,6 +27,7 @@ import numpy as np
 from sheeprl_tpu.core import mesh as mesh_lib
 from sheeprl_tpu.core.precision import Precision, resolve_precision
 from sheeprl_tpu.core.prng import seed_everything
+from sheeprl_tpu.telemetry import Telemetry
 
 _TPU_PLATFORMS = ("tpu", "axon")
 
@@ -254,6 +255,10 @@ class Runtime:
         self._launched = False
         self.seed: Optional[int] = None
         self.root_key: Optional[jax.Array] = None
+        # The run's observability surface (sheeprl_tpu/telemetry): the CLI
+        # replaces this with Telemetry.from_config(cfg); the default no-op
+        # keeps direct Runtime construction (tests, scripts) zero-cost.
+        self.telemetry: Telemetry = Telemetry.noop()
 
     # ------------------------------------------------------------ lifecycle
     def launch(self) -> "Runtime":
@@ -440,4 +445,5 @@ def get_single_device_runtime(runtime: Runtime) -> Runtime:
     view._launched = True
     view.seed = runtime.seed
     view.root_key = runtime.root_key
+    view.telemetry = runtime.telemetry
     return view

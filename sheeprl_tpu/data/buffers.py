@@ -28,6 +28,7 @@ from typing import Any, Dict, List, Optional, Sequence, Type
 import numpy as np
 
 from sheeprl_tpu.data.memmap import _VALID_MODES, MemmapArray
+from sheeprl_tpu.telemetry.tracer import current as _current_tracer
 
 def get_array(
     value: "np.ndarray | MemmapArray",
@@ -261,7 +262,10 @@ class ReplayBuffer:
     ) -> Dict[str, Any]:
         """Sample and move to device (optionally pre-sharded across a mesh)."""
         n_samples = kwargs.pop("n_samples", 1)
-        samples = self.sample(batch_size, sample_next_obs=sample_next_obs, clone=clone, n_samples=n_samples, **kwargs)
+        with _current_tracer().span("replay/sample", "replay", batch_size=int(batch_size)):
+            samples = self.sample(
+                batch_size, sample_next_obs=sample_next_obs, clone=clone, n_samples=n_samples, **kwargs
+            )
         return {k: get_array(v, dtype=dtype, device=device) for k, v in samples.items()}
 
     def to_tensor(self, dtype: Optional[Any] = None, clone: bool = False, device: Optional[Any] = None) -> Dict[str, Any]:
@@ -488,9 +492,10 @@ class EnvIndependentReplayBuffer:
         device: Optional[Any] = None,
         **kwargs,
     ) -> Dict[str, Any]:
-        samples = self.sample(
-            batch_size=batch_size, sample_next_obs=sample_next_obs, clone=clone, n_samples=n_samples, **kwargs
-        )
+        with _current_tracer().span("replay/sample", "replay", batch_size=int(batch_size)):
+            samples = self.sample(
+                batch_size=batch_size, sample_next_obs=sample_next_obs, clone=clone, n_samples=n_samples, **kwargs
+            )
         return {k: get_array(v, dtype=dtype, device=device) for k, v in samples.items()}
 
 
@@ -739,5 +744,6 @@ class EpisodeBuffer:
         device: Optional[Any] = None,
         **kwargs,
     ) -> Dict[str, Any]:
-        samples = self.sample(batch_size, sample_next_obs, n_samples, clone, sequence_length)
+        with _current_tracer().span("replay/sample", "replay", batch_size=int(batch_size)):
+            samples = self.sample(batch_size, sample_next_obs, n_samples, clone, sequence_length)
         return {k: get_array(v, dtype=dtype, device=device) for k, v in samples.items()}

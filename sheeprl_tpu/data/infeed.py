@@ -29,6 +29,8 @@ from __future__ import annotations
 import concurrent.futures
 from typing import Any, Callable, List, Optional
 
+from sheeprl_tpu.telemetry.tracer import current as _current_tracer
+
 
 class AsyncInfeed:
     """Double-buffered device staging of pre-sampled host batches."""
@@ -53,7 +55,10 @@ class AsyncInfeed:
         batches = list(host_batches)
 
         def work():
-            return [self._put_fn(b) for b in batches]
+            # Worker thread: the tracer is thread-safe, and the span makes the
+            # overlapped H2D staging visible on its own trace track.
+            with _current_tracer().span("transfer/h2d_stage", "transfer", batches=len(batches)):
+                return [self._put_fn(b) for b in batches]
 
         self._staged_count = len(batches)
         self._future = self._executor.submit(work)
@@ -120,7 +125,8 @@ class ReplayInfeed:
         """Staged device batches if available, else sample+copy synchronously."""
         batches = self._infeed.take(n) if self._infeed is not None else None
         if batches is None:
-            batches = [self._device_batch(b) for b in self._sample_host(n)]
+            with _current_tracer().span("transfer/h2d_sync", "transfer", batches=n):
+                batches = [self._device_batch(b) for b in self._sample_host(n)]
         return batches
 
     def stage(self, n: int) -> None:

@@ -1,0 +1,35 @@
+"""sheeprl_tpu.telemetry: first-party observability for every train loop.
+
+Parts (see each module's docstring for the design):
+
+- :mod:`~sheeprl_tpu.telemetry.tracer` — span ring buffer, Chrome-trace /
+  JSONL exporters, the process-wide current tracer;
+- :mod:`~sheeprl_tpu.telemetry.step_timer` — async-dispatch-aware step
+  timing with the coalesced per-interval metric fetch (the productized
+  donated-chain pattern from PROFILE.md);
+- :mod:`~sheeprl_tpu.telemetry.jax_events` — compile/retrace/cache
+  counters via jax.monitoring, HBM gauges, recompile-after-warmup watchdog;
+- :mod:`~sheeprl_tpu.telemetry.profiling` — config-driven jax.profiler
+  step-window traces and live profiler server;
+- :mod:`~sheeprl_tpu.telemetry.telemetry` — the :class:`Telemetry` facade
+  the Runtime carries and the algorithms thread through their loops.
+"""
+
+from sheeprl_tpu.telemetry import tracer
+from sheeprl_tpu.telemetry.jax_events import JaxEventMonitor
+from sheeprl_tpu.telemetry.profiling import ProfilerWindow
+from sheeprl_tpu.telemetry.step_timer import StepTimer
+from sheeprl_tpu.telemetry.telemetry import CHROME_TRACE_FILENAME, JSONL_FILENAME, Telemetry
+from sheeprl_tpu.telemetry.tracer import Span, Tracer
+
+__all__ = [
+    "CHROME_TRACE_FILENAME",
+    "JSONL_FILENAME",
+    "JaxEventMonitor",
+    "ProfilerWindow",
+    "Span",
+    "StepTimer",
+    "Telemetry",
+    "Tracer",
+    "tracer",
+]

@@ -1,0 +1,236 @@
+"""The `Telemetry` facade: one object per run, hung off the Runtime.
+
+Composition of the observability subsystem's parts:
+
+- a :class:`~sheeprl_tpu.telemetry.tracer.Tracer` (span ring buffer),
+  installed as the process-wide current tracer while the run is open so
+  low-level emitters (utils/timer, core/rollout, data/infeed) need no
+  plumbing;
+- :class:`~sheeprl_tpu.telemetry.jax_events.JaxEventMonitor` compile/
+  retrace/cache counters plus HBM gauges;
+- a :class:`~sheeprl_tpu.telemetry.profiling.ProfilerWindow` for the
+  config-driven XLA trace window and live profiler server;
+- :class:`~sheeprl_tpu.telemetry.step_timer.StepTimer` instances for the
+  train loops (always functional — they carry the coalesced metric fetch —
+  whether or not telemetry is enabled).
+
+Exports (rank zero, on :meth:`close`): ``trace.json`` (Chrome trace-event
+JSON) and ``telemetry.jsonl`` (a meta line at open, one counters line per
+log interval, every span + final counters at close) in the run's log dir.
+
+Every recording path short-circuits when disabled; a disabled Telemetry is
+safe to thread through any loop.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+from sheeprl_tpu.telemetry import tracer as tracer_mod
+from sheeprl_tpu.telemetry.jax_events import JaxEventMonitor
+from sheeprl_tpu.telemetry.profiling import ProfilerWindow
+from sheeprl_tpu.telemetry.step_timer import StepTimer
+from sheeprl_tpu.telemetry.tracer import Tracer
+
+CHROME_TRACE_FILENAME = "trace.json"
+JSONL_FILENAME = "telemetry.jsonl"
+
+
+class Telemetry:
+    def __init__(
+        self,
+        enabled: bool = False,
+        buffer_capacity: int = 65536,
+        warmup_iters: int = 3,
+        warn_on_recompile: bool = True,
+        chrome_trace: bool = True,
+        jsonl: bool = True,
+        profiler_start_step: int = -1,
+        profiler_stop_step: int = -1,
+        profiler_trace_dir: Optional[str] = None,
+        profiler_port: Optional[int] = None,
+    ) -> None:
+        self.enabled = bool(enabled)
+        self.chrome_trace = bool(chrome_trace)
+        self.jsonl = bool(jsonl)
+        self._tracer = Tracer(capacity=buffer_capacity, enabled=self.enabled)
+        self._monitor = JaxEventMonitor(
+            warmup_iters=warmup_iters, warn_on_recompile=warn_on_recompile
+        )
+        self._profiler = ProfilerWindow(
+            trace_dir=profiler_trace_dir,
+            start_step=profiler_start_step,
+            stop_step=profiler_stop_step,
+            port=profiler_port,
+        )
+        self._step_timers: Dict[str, StepTimer] = {}
+        self._log_dir: Optional[str] = None
+        self._rank_zero = True
+        self._device: Any = None
+        self._opened = False
+        self._previous_tracer: Optional[Tracer] = None
+
+    # ------------------------------------------------------------- config
+    @classmethod
+    def from_config(cls, cfg: Any) -> "Telemetry":
+        """Build from the composed run config's ``telemetry`` group (absent
+        or empty group -> disabled)."""
+        tele = cfg.get("telemetry") if hasattr(cfg, "get") else None
+        if not tele:
+            return cls(enabled=False)
+        prof = tele.get("profiler") or {}
+        return cls(
+            enabled=bool(tele.get("enabled", False)),
+            buffer_capacity=int(tele.get("buffer_capacity", 65536)),
+            warmup_iters=int(tele.get("warmup_iters", 3)),
+            warn_on_recompile=bool(tele.get("warn_on_recompile", True)),
+            chrome_trace=bool(tele.get("chrome_trace", True)),
+            jsonl=bool(tele.get("jsonl", True)),
+            profiler_start_step=int(prof.get("start_step", -1)),
+            profiler_stop_step=int(prof.get("stop_step", -1)),
+            profiler_trace_dir=prof.get("trace_dir"),
+            profiler_port=prof.get("port"),
+        )
+
+    @classmethod
+    def noop(cls) -> "Telemetry":
+        return cls(enabled=False)
+
+    # ---------------------------------------------------------- lifecycle
+    def open(self, log_dir: Optional[str], rank_zero: bool = True, device: Any = None) -> "Telemetry":
+        """Bind the run's log dir and go live: install the tracer as the
+        process-wide current one, attach the jax.monitoring counters, start
+        the profiler server if configured. Idempotent; returns self."""
+        self._log_dir = log_dir
+        self._rank_zero = bool(rank_zero)
+        self._device = device
+        if not self.enabled or self._opened:
+            return self
+        self._opened = True
+        self._previous_tracer = tracer_mod.set_current(self._tracer)
+        self._monitor.attach()
+        if self._profiler.trace_dir is None and log_dir is not None:
+            self._profiler.trace_dir = os.path.join(log_dir, "xla_trace")
+        self._profiler.start_server()
+        if self._jsonl_path() is not None:
+            import jax
+
+            self._append_jsonl(
+                {
+                    "type": "meta",
+                    "time": time.time(),
+                    "backend": jax.default_backend(),
+                    "process_index": jax.process_index(),
+                    "profiler_window": [self._profiler.start_step, self._profiler.stop_step],
+                },
+                mode="w",
+            )
+        return self
+
+    def close(self) -> None:
+        """Stop profiling, detach counters, export trace.json/telemetry.jsonl
+        (rank zero), and restore the previously-installed tracer."""
+        for st in self._step_timers.values():
+            st.flush()
+        if not self._opened:
+            return
+        self._profiler.close()
+        self._monitor.detach()
+        self._export()
+        tracer_mod.set_current(self._previous_tracer)
+        self._previous_tracer = None
+        self._opened = False
+
+    # ------------------------------------------------------------ hot path
+    def span(self, name: str, category: str = "host", **args: Any):
+        return self._tracer.span(name, category, **args)
+
+    def fetch(self, tree: Any, label: str = "fetch") -> Any:
+        """``jax.device_get`` with the transfer accounted: a fetch span plus
+        the device->host byte counter. This is the audited home for
+        structurally-necessary per-step syncs (actions feeding env.step)."""
+        import jax
+
+        start = time.perf_counter()
+        out = jax.device_get(tree)
+        if self.enabled:
+            elapsed = time.perf_counter() - start
+            nbytes = tracer_mod.tree_bytes(out)
+            self._tracer.add_span(f"fetch/{label}", "fetch", start, elapsed, {"bytes": nbytes})
+            self._tracer.count("device_get_calls", 1)
+            self._tracer.count("device_get_bytes", nbytes)
+        return out
+
+    def step_timer(self, name: str = "train", timer_key: Optional[str] = None) -> StepTimer:
+        st = self._step_timers.get(name)
+        if st is None:
+            st = StepTimer(name=name, timer_key=timer_key)
+            self._step_timers[name] = st
+        return st
+
+    def advance(self, step: int) -> None:
+        """Once per train iteration: drives the profiler window and the
+        recompile-after-warmup watchdog."""
+        if not self.enabled:
+            return
+        self._profiler.advance(step)
+        self._monitor.advance()
+
+    # ------------------------------------------------------------ counters
+    def counters(self) -> Dict[str, float]:
+        merged = self._tracer.counters()
+        merged.update(self._monitor.counters)
+        if self._device is not None:
+            merged.update(self._monitor.memory_gauges(self._device))
+        if self._tracer.dropped:
+            merged["spans_dropped"] = float(self._tracer.dropped)
+        return merged
+
+    def log_counters(self, logger: Any, step: int) -> Dict[str, float]:
+        """Per-log-interval export: every counter through the experiment
+        logger (TensorBoard/MLflow `log` surface) and one counters line in
+        telemetry.jsonl."""
+        if not self.enabled:
+            return {}
+        counters = self.counters()
+        if logger is not None:
+            for name in sorted(counters):
+                logger.log(f"Telemetry/{name}", counters[name], step)
+            st = self._step_timers.get("train")
+            if st is not None and st.steps:
+                logger.log("Telemetry/train_step_ms", st.seconds_per_step * 1e3, step)
+        if self._jsonl_path() is not None:
+            self._append_jsonl({"type": "counters", "step": step, "values": counters})
+        return counters
+
+    # ------------------------------------------------------------- export
+    def _jsonl_path(self) -> Optional[str]:
+        if self.enabled and self.jsonl and self._rank_zero and self._log_dir:
+            return os.path.join(self._log_dir, JSONL_FILENAME)
+        return None
+
+    def _append_jsonl(self, record: Dict[str, Any], mode: str = "a") -> None:
+        path = self._jsonl_path()
+        if path is None:
+            return
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, mode) as fp:
+            fp.write(json.dumps(record) + "\n")
+
+    def _export(self) -> None:
+        if not (self._rank_zero and self._log_dir):
+            return
+        if self.chrome_trace:
+            self._tracer.export_chrome(os.path.join(self._log_dir, CHROME_TRACE_FILENAME))
+        path = self._jsonl_path()
+        if path is not None:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "a") as fp:
+                for line in self._tracer.iter_jsonl():
+                    fp.write(line + "\n")
+                fp.write(
+                    json.dumps({"type": "counters", "step": -1, "values": self.counters()}) + "\n"
+                )

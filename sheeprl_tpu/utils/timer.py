@@ -3,16 +3,30 @@
 Parity with the reference `timer` ContextDecorator
 (sheeprl/utils/timer.py:16-84): accumulates elapsed seconds per key into a
 process-global store, with a global disable flag, compute() and reset().
-On TPU the caller is responsible for bounding timed regions with
-`jax.block_until_ready` where async dispatch would make wall-clock lie
-(the algorithms do this around their jitted update calls).
+
+Two departures from the reference:
+
+- **Reentrant**: each key keeps a *stack* of start times, so nested or
+  re-entered use of the same key (a decorated helper called inside a
+  ``with timer(key)`` block, recursive phases) accumulates correctly
+  instead of raising ``TimerError`` mid-run. stop() without a matching
+  start() still raises.
+- **Span emission**: every stop also emits the measured region as a span
+  into the process-wide telemetry tracer (a no-op unless a run installed
+  one), so ``timer.compute()`` and the exported trace agree by
+  construction.
+
+On TPU the caller is responsible for bounding timed regions where async
+dispatch would make wall-clock lie; the train loops do this through
+StepTimer's single per-interval block (sheeprl_tpu/telemetry/step_timer.py),
+which credits the block back into the phase total via :meth:`timer.add`.
 """
 
 from __future__ import annotations
 
 import time
 from contextlib import ContextDecorator
-from typing import Any, ClassVar, Dict, Optional
+from typing import Any, ClassVar, Dict, List
 
 
 class TimerError(Exception):
@@ -22,7 +36,7 @@ class TimerError(Exception):
 class timer(ContextDecorator):
     disabled: ClassVar[bool] = False
     timers: ClassVar[Dict[str, float]] = {}
-    _start_times: ClassVar[Dict[str, float]] = {}
+    _start_times: ClassVar[Dict[str, List[float]]] = {}
 
     def __init__(self, name: str, metric: Any = None, **kwargs: Any) -> None:
         # `metric` accepted for reference-call-site parity (SumMetric etc.);
@@ -32,17 +46,24 @@ class timer(ContextDecorator):
     def start(self) -> None:
         if self.disabled:
             return
-        if self.name in type(self)._start_times:
-            raise TimerError(f"Timer '{self.name}' is running. Use .stop() to stop it")
-        type(self)._start_times[self.name] = time.perf_counter()
+        type(self)._start_times.setdefault(self.name, []).append(time.perf_counter())
 
     def stop(self) -> float:
         if self.disabled:
             return 0.0
-        if self.name not in type(self)._start_times:
+        stack = type(self)._start_times.get(self.name)
+        if not stack:
             raise TimerError(f"Timer '{self.name}' is not running. Use .start() to start it")
-        elapsed = time.perf_counter() - type(self)._start_times.pop(self.name)
+        started = stack.pop()
+        if not stack:
+            del type(self)._start_times[self.name]
+        elapsed = time.perf_counter() - started
         type(self).timers[self.name] = type(self).timers.get(self.name, 0.0) + elapsed
+        # Keep the trace and compute() in agreement: the stopped region is
+        # also a span on the telemetry timeline (no-op tracer by default).
+        from sheeprl_tpu.telemetry.tracer import current
+
+        current().add_span(self.name, "timer", started, elapsed)
         return elapsed
 
     def __enter__(self) -> "timer":
@@ -51,6 +72,14 @@ class timer(ContextDecorator):
 
     def __exit__(self, *exc_info: Any) -> None:
         self.stop()
+
+    @classmethod
+    def add(cls, name: str, seconds: float) -> None:
+        """Credit externally-measured seconds to a key (StepTimer's
+        per-interval bounding block lands here so phase sums stay true)."""
+        if cls.disabled:
+            return
+        cls.timers[name] = cls.timers.get(name, 0.0) + float(seconds)
 
     @classmethod
     def compute(cls) -> Dict[str, float]:

@@ -1,0 +1,86 @@
+"""jax.monitoring counter tests: compile counting, the recompile-after-warmup
+watchdog (forced with a shape change), and HBM gauges on CPU."""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from sheeprl_tpu.telemetry import tracer as tracer_mod
+from sheeprl_tpu.telemetry.jax_events import JaxEventMonitor
+from sheeprl_tpu.telemetry.tracer import Tracer
+
+pytestmark = pytest.mark.telemetry
+
+
+def _fresh_jit():
+    # A distinct closure per call: every test gets its own compile.
+    def f(x):
+        return (x * 3 + 1).sum()
+
+    return jax.jit(f)
+
+
+def test_compile_events_counted_and_spanned():
+    t = Tracer()
+    prev = tracer_mod.set_current(t)
+    monitor = JaxEventMonitor(warmup_iters=100)
+    monitor.attach()
+    try:
+        _fresh_jit()(jnp.ones((8,)))
+        assert monitor.counters.get("compiles", 0) >= 1
+        assert monitor.counters.get("compile_secs", 0) > 0
+        assert monitor.counters.get("traces", 0) >= 1
+        assert any(s.name == "xla_compile" and s.category == "compile" for s in t.spans())
+    finally:
+        monitor.detach()
+        tracer_mod.set_current(prev)
+
+
+def test_recompile_after_warmup_warns_and_counts():
+    monitor = JaxEventMonitor(warmup_iters=2)
+    monitor.attach()
+    try:
+        f = _fresh_jit()
+        f(jnp.ones((4,)))  # warmup compile
+        monitor.advance()
+        monitor.advance()  # warmup watermark armed at iteration 2
+        monitor.advance()  # past warmup, no new compiles: silent
+        f(jnp.ones((6,)))  # shape change -> retrace -> fresh backend compile
+        with pytest.warns(RuntimeWarning, match="recompile"):
+            monitor.advance()
+        assert monitor.counters.get("recompiles_after_warmup", 0) >= 1
+    finally:
+        monitor.detach()
+
+
+def test_no_warning_during_warmup():
+    monitor = JaxEventMonitor(warmup_iters=10)
+    monitor.attach()
+    try:
+        f = _fresh_jit()
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", RuntimeWarning)
+            f(jnp.ones((3,)))
+            monitor.advance()
+            f(jnp.ones((5,)))  # recompiles, but still inside warmup
+            monitor.advance()
+    finally:
+        monitor.detach()
+
+
+def test_detached_monitor_stops_counting():
+    monitor = JaxEventMonitor()
+    monitor.attach()
+    monitor.detach()
+    before = dict(monitor.counters)
+    _fresh_jit()(jnp.ones((7,)))
+    assert monitor.counters == before
+
+
+def test_memory_gauges_cpu_safe():
+    # CPU devices expose no memory_stats (or None): must degrade to {} keys
+    # being absent rather than raising.
+    gauges = JaxEventMonitor.memory_gauges(jax.devices()[0])
+    assert isinstance(gauges, dict)

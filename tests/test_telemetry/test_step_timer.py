@@ -1,0 +1,96 @@
+"""StepTimer unit tests: interval bounding, coalesced fetch, pending-queue
+bounding, and phase-timer crediting."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from sheeprl_tpu.telemetry import tracer as tracer_mod
+from sheeprl_tpu.telemetry.step_timer import StepTimer
+from sheeprl_tpu.telemetry.tracer import Tracer
+from sheeprl_tpu.utils.timer import timer
+
+pytestmark = pytest.mark.telemetry
+
+
+@pytest.fixture
+def live_tracer():
+    t = Tracer()
+    prev = tracer_mod.set_current(t)
+    yield t
+    tracer_mod.set_current(prev)
+
+
+def test_flush_returns_all_pended_metrics_once(live_tracer):
+    f = jax.jit(lambda x: x + 1)
+    st = StepTimer(name="train")
+    x = jnp.zeros((4,))
+    for i in range(5):
+        with st.step():
+            x = f(x)
+        st.pend(x, {"loss": x.sum()})
+    fetched = st.flush()
+    assert len(fetched) == 5
+    # Host values, oldest first.
+    assert [float(m["loss"]) for m in fetched] == [4.0, 8.0, 12.0, 16.0, 20.0]
+    assert st.steps == 5
+    assert st.flushes == 1
+    # The queue drained: a second flush fetches nothing and re-blocks nothing.
+    assert st.flush() == []
+
+
+def test_one_block_and_one_fetch_per_interval(live_tracer):
+    f = jax.jit(lambda x: x * 2)
+    st = StepTimer(name="train")
+    x = jnp.ones((2,))
+    for _ in range(3):
+        with st.step():
+            x = f(x)
+        st.pend(x, {"m": x.sum()})
+    st.flush()
+    names = [s.name for s in live_tracer.spans()]
+    assert names.count("train/bound") == 1
+    assert names.count("train/metric_fetch") == 1
+    assert names.count("train/dispatch") == 3
+    # The fetch is accounted in the transfer counters.
+    counters = live_tracer.counters()
+    assert counters["device_get_calls"] == 1.0
+    assert counters["device_get_bytes"] > 0
+
+
+def test_interval_bound_credits_phase_timer(live_tracer):
+    """The bounding block's wall-clock lands in the phase timer key, so
+    timer.compute() totals stay truthful with async dispatch."""
+    timer.reset()
+    was_disabled = timer.disabled
+    timer.disabled = False
+    try:
+        f = jax.jit(lambda x: x + 1)
+        st = StepTimer(name="train", timer_key="Time/train_time")
+        with st.step():
+            y = f(jnp.zeros((2,)))
+        st.pend(y)
+        st.flush()
+        assert timer.compute().get("Time/train_time", 0.0) > 0.0
+        assert st.bound_s > 0.0
+    finally:
+        timer.disabled = was_disabled
+        timer.reset()
+
+
+def test_pending_queue_is_bounded():
+    st = StepTimer(name="train", max_pending=3)
+    for i in range(7):
+        st.pend(None, {"i": i})
+    assert st.dropped_metrics == 4
+    fetched = st.flush()
+    assert [m["i"] for m in fetched] == [4, 5, 6]
+
+
+def test_metrics_disabled_path_keeps_token_only():
+    f = jax.jit(lambda x: x + 1)
+    st = StepTimer(name="train")
+    y = f(jnp.zeros((2,)))
+    st.pend(y, None)
+    assert st.flush() == []
+    assert st.flushes == 1

@@ -1,0 +1,68 @@
+"""utils/timer reentrancy + tracer integration: nested/concurrent use of the
+same key accumulates instead of raising, stops emit spans, and timer.add
+credits externally-measured seconds."""
+
+import pytest
+
+from sheeprl_tpu.telemetry import tracer as tracer_mod
+from sheeprl_tpu.telemetry.tracer import Tracer
+from sheeprl_tpu.utils.timer import TimerError, timer
+
+pytestmark = pytest.mark.telemetry
+
+
+@pytest.fixture(autouse=True)
+def _clean_timer():
+    was_disabled = timer.disabled
+    timer.disabled = False
+    timer.reset()
+    yield
+    timer.disabled = was_disabled
+    timer.reset()
+
+
+def test_nested_same_key_is_reentrant():
+    # The seed's process-global single start slot raised TimerError here.
+    with timer("phase"):
+        with timer("phase"):
+            pass
+    computed = timer.compute()
+    assert computed["phase"] > 0.0
+    # Both enters accumulated (outer covers inner, so total > outer alone is
+    # not assertable; what matters is no TimerError and a clean start table).
+    assert timer._start_times == {}
+
+
+def test_stop_without_start_still_raises():
+    with pytest.raises(TimerError):
+        timer("never-started").stop()
+
+
+def test_stop_emits_span_into_current_tracer():
+    t = Tracer()
+    prev = tracer_mod.set_current(t)
+    try:
+        with timer("Time/env_interaction_time"):
+            pass
+        spans = t.spans()
+    finally:
+        tracer_mod.set_current(prev)
+    assert len(spans) == 1
+    assert spans[0].name == "Time/env_interaction_time"
+    assert spans[0].category == "timer"
+    # compute() and the trace agree on the measured region.
+    assert abs(timer.compute()["Time/env_interaction_time"] - spans[0].duration_s) < 1e-9
+
+
+def test_add_credits_seconds():
+    timer.add("Time/train_time", 0.5)
+    timer.add("Time/train_time", 0.25)
+    assert timer.compute()["Time/train_time"] == pytest.approx(0.75)
+
+
+def test_disabled_timer_is_inert():
+    timer.disabled = True
+    with timer("phase"):
+        pass
+    timer.add("phase", 1.0)
+    assert timer.compute() == {}

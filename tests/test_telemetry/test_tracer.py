@@ -1,0 +1,96 @@
+"""Tracer unit tests: ring-buffer eviction, Chrome-trace export structure,
+JSONL export, the process-wide current-tracer switch."""
+
+import json
+
+import pytest
+
+from sheeprl_tpu.telemetry import tracer as tracer_mod
+from sheeprl_tpu.telemetry.tracer import Tracer
+
+pytestmark = pytest.mark.telemetry
+
+
+def test_ring_buffer_eviction_counts_drops():
+    t = Tracer(capacity=4)
+    for i in range(10):
+        t.add_span(f"s{i}", "host", float(i), 0.5)
+    spans = t.spans()
+    assert len(spans) == 4
+    # Oldest evicted: the trailing window survives.
+    assert [s.name for s in spans] == ["s6", "s7", "s8", "s9"]
+    assert t.dropped == 6
+
+
+def test_span_context_manager_records_duration():
+    t = Tracer()
+    with t.span("work", "host", detail="x"):
+        pass
+    (s,) = t.spans()
+    assert s.name == "work"
+    assert s.category == "host"
+    assert s.duration_s >= 0.0
+    assert s.args == {"detail": "x"}
+
+
+def test_disabled_tracer_records_nothing():
+    t = Tracer(enabled=False)
+    with t.span("work"):
+        pass
+    t.add_span("x", "host", 0.0, 1.0)
+    t.count("c", 5)
+    assert t.spans() == []
+    assert t.counters() == {}
+
+
+def test_chrome_trace_golden_structure(tmp_path):
+    """The export must be loadable trace-event JSON: a traceEvents list whose
+    complete events carry name/ph/ts/dur/pid/tid (what chrome://tracing and
+    Perfetto's legacy importer require structurally)."""
+    t = Tracer()
+    t.add_span("rollout", "timer", 1.0, 0.25, {"n": 1})
+    t.add_span("train", "timer", 1.25, 0.75)
+    t.count("device_get_bytes", 123.0)
+    path = t.export_chrome(str(tmp_path / "trace.json"))
+
+    with open(path) as fp:
+        doc = json.load(fp)
+    assert set(doc) == {"traceEvents", "displayTimeUnit"}
+    events = doc["traceEvents"]
+    assert isinstance(events, list) and events
+    complete = [e for e in events if e["ph"] == "X"]
+    assert {e["name"] for e in complete} == {"rollout", "train"}
+    for e in complete:
+        assert {"name", "cat", "ph", "ts", "dur", "pid", "tid"} <= set(e)
+        assert isinstance(e["ts"], float) and isinstance(e["dur"], float)
+        assert e["dur"] > 0
+    # Same-category spans share a track; metadata names it.
+    assert len({e["tid"] for e in complete}) == 1
+    meta = [e for e in events if e["ph"] == "M"]
+    assert any(e["args"]["name"] == "timer" for e in meta)
+    counters = [e for e in events if e["ph"] == "C"]
+    assert any(e["name"] == "device_get_bytes" and e["args"]["value"] == 123.0 for e in counters)
+
+
+def test_jsonl_lines_parse():
+    t = Tracer()
+    t.add_span("a", "host", 0.0, 0.1)
+    t.count("k", 2.0)
+    lines = [json.loads(line) for line in t.iter_jsonl()]
+    kinds = {rec["type"] for rec in lines}
+    assert kinds == {"span", "counter"}
+
+
+def test_current_tracer_switch_and_restore():
+    before = tracer_mod.current()
+    live = Tracer()
+    prev = tracer_mod.set_current(live)
+    try:
+        assert tracer_mod.current() is live
+    finally:
+        tracer_mod.set_current(prev)
+    assert tracer_mod.current() is before
+    # None restores the shared disabled tracer
+    p = tracer_mod.set_current(None)
+    assert not tracer_mod.current().enabled
+    tracer_mod.set_current(p)
