@@ -215,16 +215,29 @@ def bench_a2c():
     )
 
 
-def bench_sac():
+def bench_sac(device_buffer: bool = False):
     # README.md:139-140 — 65,536 steps in 320.21 s. Off-policy: the player
     # never blocks on the weight mirror (fabric.player_sync=async,
     # core/player.py) — SAC trains every env step, so a blocking mirror
     # would serialize the interaction loop on the device link.
-    return _timeboxed(
-        "sac_env_steps_per_sec", "sac_benchmarks", 65536, 65536 / 320.21,
+    extra = ["fabric.player_sync=async"]
+    suffix = ""
+    if device_buffer:
+        # A/B leg: device-resident replay ring + fused K-step scan
+        # (data/device_buffer.py) vs the host sample + per-call transfer
+        # above. Same workload, same baseline, so vs_baseline is directly
+        # comparable between the two rows.
+        extra += ["buffer.device=true", "algo.fused_train_steps=8"]
+        suffix = "_devbuf"
+    result = _timeboxed(
+        f"sac{suffix}_env_steps_per_sec", "sac_benchmarks", 65536, 65536 / 320.21,
         learning_starts=100, warmup_steps=1024, start_steps=4096,
-        extra=("fabric.player_sync=async",),
+        extra=tuple(extra),
     )
+    if device_buffer:
+        result["buffer_device"] = True
+        result["fused_train_steps"] = 8
+    return result
 
 
 def _accel_precision() -> str:
@@ -236,18 +249,29 @@ def _accel_precision() -> str:
     return "bf16-mixed" if jax.default_backend() != "cpu" else "32-true"
 
 
-def _bench_dreamer(version: str, baseline_seconds: float):
+def _bench_dreamer(version: str, baseline_seconds: float, device_buffer: bool = False):
     # Off-policy: async weight mirror (see bench_sac). Precision is passed
     # explicitly so the result JSON records the semantics the number was
     # measured under.
-    return _timeboxed(
-        f"dreamer_v{version}_env_steps_per_sec",
+    extra = ["fabric.player_sync=async", f"fabric.precision={_accel_precision()}"]
+    suffix = ""
+    if device_buffer:
+        # A/B leg (see bench_sac): HBM replay ring + fused K-step scan vs
+        # host buffer + ReplayInfeed.
+        extra += ["buffer.device=true", "algo.fused_train_steps=8"]
+        suffix = "_devbuf"
+    result = _timeboxed(
+        f"dreamer_v{version}{suffix}_env_steps_per_sec",
         f"dreamer_v{version}_benchmarks",
         16384,
         16384 / baseline_seconds,
         learning_starts=1024,
-        extra=("fabric.player_sync=async", f"fabric.precision={_accel_precision()}"),
+        extra=tuple(extra),
     )
+    if device_buffer:
+        result["buffer_device"] = True
+        result["fused_train_steps"] = 8
+    return result
 
 
 def bench_dreamer_v1():
@@ -337,6 +361,7 @@ def main() -> None:
     sheeprl_tpu.register_all()
     result = {
         "dreamer_v3": bench_dreamer_v3,
+        "dreamer_v3_devbuf": lambda: _bench_dreamer("3", 1589.30, device_buffer=True),
         "dreamer_v3_S": bench_dreamer_v3_S,
         "dreamer_v3_S_b32": lambda: bench_dreamer_v3_S(batch=32),
         "dreamer_v3_S_b64": lambda: bench_dreamer_v3_S(batch=64),
@@ -345,6 +370,7 @@ def main() -> None:
         "ppo": bench_ppo,
         "a2c": bench_a2c,
         "sac": bench_sac,
+        "sac_devbuf": lambda: bench_sac(device_buffer=True),
     }[which]()
     result["backend"] = jax.default_backend()
     print(json.dumps(result))

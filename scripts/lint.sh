@@ -27,4 +27,9 @@ python -m sheeprl_tpu.analysis sheeprl_tpu/ || rc=1
 echo "== graftlint (telemetry, no baseline) =="
 python -m sheeprl_tpu.analysis --no-baseline sheeprl_tpu/telemetry/ || rc=1
 
+# The data package sits on the rollout/train hot path (replay buffers,
+# infeed, the device-resident ring): same zero-findings bar, no baseline.
+echo "== graftlint (data, no baseline) =="
+python -m sheeprl_tpu.analysis --no-baseline sheeprl_tpu/data/ || rc=1
+
 exit "$rc"

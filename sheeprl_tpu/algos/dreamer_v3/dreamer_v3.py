@@ -44,6 +44,7 @@ from sheeprl_tpu.core.mesh import DATA_AXIS
 from sheeprl_tpu.core.player import PlayerPlacement
 from sheeprl_tpu.data.infeed import ReplayInfeed
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.data.device_buffer import DeviceReplayRing
 from sheeprl_tpu.core.runtime import DispatchThrottle
 from sheeprl_tpu.registry import register_algorithm
 from sheeprl_tpu.utils.checkpoint import load_checkpoint, restore_opt_state, save_checkpoint
@@ -72,8 +73,14 @@ def _make_optimizer(optim_cfg: Dict[str, Any], clip: float) -> optax.GradientTra
     return inner
 
 
-def make_train_step(agent: DV3Agent, txs: Dict[str, optax.GradientTransformation], cfg: Dict[str, Any], mesh):
-    """Build the jitted single-gradient-step function over a [T, B] batch."""
+def make_step_core(agent: DV3Agent, txs: Dict[str, optax.GradientTransformation], cfg: Dict[str, Any], mesh):
+    """Build the PURE single-gradient-step function over a [T, B] batch.
+
+    Not jitted and no internal key split: :func:`make_train_step` wraps it
+    into the classic one-dispatch-per-step jit, and
+    :func:`make_fused_train_step` scans it over K on-device-sampled batches
+    inside one jitted call. Both share this trace so they optimise the same
+    math."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     wm_cfg = cfg.algo.world_model
@@ -191,9 +198,7 @@ def make_train_step(agent: DV3Agent, txs: Dict[str, optax.GradientTransformation
         }
         return rec_loss, aux
 
-    @partial(jax.jit, donate_argnums=(0, 1, 2))
-    def train_step(state, opt_states, moments_state, data, key, tau):
-        next_key, key = jax.random.split(key)
+    def step_core(state, opt_states, moments_state, data, key, tau):
         T, B = data["rewards"].shape[:2]
         data = jax.lax.with_sharding_constraint(data, {k: batch_sharding for k in data})
         batch_obs = {k: data[k] / 255.0 - 0.5 for k in cnn_keys}
@@ -355,9 +360,78 @@ def make_train_step(agent: DV3Agent, txs: Dict[str, optax.GradientTransformation
             "Grads/actor": optax.global_norm(actor_grads),
             "Grads/critic": optax.global_norm(critic_grads),
         }
-        return state, opt_states, img_aux["moments"], metrics, next_key
+        return state, opt_states, img_aux["moments"], metrics
+
+    return step_core
+
+
+def make_train_step(agent: DV3Agent, txs: Dict[str, optax.GradientTransformation], cfg: Dict[str, Any], mesh):
+    """Build the jitted single-gradient-step function over a [T, B] batch."""
+    step_core = make_step_core(agent, txs, cfg, mesh)
+
+    @partial(jax.jit, donate_argnums=(0, 1, 2))
+    def train_step(state, opt_states, moments_state, data, key, tau):
+        next_key, key = jax.random.split(key)
+        state, opt_states, moments_state, metrics = step_core(
+            state, opt_states, moments_state, data, key, tau
+        )
+        return state, opt_states, moments_state, metrics, next_key
 
     return train_step
+
+
+def make_fused_train_step(
+    agent: DV3Agent,
+    txs: Dict[str, optax.GradientTransformation],
+    cfg: Dict[str, Any],
+    mesh,
+    sample_fn,
+):
+    """Fuse K gradient steps (sampling included) into ONE jitted lax.scan.
+
+    ``sample_fn`` is a :meth:`DeviceReplayRing.make_sample_fn` pure sampler:
+    each scan iteration draws its own batch from the device-resident ring
+    with the JAX PRNG, so the host ships zero batch bytes and pays one
+    dispatch for the whole bucket. K is carried by ``taus``'s length (the
+    per-step target-EMA coefficients the host already computes), so each
+    power-of-two bucket compiles exactly once.
+    """
+    step_core = make_step_core(agent, txs, cfg, mesh)
+
+    @partial(jax.jit, donate_argnums=(0, 1, 2))
+    def fused_train_step(state, opt_states, moments_state, ring_state, key, taus):
+        next_key, key = jax.random.split(key)
+        step_keys = jax.random.split(key, taus.shape[0])
+
+        def body(carry, x):
+            state, opt_states, moments_state = carry
+            k, tau = x
+            k_sample, k_core = jax.random.split(k)
+            data = sample_fn(ring_state, k_sample)
+            state, opt_states, moments_state, metrics = step_core(
+                state, opt_states, moments_state, data, k_core, tau
+            )
+            return (state, opt_states, moments_state), metrics
+
+        (state, opt_states, moments_state), metrics = jax.lax.scan(
+            body, (state, opt_states, moments_state), (step_keys, taus)
+        )
+        metrics = jax.tree_util.tree_map(lambda m: m.mean(0), metrics)
+        return state, opt_states, moments_state, metrics, next_key
+
+    return fused_train_step
+
+
+def _target_update_taus(cumulative: int, k: int, freq: int, tau: float) -> np.ndarray:
+    """Per-step target-critic EMA coefficients for a K-step fused bucket,
+    reproducing the host loop's cadence: hard copy (1.0) on the very first
+    gradient step, ``tau`` every ``freq`` cumulative steps, else 0."""
+    taus = np.zeros(k, np.float32)
+    for i in range(k):
+        c = cumulative + i
+        if c % freq == 0:
+            taus[i] = 1.0 if c == 0 else tau
+    return taus
 
 
 @register_algorithm()
@@ -505,6 +579,32 @@ def main(runtime, cfg: Dict[str, Any]):
 
     train_fn = make_train_step(agent, txs, cfg, mesh)
 
+    # Device-resident replay ring (data/device_buffer.py): rollout rows are
+    # mirrored into HBM and the fused train step samples them inside its own
+    # jit — zero per-gradient-step host transfers. The host buffer stays
+    # authoritative (checkpointing, fallback when the ring won't fit HBM).
+    use_device_buffer = bool(cfg.buffer.get("device", False))
+    fused_train_steps = max(int(cfg.algo.get("fused_train_steps", 1)), 1)
+    ring = None
+    fused_train_fn = None
+    if use_device_buffer:
+        ring = DeviceReplayRing(
+            buffer_size,
+            cfg.env.num_envs,
+            cnn_keys=tuple(cfg.algo.cnn_keys.encoder),
+            obs_keys=tuple(obs_keys),
+            hbm_fraction=float(cfg.buffer.get("device_hbm_fraction", 0.4)),
+            device=mesh.devices.flat[0],
+        )
+        if state_ckpt is not None and cfg.buffer.checkpoint and state_ckpt.get("rb") is not None:
+            ring.load_host_buffer(rb)
+        ring_sample_fn = ring.make_sample_fn(
+            cfg.algo.per_rank_batch_size,
+            sequence_length=cfg.algo.per_rank_sequence_length,
+            time_major=True,
+        )
+        fused_train_fn = make_fused_train_step(agent, txs, cfg, mesh, ring_sample_fn)
+
     # Async infeed (data/infeed.py): the next train call's sampled batches
     # are copied host->device by a worker thread while envs step, so the
     # pixel-batch H2D never sits on the critical path.
@@ -598,6 +698,8 @@ def main(runtime, cfg: Dict[str, Any]):
 
             step_data["actions"] = actions.reshape((1, cfg.env.num_envs, -1))
             rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            if ring is not None:
+                ring.add(step_data)
 
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 real_actions.reshape(envs.action_space.shape)
@@ -621,6 +723,15 @@ def main(runtime, cfg: Dict[str, Any]):
                     rb.buffer[i]["is_first"][last_inserted_idx] = np.zeros_like(
                         rb.buffer[i]["is_first"][last_inserted_idx]
                     )
+                    if ring is not None:
+                        ring.amend_last(
+                            i,
+                            {
+                                "terminated": np.zeros((1,), np.float32),
+                                "truncated": np.ones((1,), np.float32),
+                                "is_first": np.zeros((1,), np.float32),
+                            },
+                        )
                     step_data["is_first"][:, i] = np.ones_like(step_data["is_first"][:, i])
 
         if cfg.metric.log_level > 0 and "final_info" in infos:
@@ -662,6 +773,8 @@ def main(runtime, cfg: Dict[str, Any]):
             reset_data["rewards"] = step_data["rewards"][:, dones_idxes]
             reset_data["is_first"] = np.zeros_like(reset_data["terminated"])
             rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
+            if ring is not None:
+                ring.add(reset_data, dones_idxes)
 
             step_data["rewards"][:, dones_idxes] = np.zeros_like(reset_data["rewards"])
             step_data["terminated"][:, dones_idxes] = np.zeros_like(step_data["terminated"][:, dones_idxes])
@@ -679,45 +792,88 @@ def main(runtime, cfg: Dict[str, Any]):
             ratio_steps = policy_step - prefill_steps * policy_steps_per_iter
             per_rank_gradient_steps = ratio(ratio_steps / world_size)
             if per_rank_gradient_steps > 0:
-                batches = infeed.take_or_sample(per_rank_gradient_steps)
-                with timer("Time/train_time"):
-                    for i in range(per_rank_gradient_steps):
-                        if (
-                            cumulative_per_rank_gradient_steps
-                            % cfg.algo.critic.per_rank_target_network_update_freq
-                            == 0
-                        ):
-                            tau = 1.0 if cumulative_per_rank_gradient_steps == 0 else cfg.algo.critic.tau
-                        else:
-                            tau = 0.0
-                        batch = batches[i]
-                        with train_timer.step():
-                            agent_state, opt_states, moments_state, train_metrics, train_key = train_fn(
-                                agent_state, opt_states, moments_state, batch, train_key,
-                                np.asarray(tau, np.float32),
+                # Ship this interval's staged rollout rows in ONE donated
+                # write, then (if enough history is device-resident) train
+                # entirely from the ring: no host sampling, no per-step H2D.
+                if ring is not None and ring.active:
+                    ring.flush()
+                use_ring = (
+                    ring is not None
+                    and ring.active
+                    and ring.ready(cfg.algo.per_rank_sequence_length)
+                )
+                if use_ring:
+                    with timer("Time/train_time"):
+                        remaining = per_rank_gradient_steps
+                        while remaining > 0:
+                            # Power-of-two buckets bound the number of fused
+                            # graphs to log2(fused_train_steps).
+                            k = 1 << (min(remaining, fused_train_steps).bit_length() - 1)
+                            taus = _target_update_taus(
+                                cumulative_per_rank_gradient_steps,
+                                k,
+                                cfg.algo.critic.per_rank_target_network_update_freq,
+                                cfg.algo.critic.tau,
                             )
-                        # Feed EVERY gradient step's losses toward the log
-                        # (only sampling the last one under-reports the
-                        # training signal). No sync here: the dispatch stays
-                        # fully async — the StepTimer queues the scalars
-                        # device-side and bounds the interval's wall-clock
-                        # with ONE block at the log-interval flush.
-                        train_timer.pend(
-                            agent_state["world_model"],
-                            train_metrics if keep_train_metrics else None,
+                            with train_timer.step():
+                                agent_state, opt_states, moments_state, train_metrics, train_key = fused_train_fn(
+                                    agent_state, opt_states, moments_state, ring.state,
+                                    train_key, taus,
+                                )
+                            # Mean losses over the bucket (the scan stacks
+                            # them; one tree per dispatch keeps the flush
+                            # cheap).
+                            train_timer.pend(
+                                agent_state["world_model"],
+                                train_metrics if keep_train_metrics else None,
+                            )
+                            dispatch_throttle.add(train_metrics)
+                            cumulative_per_rank_gradient_steps += k
+                            remaining -= k
+                        placement.push(
+                            {"world_model": agent_state["world_model"], "actor": agent_state["actor"]}
                         )
-                        dispatch_throttle.add(train_metrics)
-                        cumulative_per_rank_gradient_steps += 1
-                    # One mirror refresh per train call (the player only acts
-                    # again after the whole gradient-step loop, so this is
-                    # exactly the reference's tied-weights freshness).
-                    placement.push(
-                        {"world_model": agent_state["world_model"], "actor": agent_state["actor"]}
-                    )
-                    train_step_count += world_size
-                # Sample on the main thread (no buffer race); stage the device
-                # copies to overlap the next env-step phase.
-                infeed.stage(per_rank_gradient_steps)
+                        train_step_count += world_size
+                else:
+                    batches = infeed.take_or_sample(per_rank_gradient_steps)
+                    with timer("Time/train_time"):
+                        for i in range(per_rank_gradient_steps):
+                            if (
+                                cumulative_per_rank_gradient_steps
+                                % cfg.algo.critic.per_rank_target_network_update_freq
+                                == 0
+                            ):
+                                tau = 1.0 if cumulative_per_rank_gradient_steps == 0 else cfg.algo.critic.tau
+                            else:
+                                tau = 0.0
+                            batch = batches[i]
+                            with train_timer.step():
+                                agent_state, opt_states, moments_state, train_metrics, train_key = train_fn(
+                                    agent_state, opt_states, moments_state, batch, train_key,
+                                    np.asarray(tau, np.float32),
+                                )
+                            # Feed EVERY gradient step's losses toward the log
+                            # (only sampling the last one under-reports the
+                            # training signal). No sync here: the dispatch stays
+                            # fully async — the StepTimer queues the scalars
+                            # device-side and bounds the interval's wall-clock
+                            # with ONE block at the log-interval flush.
+                            train_timer.pend(
+                                agent_state["world_model"],
+                                train_metrics if keep_train_metrics else None,
+                            )
+                            dispatch_throttle.add(train_metrics)
+                            cumulative_per_rank_gradient_steps += 1
+                        # One mirror refresh per train call (the player only acts
+                        # again after the whole gradient-step loop, so this is
+                        # exactly the reference's tied-weights freshness).
+                        placement.push(
+                            {"world_model": agent_state["world_model"], "actor": agent_state["actor"]}
+                        )
+                        train_step_count += world_size
+                    # Sample on the main thread (no buffer race); stage the device
+                    # copies to overlap the next env-step phase.
+                    infeed.stage(per_rank_gradient_steps)
 
         # -------------------------------------------------------- logging
         should_log = cfg.metric.log_level > 0 and (

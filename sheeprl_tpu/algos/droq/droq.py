@@ -32,6 +32,7 @@ from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.core.mesh import DATA_AXIS
 from sheeprl_tpu.core.player import PlayerPlacement
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.device_buffer import DeviceReplayRing
 from sheeprl_tpu.core.runtime import DispatchThrottle
 from sheeprl_tpu.registry import register_algorithm
 from sheeprl_tpu.utils.checkpoint import load_checkpoint, restore_opt_state, save_checkpoint
@@ -42,11 +43,74 @@ from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import Ratio, save_configs
 
 
+def make_critic_step(agent: DROQAgent, txs: Dict[str, optax.GradientTransformation], cfg: Dict[str, Any]):
+    """Build the pure one-minibatch critic update (scan body) shared by the
+    host-batched and ring-sampled train steps."""
+    gamma = float(cfg.algo.gamma)
+
+    def critic_step(carry, batch):
+        state, qf_opt = carry
+        k_target, k_drop = jax.random.split(batch.pop("_key"))
+
+        # Fixed soft target for this minibatch (reference: droq.py:99-104)
+        next_target = agent.next_target_q_values(
+            state, batch["next_observations"], batch["rewards"], batch["terminated"], gamma, k_target
+        )
+
+        def qf_loss_fn(qf_params):
+            qf_values = agent.q_values(
+                qf_params, batch["observations"], batch["actions"], dropout_key=k_drop
+            )
+            # Per-member MSE against the shared target, summed: identical
+            # gradients to the reference's sequential per-critic steps.
+            return ((qf_values - next_target) ** 2).mean(0).sum()
+
+        qf_l, qf_grads = jax.value_and_grad(qf_loss_fn)(state["qfs"])
+        qf_updates, qf_opt = txs["qf"].update(qf_grads, qf_opt, state["qfs"])
+        state["qfs"] = optax.apply_updates(state["qfs"], qf_updates)
+        # EMA after every critic update (reference: droq.py:117)
+        state["qfs_target"] = agent.target_ema(state["qfs"], state["qfs_target"])
+        return (state, qf_opt), qf_l
+
+    return critic_step
+
+
+def make_actor_alpha_update(agent: DROQAgent, txs: Dict[str, optax.GradientTransformation]):
+    """Build the pure actor+alpha update over one [B, ...] observation batch
+    (reference: droq.py:120-134)."""
+
+    def actor_alpha_update(state, actor_opt_in, alpha_opt_in, observations, k_actor, k_actor_drop):
+        alpha = jnp.exp(state["log_alpha"])
+
+        def actor_loss_fn(actor_params):
+            actions, logprobs = agent.actions_and_log_probs(actor_params, observations, k_actor)
+            qf_values = agent.q_values(
+                state["qfs"], observations, actions, dropout_key=k_actor_drop
+            )
+            mean_qf = jnp.mean(qf_values, axis=-1, keepdims=True)
+            return policy_loss(alpha, logprobs, mean_qf), logprobs
+
+        (actor_l, logprobs), actor_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(state["actor"])
+        actor_updates, actor_opt = txs["actor"].update(actor_grads, actor_opt_in, state["actor"])
+        state["actor"] = optax.apply_updates(state["actor"], actor_updates)
+
+        def alpha_loss_fn(log_alpha):
+            return entropy_loss(log_alpha, logprobs, agent.target_entropy)
+
+        alpha_l, alpha_grads = jax.value_and_grad(alpha_loss_fn)(state["log_alpha"])
+        alpha_updates, alpha_opt = txs["alpha"].update(alpha_grads, alpha_opt_in, state["log_alpha"])
+        state["log_alpha"] = optax.apply_updates(state["log_alpha"], alpha_updates)
+        return state, actor_opt, alpha_opt, actor_l, alpha_l
+
+    return actor_alpha_update
+
+
 def make_train_step(agent: DROQAgent, txs: Dict[str, optax.GradientTransformation], cfg: Dict[str, Any], mesh):
     """Build the jitted (G critic steps + 1 actor step) update."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    gamma = float(cfg.algo.gamma)
+    critic_step = make_critic_step(agent, txs, cfg)
+    actor_alpha_update = make_actor_alpha_update(agent, txs)
     batch_sharding = NamedSharding(mesh, P(None, DATA_AXIS))
     flat_sharding = NamedSharding(mesh, P(DATA_AXIS))
 
@@ -54,30 +118,6 @@ def make_train_step(agent: DROQAgent, txs: Dict[str, optax.GradientTransformatio
     def train_step(state, opt_states, critic_data, actor_data, key):
         """critic_data: dict of [G, B, ...]; actor_data: dict of [B, ...]."""
         next_key, key = jax.random.split(key)
-
-        def critic_step(carry, batch):
-            state, qf_opt = carry
-            k_target, k_drop = jax.random.split(batch.pop("_key"))
-
-            # Fixed soft target for this minibatch (reference: droq.py:99-104)
-            next_target = agent.next_target_q_values(
-                state, batch["next_observations"], batch["rewards"], batch["terminated"], gamma, k_target
-            )
-
-            def qf_loss_fn(qf_params):
-                qf_values = agent.q_values(
-                    qf_params, batch["observations"], batch["actions"], dropout_key=k_drop
-                )
-                # Per-member MSE against the shared target, summed: identical
-                # gradients to the reference's sequential per-critic steps.
-                return ((qf_values - next_target) ** 2).mean(0).sum()
-
-            qf_l, qf_grads = jax.value_and_grad(qf_loss_fn)(state["qfs"])
-            qf_updates, qf_opt = txs["qf"].update(qf_grads, qf_opt, state["qfs"])
-            state["qfs"] = optax.apply_updates(state["qfs"], qf_updates)
-            # EMA after every critic update (reference: droq.py:117)
-            state["qfs_target"] = agent.target_ema(state["qfs"], state["qfs_target"])
-            return (state, qf_opt), qf_l
 
         critic_data = jax.lax.with_sharding_constraint(
             critic_data, {k: batch_sharding for k in critic_data}
@@ -92,29 +132,10 @@ def make_train_step(agent: DROQAgent, txs: Dict[str, optax.GradientTransformatio
             critic_step, (state, opt_states["qf"]), critic_data
         )
 
-        # ----------------------------- actor + alpha (reference: droq.py:120-134)
-        alpha = jnp.exp(state["log_alpha"])
-
-        def actor_loss_fn(actor_params):
-            actions, logprobs = agent.actions_and_log_probs(
-                actor_params, actor_data["observations"], k_actor
-            )
-            qf_values = agent.q_values(
-                state["qfs"], actor_data["observations"], actions, dropout_key=k_actor_drop
-            )
-            mean_qf = jnp.mean(qf_values, axis=-1, keepdims=True)
-            return policy_loss(alpha, logprobs, mean_qf), logprobs
-
-        (actor_l, logprobs), actor_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(state["actor"])
-        actor_updates, actor_opt = txs["actor"].update(actor_grads, opt_states["actor"], state["actor"])
-        state["actor"] = optax.apply_updates(state["actor"], actor_updates)
-
-        def alpha_loss_fn(log_alpha):
-            return entropy_loss(log_alpha, logprobs, agent.target_entropy)
-
-        alpha_l, alpha_grads = jax.value_and_grad(alpha_loss_fn)(state["log_alpha"])
-        alpha_updates, alpha_opt = txs["alpha"].update(alpha_grads, opt_states["alpha"], state["log_alpha"])
-        state["log_alpha"] = optax.apply_updates(state["log_alpha"], alpha_updates)
+        state, actor_opt, alpha_opt, actor_l, alpha_l = actor_alpha_update(
+            state, opt_states["actor"], opt_states["alpha"], actor_data["observations"],
+            k_actor, k_actor_drop,
+        )
 
         opt_states = {"qf": qf_opt, "actor": actor_opt, "alpha": alpha_opt}
         return state, opt_states, {
@@ -124,6 +145,57 @@ def make_train_step(agent: DROQAgent, txs: Dict[str, optax.GradientTransformatio
         }, next_key
 
     return train_step
+
+
+def make_fused_train_step(
+    agent: DROQAgent,
+    txs: Dict[str, optax.GradientTransformation],
+    cfg: Dict[str, Any],
+    mesh,
+    sample_fn,
+):
+    """Build the ring-sampled K-critic-step update: every critic minibatch —
+    and the actor's separate batch — is drawn from the device-resident
+    replay ring inside the jit. ``with_actor`` (static) runs the single
+    actor+alpha update, so the caller enables it only on the LAST bucket of
+    an iteration, preserving the one-actor-step-per-env-step cadence."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    critic_step = make_critic_step(agent, txs, cfg)
+    actor_alpha_update = make_actor_alpha_update(agent, txs)
+    flat_sharding = NamedSharding(mesh, P(DATA_AXIS))
+
+    def _shard(batch):
+        return jax.lax.with_sharding_constraint(batch, {k: flat_sharding for k in batch})
+
+    @partial(jax.jit, donate_argnums=(0, 1), static_argnums=(4, 5))
+    def fused_train_step(state, opt_states, ring_state, key, k_steps, with_actor):
+        next_key, key = jax.random.split(key)
+        k_scan, k_actor_sample, k_actor, k_actor_drop = jax.random.split(key, 4)
+        step_keys = jax.random.split(k_scan, k_steps)
+
+        def body(carry, k):
+            k_sample, k_step = jax.random.split(k)
+            batch = _shard(sample_fn(ring_state, k_sample))
+            batch = dict(batch, _key=k_step)
+            return critic_step(carry, batch)
+
+        (state, qf_opt), qf_losses = jax.lax.scan(body, (state, opt_states["qf"]), step_keys)
+        metrics = {"value_loss": qf_losses.mean()}
+        if with_actor:
+            actor_batch = _shard(sample_fn(ring_state, k_actor_sample))
+            state, actor_opt, alpha_opt, actor_l, alpha_l = actor_alpha_update(
+                state, opt_states["actor"], opt_states["alpha"], actor_batch["observations"],
+                k_actor, k_actor_drop,
+            )
+            opt_states = {"qf": qf_opt, "actor": actor_opt, "alpha": alpha_opt}
+            metrics["policy_loss"] = actor_l
+            metrics["alpha_loss"] = alpha_l
+        else:
+            opt_states = {"qf": qf_opt, "actor": opt_states["actor"], "alpha": opt_states["alpha"]}
+        return state, opt_states, metrics, next_key
+
+    return fused_train_step
 
 
 @register_algorithm()
@@ -255,6 +327,32 @@ def main(runtime, cfg: Dict[str, Any]):
     player_fn = jax.jit(_player)
     train_fn = make_train_step(agent, txs, cfg, mesh)
 
+    # Device-resident replay ring (data/device_buffer.py): transitions are
+    # mirrored into HBM and sampled inside the fused train jit — the host
+    # [G*B] critic sample + transfer drop out of the hot path. Falls back
+    # to the host buffer when the ring won't fit the HBM budget.
+    use_device_buffer = bool(cfg.buffer.get("device", False))
+    fused_train_steps = max(int(cfg.algo.get("fused_train_steps", 1)), 1)
+    ring = None
+    fused_train_fn = None
+    ring_span = 1 + int(bool(cfg.buffer.sample_next_obs))
+    if use_device_buffer:
+        ring = DeviceReplayRing(
+            buffer_size,
+            cfg.env.num_envs,
+            obs_keys=("observations",),
+            hbm_fraction=float(cfg.buffer.get("device_hbm_fraction", 0.4)),
+            device=mesh.devices.flat[0],
+        )
+        if state_ckpt is not None and cfg.buffer.checkpoint and state_ckpt.get("rb") is not None:
+            ring.load_host_buffer(rb)
+        ring_sample_fn = ring.make_sample_fn(
+            cfg.algo.per_rank_batch_size,
+            sequence_length=1,
+            sample_next_obs=bool(cfg.buffer.sample_next_obs),
+        )
+        fused_train_fn = make_fused_train_step(agent, txs, cfg, mesh, ring_sample_fn)
+
     # Latency-aware player placement (core/player.py); off-policy: honors
     # fabric.player_sync=async.
     placement = PlayerPlacement.resolve(cfg, mesh.devices.flat[0], params=agent_state["actor"])
@@ -321,47 +419,77 @@ def main(runtime, cfg: Dict[str, Any]):
             step_data["next_observations"] = real_next_obs_cat[np.newaxis]
         step_data["rewards"] = rewards[np.newaxis].astype(np.float32)
         rb.add(step_data, validate_args=cfg.buffer.validate_args)
+        if ring is not None:
+            ring.add(step_data)
 
         obs = next_obs
 
         if iter_num >= learning_starts:
             per_rank_gradient_steps = ratio((policy_step - prefill_steps + policy_steps_per_iter) / world_size)
             if per_rank_gradient_steps > 0:
-                # One big critic sample + one separate actor sample
-                # (reference: droq.py:44-94).
-                critic_sample = rb.sample_tensors(
-                    batch_size=per_rank_gradient_steps * cfg.algo.per_rank_batch_size,
-                    sample_next_obs=cfg.buffer.sample_next_obs,
-                )
-                critic_data = {
-                    k: np.asarray(v)
-                    .astype(np.float32)
-                    .reshape(per_rank_gradient_steps, cfg.algo.per_rank_batch_size, *np.asarray(v).shape[2:])
-                    for k, v in critic_sample.items()
-                }
-                actor_sample = rb.sample_tensors(
-                    batch_size=cfg.algo.per_rank_batch_size,
-                    sample_next_obs=cfg.buffer.sample_next_obs,
-                )
-                actor_data = {
-                    k: np.asarray(v).astype(np.float32).reshape(cfg.algo.per_rank_batch_size, *np.asarray(v).shape[2:])
-                    for k, v in actor_sample.items()
-                }
-                with timer("Time/train_time"):
-                    with train_timer.step():
-                        agent_state, opt_states, train_metrics, train_key = train_fn(
-                            agent_state, opt_states, critic_data, actor_data, train_key
-                        )
-                    # No sync here: the StepTimer queues the loss scalars
-                    # device-side and bounds the interval with ONE block at
-                    # the log-interval flush.
-                    train_timer.pend(
-                        agent_state["actor"], train_metrics if keep_train_metrics else None
+                if ring is not None and ring.active:
+                    ring.flush()
+                use_ring = ring is not None and ring.active and ring.ready(ring_span)
+                if use_ring:
+                    with timer("Time/train_time"):
+                        remaining = per_rank_gradient_steps
+                        while remaining > 0:
+                            # Power-of-two buckets bound the fused graphs to
+                            # log2(fused_train_steps) variants; the actor
+                            # (trained once per env step in the reference)
+                            # rides only on the LAST bucket.
+                            k = 1 << (min(remaining, fused_train_steps).bit_length() - 1)
+                            with_actor = remaining - k == 0
+                            with train_timer.step():
+                                agent_state, opt_states, train_metrics, train_key = fused_train_fn(
+                                    agent_state, opt_states, ring.state, train_key, k, with_actor
+                                )
+                            train_timer.pend(
+                                agent_state["actor"], train_metrics if keep_train_metrics else None
+                            )
+                            dispatch_throttle.add(train_metrics)
+                            cumulative_per_rank_gradient_steps += k
+                            remaining -= k
+                        placement.push(agent_state["actor"])
+                    train_step_count += world_size
+                else:
+                    # One big critic sample + one separate actor sample
+                    # (reference: droq.py:44-94).
+                    critic_sample = rb.sample_tensors(
+                        batch_size=per_rank_gradient_steps * cfg.algo.per_rank_batch_size,
+                        sample_next_obs=cfg.buffer.sample_next_obs,
                     )
-                    dispatch_throttle.add(train_metrics)
-                    placement.push(agent_state["actor"])
-                    cumulative_per_rank_gradient_steps += per_rank_gradient_steps
-                train_step_count += world_size
+                    critic_data = {
+                        k: np.asarray(v)
+                        .astype(np.float32)
+                        .reshape(per_rank_gradient_steps, cfg.algo.per_rank_batch_size, *np.asarray(v).shape[2:])
+                        for k, v in critic_sample.items()
+                    }
+                    actor_sample = rb.sample_tensors(
+                        batch_size=cfg.algo.per_rank_batch_size,
+                        sample_next_obs=cfg.buffer.sample_next_obs,
+                    )
+                    actor_data = {
+                        k: np.asarray(v)
+                        .astype(np.float32)
+                        .reshape(cfg.algo.per_rank_batch_size, *np.asarray(v).shape[2:])
+                        for k, v in actor_sample.items()
+                    }
+                    with timer("Time/train_time"):
+                        with train_timer.step():
+                            agent_state, opt_states, train_metrics, train_key = train_fn(
+                                agent_state, opt_states, critic_data, actor_data, train_key
+                            )
+                        # No sync here: the StepTimer queues the loss scalars
+                        # device-side and bounds the interval with ONE block at
+                        # the log-interval flush.
+                        train_timer.pend(
+                            agent_state["actor"], train_metrics if keep_train_metrics else None
+                        )
+                        dispatch_throttle.add(train_metrics)
+                        placement.push(agent_state["actor"])
+                        cumulative_per_rank_gradient_steps += per_rank_gradient_steps
+                    train_step_count += world_size
 
         should_log = cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
@@ -373,8 +501,11 @@ def main(runtime, cfg: Dict[str, Any]):
             if aggregator and not aggregator.disabled:
                 for tm in fetched_train_metrics:
                     aggregator.update("Loss/value_loss", tm["value_loss"])
-                    aggregator.update("Loss/policy_loss", tm["policy_loss"])
-                    aggregator.update("Loss/alpha_loss", tm["alpha_loss"])
+                    # Ring-path buckets without the actor step carry no
+                    # policy/alpha losses.
+                    if "policy_loss" in tm:
+                        aggregator.update("Loss/policy_loss", tm["policy_loss"])
+                        aggregator.update("Loss/alpha_loss", tm["alpha_loss"])
                 # Collective when sync_on_compute is on: every rank joins;
                 # only rank 0 (the only rank with a logger) writes.
                 aggregator.log_and_reset(logger, policy_step)

@@ -35,6 +35,7 @@ from sheeprl_tpu.config.instantiate import instantiate, locate
 from sheeprl_tpu.core.mesh import DATA_AXIS
 from sheeprl_tpu.core.player import PlayerPlacement
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.device_buffer import DeviceReplayRing
 from sheeprl_tpu.core.runtime import DispatchThrottle
 from sheeprl_tpu.registry import register_algorithm
 from sheeprl_tpu.utils.checkpoint import load_checkpoint, restore_opt_state, save_checkpoint
@@ -51,11 +52,63 @@ def _make_optimizer(optim_cfg: Dict[str, Any]) -> optax.GradientTransformation:
     return locate(target)(**optim_cfg)
 
 
+def make_gradient_step(agent: SACAgent, txs: Dict[str, optax.GradientTransformation], cfg: Dict[str, Any]):
+    """Build the pure one-minibatch update ``gradient_step(carry, batch,
+    tau_eff)`` shared by the host-batched and ring-sampled train steps."""
+    gamma = float(cfg.algo.gamma)
+
+    def gradient_step(carry, batch, tau_eff):
+        state, opt_states = carry
+        k1, k2 = jax.random.split(batch.pop("_key"))
+
+        # --- critic update (reference: sac.py:45-53)
+        next_target = agent.next_target_q_values(
+            state, batch["next_observations"], batch["rewards"], batch["terminated"], gamma, k1
+        )
+
+        def qf_loss_fn(qf_params):
+            qf_values = agent.q_values(qf_params, batch["observations"], batch["actions"])
+            return critic_loss(qf_values, next_target, agent.num_critics)
+
+        qf_l, qf_grads = jax.value_and_grad(qf_loss_fn)(state["qfs"])
+        qf_updates, qf_opt = txs["qf"].update(qf_grads, opt_states["qf"], state["qfs"])
+        state["qfs"] = optax.apply_updates(state["qfs"], qf_updates)
+
+        # --- target EMA (reference: sac.py:56-57)
+        state["qfs_target"] = agent.target_ema(state["qfs"], state["qfs_target"], tau_eff)
+
+        # --- actor update (reference: sac.py:59-66)
+        alpha = jnp.exp(state["log_alpha"])
+
+        def actor_loss_fn(actor_params):
+            actions, logprobs = agent.actions_and_log_probs(actor_params, batch["observations"], k2)
+            qf_values = agent.q_values(state["qfs"], batch["observations"], actions)
+            min_qf = jnp.min(qf_values, axis=-1, keepdims=True)
+            return policy_loss(alpha, logprobs, min_qf), logprobs
+
+        (actor_l, logprobs), actor_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(state["actor"])
+        actor_updates, actor_opt = txs["actor"].update(actor_grads, opt_states["actor"], state["actor"])
+        state["actor"] = optax.apply_updates(state["actor"], actor_updates)
+
+        # --- alpha update (reference: sac.py:68-74)
+        def alpha_loss_fn(log_alpha):
+            return entropy_loss(log_alpha, logprobs, agent.target_entropy)
+
+        alpha_l, alpha_grads = jax.value_and_grad(alpha_loss_fn)(state["log_alpha"])
+        alpha_updates, alpha_opt = txs["alpha"].update(alpha_grads, opt_states["alpha"], state["log_alpha"])
+        state["log_alpha"] = optax.apply_updates(state["log_alpha"], alpha_updates)
+
+        opt_states = {"qf": qf_opt, "actor": actor_opt, "alpha": alpha_opt}
+        return (state, opt_states), jnp.stack([qf_l, actor_l, alpha_l])
+
+    return gradient_step
+
+
 def make_train_step(agent: SACAgent, txs: Dict[str, optax.GradientTransformation], cfg: Dict[str, Any], mesh):
     """Build the jitted G-gradient-steps update."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    gamma = float(cfg.algo.gamma)
+    gradient_step = make_gradient_step(agent, txs, cfg)
     batch_sharding = NamedSharding(mesh, P(None, DATA_AXIS))
 
     @partial(jax.jit, donate_argnums=(0, 1))
@@ -64,59 +117,53 @@ def make_train_step(agent: SACAgent, txs: Dict[str, optax.GradientTransformation
         Returns the split-off next key so the caller never runs an eager
         (host-blocking) split between calls — the key stays device-resident."""
         next_key, key = jax.random.split(key)
-
-        def gradient_step(carry, batch):
-            state, opt_states = carry
-            k1, k2 = jax.random.split(batch.pop("_key"))
-
-            # --- critic update (reference: sac.py:45-53)
-            next_target = agent.next_target_q_values(
-                state, batch["next_observations"], batch["rewards"], batch["terminated"], gamma, k1
-            )
-
-            def qf_loss_fn(qf_params):
-                qf_values = agent.q_values(qf_params, batch["observations"], batch["actions"])
-                return critic_loss(qf_values, next_target, agent.num_critics)
-
-            qf_l, qf_grads = jax.value_and_grad(qf_loss_fn)(state["qfs"])
-            qf_updates, qf_opt = txs["qf"].update(qf_grads, opt_states["qf"], state["qfs"])
-            state["qfs"] = optax.apply_updates(state["qfs"], qf_updates)
-
-            # --- target EMA (reference: sac.py:56-57)
-            state["qfs_target"] = agent.target_ema(state["qfs"], state["qfs_target"], tau_eff)
-
-            # --- actor update (reference: sac.py:59-66)
-            alpha = jnp.exp(state["log_alpha"])
-
-            def actor_loss_fn(actor_params):
-                actions, logprobs = agent.actions_and_log_probs(actor_params, batch["observations"], k2)
-                qf_values = agent.q_values(state["qfs"], batch["observations"], actions)
-                min_qf = jnp.min(qf_values, axis=-1, keepdims=True)
-                return policy_loss(alpha, logprobs, min_qf), logprobs
-
-            (actor_l, logprobs), actor_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(state["actor"])
-            actor_updates, actor_opt = txs["actor"].update(actor_grads, opt_states["actor"], state["actor"])
-            state["actor"] = optax.apply_updates(state["actor"], actor_updates)
-
-            # --- alpha update (reference: sac.py:68-74)
-            def alpha_loss_fn(log_alpha):
-                return entropy_loss(log_alpha, logprobs, agent.target_entropy)
-
-            alpha_l, alpha_grads = jax.value_and_grad(alpha_loss_fn)(state["log_alpha"])
-            alpha_updates, alpha_opt = txs["alpha"].update(alpha_grads, opt_states["alpha"], state["log_alpha"])
-            state["log_alpha"] = optax.apply_updates(state["log_alpha"], alpha_updates)
-
-            opt_states = {"qf": qf_opt, "actor": actor_opt, "alpha": alpha_opt}
-            return (state, opt_states), jnp.stack([qf_l, actor_l, alpha_l])
-
         data = jax.lax.with_sharding_constraint(data, {k: batch_sharding for k in data})
         keys = jax.random.split(key, data["rewards"].shape[0])
         data = dict(data, _key=keys)
-        (state, opt_states), metrics = jax.lax.scan(gradient_step, (state, opt_states), data)
+        (state, opt_states), metrics = jax.lax.scan(
+            lambda carry, batch: gradient_step(carry, batch, tau_eff), (state, opt_states), data
+        )
         m = metrics.mean(0)
         return state, opt_states, {"value_loss": m[0], "policy_loss": m[1], "alpha_loss": m[2]}, next_key
 
     return train_step
+
+
+def make_fused_train_step(
+    agent: SACAgent,
+    txs: Dict[str, optax.GradientTransformation],
+    cfg: Dict[str, Any],
+    mesh,
+    sample_fn,
+):
+    """Build the ring-sampled K-step update: each scan iteration draws its
+    minibatch from the device-resident replay ring with the JAX PRNG, so the
+    host samples nothing and ships no batch bytes. K rides on ``taus``'s
+    length (one EMA coefficient per step — the host fills them all with the
+    iteration's tau_eff), so each power-of-two bucket compiles once."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    gradient_step = make_gradient_step(agent, txs, cfg)
+    flat_sharding = NamedSharding(mesh, P(DATA_AXIS))
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def fused_train_step(state, opt_states, ring_state, key, taus):
+        next_key, key = jax.random.split(key)
+        step_keys = jax.random.split(key, taus.shape[0])
+
+        def body(carry, x):
+            k, tau_eff = x
+            k_sample, k_step = jax.random.split(k)
+            batch = sample_fn(ring_state, k_sample)
+            batch = jax.lax.with_sharding_constraint(batch, {name: flat_sharding for name in batch})
+            batch = dict(batch, _key=k_step)
+            return gradient_step(carry, batch, tau_eff)
+
+        (state, opt_states), metrics = jax.lax.scan(body, (state, opt_states), (step_keys, taus))
+        m = metrics.mean(0)
+        return state, opt_states, {"value_loss": m[0], "policy_loss": m[1], "alpha_loss": m[2]}, next_key
+
+    return fused_train_step
 
 
 @register_algorithm()
@@ -242,6 +289,32 @@ def main(runtime, cfg: Dict[str, Any]):
     train_fn = make_train_step(agent, txs, cfg, mesh)
     target_freq_iters = cfg.algo.critic.target_network_frequency // policy_steps_per_iter + 1
 
+    # Device-resident replay ring (data/device_buffer.py): transitions are
+    # mirrored into HBM and sampled inside the fused train jit — the host
+    # sample + [G*B] batch transfer above drop out of the hot path. Falls
+    # back to the host buffer when the ring won't fit the HBM budget.
+    use_device_buffer = bool(cfg.buffer.get("device", False))
+    fused_train_steps = max(int(cfg.algo.get("fused_train_steps", 1)), 1)
+    ring = None
+    fused_train_fn = None
+    ring_span = 1 + int(bool(cfg.buffer.sample_next_obs))
+    if use_device_buffer:
+        ring = DeviceReplayRing(
+            buffer_size,
+            cfg.env.num_envs,
+            obs_keys=("observations",),
+            hbm_fraction=float(cfg.buffer.get("device_hbm_fraction", 0.4)),
+            device=mesh.devices.flat[0],
+        )
+        if state_ckpt is not None and cfg.buffer.checkpoint and state_ckpt.get("rb") is not None:
+            ring.load_host_buffer(rb)
+        ring_sample_fn = ring.make_sample_fn(
+            cfg.algo.per_rank_batch_size,
+            sequence_length=1,
+            sample_next_obs=bool(cfg.buffer.sample_next_obs),
+        )
+        fused_train_fn = make_fused_train_step(agent, txs, cfg, mesh, ring_sample_fn)
+
     # Latency-aware player placement (core/player.py). Off-policy: honors
     # fabric.player_sync=async (the player may act on weights one update
     # stale, never blocking the interaction loop on the mirror transfer).
@@ -314,44 +387,72 @@ def main(runtime, cfg: Dict[str, Any]):
             step_data["next_observations"] = real_next_obs_cat[np.newaxis]
         step_data["rewards"] = rewards[np.newaxis].astype(np.float32)
         rb.add(step_data, validate_args=cfg.buffer.validate_args)
+        if ring is not None:
+            ring.add(step_data)
 
         obs = next_obs
 
         if iter_num >= learning_starts:
             per_rank_gradient_steps = ratio((policy_step - prefill_steps + policy_steps_per_iter) / world_size)
             if per_rank_gradient_steps > 0:
-                sample = rb.sample_tensors(
-                    batch_size=per_rank_gradient_steps * cfg.algo.per_rank_batch_size,
-                    sample_next_obs=cfg.buffer.sample_next_obs,
-                )
-                data = {
-                    k: np.asarray(v)
-                    .astype(np.float32)
-                    .reshape(per_rank_gradient_steps, cfg.algo.per_rank_batch_size, *np.asarray(v).shape[2:])
-                    for k, v in sample.items()
-                }
-                with timer("Time/train_time"):
-                    do_ema = iter_num % target_freq_iters == 0
-                    # tau as numpy (an eager jnp.asarray would dispatch);
-                    # the PRNG split happens inside the jit.
-                    with train_timer.step():
-                        agent_state, opt_states, train_metrics, train_key = train_fn(
-                            agent_state,
-                            opt_states,
-                            data,
-                            train_key,
-                            np.asarray(agent.tau if do_ema else 0.0, np.float32),
-                        )
-                    # No sync here: the dispatch stays fully async — the
-                    # StepTimer queues the loss scalars device-side and
-                    # bounds the interval with ONE block at the flush below.
-                    train_timer.pend(
-                        agent_state["actor"], train_metrics if keep_train_metrics else None
+                if ring is not None and ring.active:
+                    ring.flush()
+                use_ring = ring is not None and ring.active and ring.ready(ring_span)
+                if use_ring:
+                    with timer("Time/train_time"):
+                        do_ema = iter_num % target_freq_iters == 0
+                        tau_eff = np.float32(agent.tau if do_ema else 0.0)
+                        remaining = per_rank_gradient_steps
+                        while remaining > 0:
+                            # Power-of-two buckets bound the fused graphs to
+                            # log2(fused_train_steps) variants.
+                            k = 1 << (min(remaining, fused_train_steps).bit_length() - 1)
+                            with train_timer.step():
+                                agent_state, opt_states, train_metrics, train_key = fused_train_fn(
+                                    agent_state, opt_states, ring.state, train_key,
+                                    np.full(k, tau_eff, np.float32),
+                                )
+                            train_timer.pend(
+                                agent_state["actor"], train_metrics if keep_train_metrics else None
+                            )
+                            dispatch_throttle.add(train_metrics)
+                            cumulative_per_rank_gradient_steps += k
+                            remaining -= k
+                        placement.push(agent_state["actor"])
+                    train_step_count += world_size
+                else:
+                    sample = rb.sample_tensors(
+                        batch_size=per_rank_gradient_steps * cfg.algo.per_rank_batch_size,
+                        sample_next_obs=cfg.buffer.sample_next_obs,
                     )
-                    dispatch_throttle.add(train_metrics)
-                    placement.push(agent_state["actor"])
-                    cumulative_per_rank_gradient_steps += per_rank_gradient_steps
-                train_step_count += world_size
+                    data = {
+                        k: np.asarray(v)
+                        .astype(np.float32)
+                        .reshape(per_rank_gradient_steps, cfg.algo.per_rank_batch_size, *np.asarray(v).shape[2:])
+                        for k, v in sample.items()
+                    }
+                    with timer("Time/train_time"):
+                        do_ema = iter_num % target_freq_iters == 0
+                        # tau as numpy (an eager jnp.asarray would dispatch);
+                        # the PRNG split happens inside the jit.
+                        with train_timer.step():
+                            agent_state, opt_states, train_metrics, train_key = train_fn(
+                                agent_state,
+                                opt_states,
+                                data,
+                                train_key,
+                                np.asarray(agent.tau if do_ema else 0.0, np.float32),
+                            )
+                        # No sync here: the dispatch stays fully async — the
+                        # StepTimer queues the loss scalars device-side and
+                        # bounds the interval with ONE block at the flush below.
+                        train_timer.pend(
+                            agent_state["actor"], train_metrics if keep_train_metrics else None
+                        )
+                        dispatch_throttle.add(train_metrics)
+                        placement.push(agent_state["actor"])
+                        cumulative_per_rank_gradient_steps += per_rank_gradient_steps
+                    train_step_count += world_size
 
         should_log = cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters

@@ -73,7 +73,7 @@ def _ship_rollout(runtime, local_data, flat_keys, next_obs_np, share_data, jax):
             runtime.shard_batch(data, axis=1),
             runtime.shard_batch(next_obs_np, axis=0),
         )
-    if jax.process_count() > 1:
+    if jax.process_count() > 1 and not share_data:
         # Replication would be incoherent here: each process holds
         # DIFFERENT rollouts, and a "replicated" global array assumes every
         # copy is identical — GSPMD may then read any process's copy,
@@ -83,6 +83,9 @@ def _ship_rollout(runtime, local_data, flat_keys, next_obs_np, share_data, jax):
             f"data-axis size ({runtime.world_size}) in a multi-process run "
             "(or enable buffer.share_data to train on the gathered union)."
         )
+    # Single process, OR the share_data allgather above already ran: every
+    # process now holds the identical gathered union, so replication is
+    # coherent (just pays the full copy per device).
     warnings.warn(
         f"num_envs ({n_env_cols}) is not divisible by the data-axis size "
         f"({runtime.world_size}): the rollout is replicated to every device "

@@ -1,0 +1,434 @@
+"""Device-resident replay ring: the replay buffer as a pytree of HBM arrays.
+
+PROFILE.md's round-3 roofline left the host-side data path as the last
+measured overhead in the DV3 step: a memcpy-bound numpy gather plus a
+~12 MB host→device transfer *per gradient step*. The T5X-style answer is to
+keep the ring on-device and sample it inside the train jit, so the host
+never touches the hot path:
+
+- :class:`DeviceReplayRing` mirrors the host replay ring as a dict of
+  ``(capacity, n_envs, *feature)`` arrays living in HBM. Rollout rows are
+  *staged* on the host (cheap numpy copies) and shipped once per train
+  interval by :meth:`flush` — a single donated jitted scatter, not one
+  transfer per gradient step.
+- :meth:`make_sample_fn` returns a **pure function** ``sample(state, key)``
+  that draws uniform sequence starts with the JAX PRNG entirely inside the
+  caller's jit, reproducing ``SequentialReplayBuffer``'s valid-start
+  semantics (the write head never appears inside a sampled window).
+- Capacity accounting up front: when the ring would not fit the HBM budget
+  the ring deactivates itself and the train loop falls back to the existing
+  host buffer + ``ReplayInfeed`` path.
+
+The host replay buffer stays authoritative for checkpointing — ring writes
+are additive, so resume just replays the host ring into HBM via
+:meth:`load_host_buffer`. Nothing here is pickled.
+
+Valid-start math (shared by the in-jit sampler and the tests): with
+per-env write position ``pos``, per-env total rows written ``added``,
+ring ``capacity`` and window ``span``::
+
+    full    = added >= capacity
+    n_valid = full ? capacity - span + 1 : max(added - span + 1, 1)
+    offset  = full ? pos : 0
+    start   = (offset + uniform_int(0, n_valid)) % capacity
+
+which enumerates exactly the starts ``SequentialReplayBuffer.sample``
+allows: the oldest valid start is the write head itself once the ring has
+wrapped (the head is the oldest row), and windows never straddle the seam
+between the newest and the oldest row.
+"""
+
+from __future__ import annotations
+
+import warnings
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.telemetry import tracer as tracer_mod
+
+__all__ = ["DeviceReplayRing", "next_power_of_two"]
+
+
+def next_power_of_two(n: int) -> int:
+    """Smallest power of two >= n (n >= 1)."""
+    return 1 << (max(int(n), 1) - 1).bit_length()
+
+
+def _feature_shape(shape: Sequence[int]) -> Tuple[int, ...]:
+    """Feature dims of a ``[T, E, *feature]`` rollout array."""
+    return tuple(int(s) for s in shape[2:])
+
+
+class DeviceReplayRing:
+    """A replay ring held in device memory as ``{key: (capacity, n_envs, *f)}``.
+
+    Host-side staging + one donated jitted write per :meth:`flush`; sampling
+    is a pure function over :attr:`state` built by :meth:`make_sample_fn`
+    and meant to be closed over by the caller's train jit.
+
+    The ring is *additive*: the host buffer keeps receiving the same rows
+    and remains the checkpoint source of truth. ``capacity`` is the per-env
+    ring length (matching the host per-env sub-buffer size).
+    """
+
+    def __init__(
+        self,
+        capacity: int,
+        n_envs: int,
+        cnn_keys: Sequence[str] = (),
+        obs_keys: Sequence[str] = ("observations",),
+        hbm_fraction: float = 0.4,
+        hbm_budget_bytes: Optional[int] = None,
+        device: Any = None,
+    ) -> None:
+        if capacity < 1:
+            raise ValueError(f"DeviceReplayRing capacity must be >= 1, got {capacity}")
+        if n_envs < 1:
+            raise ValueError(f"DeviceReplayRing n_envs must be >= 1, got {n_envs}")
+        self.capacity = int(capacity)
+        self.n_envs = int(n_envs)
+        self.cnn_keys = tuple(cnn_keys)
+        self.obs_keys = tuple(obs_keys)
+        self.hbm_fraction = float(hbm_fraction)
+        self.hbm_budget_bytes = hbm_budget_bytes if hbm_budget_bytes is None else int(hbm_budget_bytes)
+        self._device = device
+        # Ring state (allocated lazily on the first add, when key shapes and
+        # dtypes are known).
+        self._specs: Optional[Dict[str, Tuple[Tuple[int, ...], np.dtype]]] = None
+        self._data: Optional[Dict[str, jax.Array]] = None
+        self._pos: Optional[jax.Array] = None
+        self._added: Optional[jax.Array] = None
+        # Host-side mirrors of pos/added so readiness checks never touch the
+        # device (GL002: no per-iteration host sync).
+        self._host_pos = np.zeros(self.n_envs, dtype=np.int64)
+        self._host_added = np.zeros(self.n_envs, dtype=np.int64)
+        # Staged rows awaiting flush: parallel lists of (mask[E], {k: row[E,*f]}).
+        self._staged_masks: List[np.ndarray] = []
+        self._staged_rows: List[Dict[str, np.ndarray]] = []
+        self._write_fn = None
+        # active=False -> the ring declined its allocation (HBM budget) and
+        # every method is a no-op; callers use the host path instead.
+        self.active = True
+        self.inactive_reason: Optional[str] = None
+
+    # ------------------------------------------------------------ capacity
+    def _budget_bytes(self) -> Optional[int]:
+        """The HBM byte budget, or None when unknown (no accounting)."""
+        if self.hbm_budget_bytes is not None:
+            return self.hbm_budget_bytes
+        device = self._device
+        if device is None:
+            devices = jax.local_devices()
+            device = devices[0] if devices else None
+        if device is None:
+            return None
+        stats = getattr(device, "memory_stats", None)
+        if stats is None:
+            return None
+        try:
+            limit = (stats() or {}).get("bytes_limit")
+        except Exception:  # memory_stats unsupported on this backend
+            return None
+        if limit is None:
+            return None
+        return int(int(limit) * self.hbm_fraction)
+
+    def ring_nbytes(self) -> int:
+        """Total ring bytes for the recorded key specs (0 before first add)."""
+        if self._specs is None:
+            return 0
+        total = 0
+        for feature, dtype in self._specs.values():
+            total += self.capacity * self.n_envs * int(np.prod(feature, dtype=np.int64)) * dtype.itemsize
+        return total
+
+    def _deactivate(self, reason: str) -> None:
+        self.active = False
+        self.inactive_reason = reason
+        self._staged_masks.clear()
+        self._staged_rows.clear()
+        self._data = None
+        warnings.warn(f"DeviceReplayRing disabled, falling back to the host buffer path: {reason}")
+
+    def _allocate(self) -> None:
+        needed = self.ring_nbytes()
+        budget = self._budget_bytes()
+        if budget is not None and needed > budget:
+            self._deactivate(
+                f"ring needs {needed / 2**20:.1f} MiB but the HBM budget is {budget / 2**20:.1f} MiB"
+            )
+            return
+        data: Dict[str, jax.Array] = {}
+        for key, (feature, dtype) in self._specs.items():
+            data[key] = jnp.zeros((self.capacity, self.n_envs) + feature, dtype=dtype)
+        self._data = data
+        self._pos = jnp.zeros(self.n_envs, dtype=jnp.int32)
+        self._added = jnp.zeros(self.n_envs, dtype=jnp.int32)
+        tracer_mod.current().set_gauge("replay_ring_bytes", float(needed))
+
+    # ------------------------------------------------------------- staging
+    def add(self, data: Dict[str, Any], env_idxes: Optional[Sequence[int]] = None) -> None:
+        """Stage ``[T, E', *f]`` rows for the given env columns (all when
+        ``env_idxes`` is None). Values are **copied** — callers are free to
+        mutate ``data`` in place afterwards (the train loops do)."""
+        if not self.active:
+            return
+        if env_idxes is None:
+            env_idxes = range(self.n_envs)
+        env_idxes = [int(e) for e in env_idxes]
+        arrays = {key: np.asarray(value) for key, value in data.items()}
+        n_steps = int(next(iter(arrays.values())).shape[0])
+        if self._specs is None:
+            # First add fixes the key set, feature shapes and dtypes; the
+            # HBM budget check happens here so a too-big ring deactivates
+            # before any staging cost is paid.
+            self._specs = {
+                key: (_feature_shape(value.shape), np.dtype(value.dtype))
+                for key, value in arrays.items()
+            }
+            needed = self.ring_nbytes()
+            budget = self._budget_bytes()
+            if budget is not None and needed > budget:
+                self._deactivate(
+                    f"ring needs {needed / 2**20:.1f} MiB but the HBM budget is {budget / 2**20:.1f} MiB"
+                )
+                return
+        for t in range(n_steps):
+            mask = np.zeros(self.n_envs, dtype=bool)
+            mask[env_idxes] = True
+            row: Dict[str, np.ndarray] = {}
+            for key, (feature, dtype) in self._specs.items():
+                full_row = np.zeros((self.n_envs,) + feature, dtype=dtype)
+                value = arrays.get(key)
+                if value is not None:
+                    # Keys absent from this add (e.g. sparse reset rows)
+                    # keep their natural zero, matching what the loops put
+                    # in reset rows explicitly.
+                    full_row[env_idxes] = value[t]
+                row[key] = full_row
+            self._staged_masks.append(mask)
+            self._staged_rows.append(row)
+        self._host_pos[env_idxes] = (self._host_pos[env_idxes] + n_steps) % self.capacity
+        self._host_added[env_idxes] = np.minimum(self._host_added[env_idxes] + n_steps, self.capacity)
+
+    def amend_last(self, env_idx: int, values: Dict[str, Any]) -> None:
+        """Patch the newest row written for one env (staged when possible,
+        an eager device update otherwise). Used by the restart-on-exception
+        path to flip terminal flags on the already-added row."""
+        if not self.active:
+            return
+        env_idx = int(env_idx)
+        for mask, row in zip(reversed(self._staged_masks), reversed(self._staged_rows)):
+            if mask[env_idx]:
+                for key, value in values.items():
+                    if key in row:
+                        row[key][env_idx] = np.asarray(value).reshape(row[key][env_idx].shape)
+                return
+        if self._data is None or self._host_added[env_idx] == 0:
+            return
+        t = int((self._host_pos[env_idx] - 1) % self.capacity)
+        for key, value in values.items():
+            if key in self._data:
+                patch = jnp.asarray(np.asarray(value).reshape(self._data[key].shape[2:]))
+                self._data[key] = self._data[key].at[t, env_idx].set(patch.astype(self._data[key].dtype))
+
+    # --------------------------------------------------------------- write
+    def _build_write_fn(self):
+        capacity = self.capacity
+        n_envs = self.n_envs
+        env_ids = jnp.arange(n_envs)
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def write(data, pos, added, rows, mask, shift):
+            # mask: [S, E] bool; rows: {k: [S, E, *f]}. Per-env cumulative
+            # write count turns the staged order into ring targets; masked-out
+            # slots are sent out of bounds and dropped by the scatter.
+            # shift: [E] rows the host dropped when trimming an oversized
+            # flush — they still advance the write head, keeping the device
+            # pos in lockstep with the host mirror.
+            pos = (pos + shift) % capacity
+            counts = jnp.cumsum(mask.astype(jnp.int32), axis=0)  # [S, E]
+            t_idx = jnp.where(mask, (pos[None, :] + counts - 1) % capacity, capacity)
+            e_idx = jnp.broadcast_to(env_ids[None, :], t_idx.shape)
+            new_data = {
+                key: value.at[t_idx, e_idx].set(rows[key].astype(value.dtype), mode="drop")
+                for key, value in data.items()
+            }
+            new_pos = (pos + counts[-1]) % capacity
+            new_added = jnp.minimum(added + shift + counts[-1], capacity)
+            return new_data, new_pos, new_added
+
+        return write
+
+    def flush(self) -> bool:
+        """Ship every staged row to the device in ONE donated jitted write.
+
+        Returns True when a write happened. The staged step count is padded
+        to the next power of two (extra rows fully masked out) so the write
+        kernel recompiles at most log2(max_steps) times.
+        """
+        if not self.active or not self._staged_rows:
+            return False
+        if self._data is None:
+            self._allocate()
+            if not self.active:
+                return False
+        n_staged = len(self._staged_rows)
+        shift = np.zeros(self.n_envs, dtype=np.int32)
+        if n_staged > self.capacity:
+            # Only the last `capacity` masked rows per env can survive; drop
+            # older ones on the host so ring targets stay collision-free.
+            # The dropped rows still advance the write head (shift), keeping
+            # the device pos equal to the host mirror's.
+            masks = np.stack(self._staged_masks, axis=0)
+            seen_from_end = np.cumsum(masks[::-1].astype(np.int64), axis=0)[::-1]
+            keep = masks & (seen_from_end <= self.capacity)
+            shift = (masks.sum(axis=0) - keep.sum(axis=0)).astype(np.int32)
+            self._staged_masks = [keep[t] for t in range(n_staged)]
+        padded = next_power_of_two(n_staged)
+        mask = np.zeros((padded, self.n_envs), dtype=bool)
+        mask[:n_staged] = np.stack(self._staged_masks, axis=0)
+        rows: Dict[str, np.ndarray] = {}
+        for key in self._staged_rows[0]:
+            stacked = np.stack([row[key] for row in self._staged_rows], axis=0)
+            if padded > n_staged:
+                pad = np.zeros((padded - n_staged,) + stacked.shape[1:], dtype=stacked.dtype)
+                stacked = np.concatenate([stacked, pad], axis=0)
+            rows[key] = stacked
+        self._staged_masks.clear()
+        self._staged_rows.clear()
+        if self._write_fn is None:
+            self._write_fn = self._build_write_fn()
+        nbytes = int(sum(value.nbytes for value in rows.values()) + mask.nbytes)
+        trc = tracer_mod.current()
+        with trc.span("transfer/ring_write", "transfer", steps=n_staged, bytes=nbytes):
+            self._data, self._pos, self._added = self._write_fn(
+                self._data, self._pos, self._added, rows, mask, shift
+            )
+        trc.count("host_to_device_calls", 1)
+        trc.count("host_to_device_bytes", nbytes)
+        trc.count("ring_write_rows", int(mask.sum()))
+        return True
+
+    # ------------------------------------------------------------ sampling
+    @property
+    def state(self) -> Dict[str, Any]:
+        """The device-resident ring as a pytree: pass this into the train
+        jit; :meth:`make_sample_fn`'s pure function consumes it."""
+        if self._data is None:
+            raise RuntimeError("DeviceReplayRing.state read before the first flush allocated the ring")
+        return {"data": self._data, "pos": self._pos, "added": self._added}
+
+    def ready(self, span: int) -> bool:
+        """True when every env column has at least ``span`` rows *flushed*,
+        so the in-jit sampler cannot window into unwritten rows. Pure host
+        arithmetic — no device sync."""
+        if not self.active or self._data is None:
+            return False
+        return bool(self._host_added.min() >= max(int(span), 1)) and span <= self.capacity
+
+    def make_sample_fn(
+        self,
+        batch_size: int,
+        sequence_length: int = 1,
+        sample_next_obs: bool = False,
+        time_major: bool = False,
+    ) -> Callable[[Dict[str, Any], jax.Array], Dict[str, jax.Array]]:
+        """Build the pure in-jit sampler ``sample(state, key) -> batch``.
+
+        Uniform env choice then uniform valid sequence start per sample —
+        ``SequentialReplayBuffer`` semantics (one env per sequence, windows
+        never cross the write head). Output is ``[B, *f]`` when
+        ``sequence_length == 1`` and ``time_major`` is False, else
+        ``[L, B, *f]`` (time-major) or ``[B, L, *f]``. Non-CNN keys are cast
+        to float32 in-jit (the CNN keys keep their storage dtype for the
+        train step's own ``/255`` normalisation). With ``sample_next_obs``
+        the window is one longer and each obs key ``k`` gains ``next_k``.
+        """
+        capacity = self.capacity
+        n_envs = self.n_envs
+        cnn_keys = frozenset(self.cnn_keys)
+        obs_keys = tuple(self.obs_keys)
+        span = int(sequence_length) + int(bool(sample_next_obs))
+        if span > capacity:
+            raise ValueError(
+                f"sequence window {span} exceeds DeviceReplayRing capacity {capacity}"
+            )
+        batch_size = int(batch_size)
+        sequence_length = int(sequence_length)
+
+        def _cast(key: str, value: jax.Array) -> jax.Array:
+            return value if key in cnn_keys else value.astype(jnp.float32)
+
+        def _shape(value: jax.Array) -> jax.Array:
+            # value: [B, L(+1) sliced to L, *f] -> requested layout.
+            if sequence_length == 1 and not time_major:
+                return value[:, 0]
+            if time_major:
+                return jnp.swapaxes(value, 0, 1)
+            return value
+
+        def sample(state: Dict[str, Any], key: jax.Array) -> Dict[str, jax.Array]:
+            pos = state["pos"]
+            added = state["added"]
+            k_env, k_start = jax.random.split(key)
+            env_idx = jax.random.randint(k_env, (batch_size,), 0, n_envs)
+            full = added >= capacity
+            n_valid = jnp.where(
+                full,
+                capacity - span + 1,
+                jnp.maximum(added - span + 1, 1),
+            )
+            offset = jnp.where(full, pos, 0)
+            r = jax.random.randint(k_start, (batch_size,), 0, n_valid[env_idx])
+            start = (offset[env_idx] + r) % capacity
+            t_idx = (start[:, None] + jnp.arange(span)) % capacity  # [B, span]
+            batch: Dict[str, jax.Array] = {}
+            for name, ring in state["data"].items():
+                window = ring[t_idx, env_idx[:, None]]  # [B, span, *f]
+                batch[name] = _shape(_cast(name, window[:, :sequence_length]))
+                if sample_next_obs and name in obs_keys:
+                    batch[f"next_{name}"] = _shape(_cast(name, window[:, 1:]))
+            return batch
+
+        return sample
+
+    # ------------------------------------------------------------- resume
+    def load_host_buffer(self, rb: Any) -> None:
+        """Stage the host buffer's current contents chronologically (oldest first)
+        so a resumed run samples its checkpointed history on-device.
+
+        Understands ``EnvIndependentReplayBuffer`` (per-env sub-buffers) and
+        flat ``ReplayBuffer``/``SequentialReplayBuffer``; anything else
+        (episode buffers) deactivates the ring with a warning.
+        """
+        if not self.active:
+            return
+        sub_buffers = getattr(rb, "buffer", None)
+        if sub_buffers is not None and isinstance(sub_buffers, (list, tuple)):
+            for env_idx, sub in enumerate(sub_buffers):
+                self._load_flat(sub, [env_idx])
+            return
+        if hasattr(rb, "_pos") and hasattr(rb, "full"):
+            self._load_flat(rb, list(range(self.n_envs)))
+            return
+        self._deactivate(f"cannot mirror a {type(rb).__name__} into the device ring")
+
+    def _load_flat(self, rb: Any, env_idxes: List[int]) -> None:
+        if getattr(rb, "empty", True):
+            return
+        size = int(rb.buffer_size)
+        pos = int(rb._pos)
+        if getattr(rb, "full", False):
+            order = np.concatenate([np.arange(pos, size), np.arange(0, pos)])
+        else:
+            order = np.arange(pos)
+        if order.size == 0:
+            return
+        data = {key: np.asarray(rb[key])[order] for key in rb.buffer.keys()}
+        self.add(data, env_idxes)

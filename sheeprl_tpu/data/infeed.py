@@ -105,6 +105,14 @@ class ReplayInfeed:
         cnn_key_set = set(cnn_keys)
 
         def device_batch(host_batch):
+            # Count the host->device traffic before conversion (the host
+            # array's nbytes is what actually crosses the PCIe/ICI link);
+            # the tracer is thread-safe, so this is fine on the worker.
+            trc = _current_tracer()
+            if trc.enabled:
+                nbytes = sum(int(getattr(v, "nbytes", 0)) for v in host_batch.values())
+                trc.count("host_to_device_calls", 1)
+                trc.count("host_to_device_bytes", nbytes)
             return {
                 k: jnp.asarray(v, jnp.float32) if k not in cnn_key_set else jnp.asarray(v)
                 for k, v in host_batch.items()

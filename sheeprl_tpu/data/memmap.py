@@ -141,6 +141,14 @@ class MemmapArray:
         memo[id(self)] = clone
         return clone
 
+    def __copy__(self) -> "MemmapArray":
+        # Same rationale as __deepcopy__: copy.copy() would otherwise route
+        # through __getstate__, whose pickling side effect strips ownership
+        # from the SOURCE for a mere in-process shallow copy.
+        clone = type(self)(self._filename, self._dtype, self._shape, self._mode)
+        clone._has_ownership = False
+        return clone
+
     # ---------------------------------------------------------- array-like
     def __array__(self, dtype: DTypeLike = None) -> np.ndarray:
         arr = self.array

@@ -67,7 +67,12 @@ class StepTimer:
         elapsed = time.perf_counter() - start
         self.steps += 1
         self.dispatch_s += elapsed
-        tracer_mod.current().add_span(f"{self.name}/dispatch", "dispatch", start, elapsed)
+        trc = tracer_mod.current()
+        trc.add_span(f"{self.name}/dispatch", "dispatch", start, elapsed)
+        # Dispatch-count counter: fused K-step trains show up as one
+        # dispatch, which is the whole point — the counter is how the A/B
+        # proves it.
+        trc.count(f"{self.name}_dispatches", 1)
 
     def pend(self, token: Any, metrics: Any = None) -> None:
         """Stash the step's bounding token (always replaces: with donated

@@ -1,0 +1,291 @@
+"""Fused K-gradient-step scan == K looped single-step calls (fixed seed,
+CPU, micro models), plus the host-vs-device-buffer telemetry A/B: with
+`buffer.device=true` the per-interval host->device bytes AND train dispatch
+count must drop strictly below the host-path run."""
+
+import glob
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import sheeprl_tpu
+from sheeprl_tpu.cli import run
+from sheeprl_tpu.config.loader import compose
+from sheeprl_tpu.core import Runtime
+from sheeprl_tpu.data.device_buffer import DeviceReplayRing
+
+K_VALUES = (1, 2, 4)
+
+
+@pytest.fixture(autouse=True)
+def _chdir_tmp(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+
+
+def _compose(args):
+    sheeprl_tpu.register_all()
+    return compose("config", args)
+
+
+def _tree_allclose(a, b, atol=1e-5):
+    leaves_a = jax.tree_util.tree_leaves(a)
+    leaves_b = jax.tree_util.tree_leaves(b)
+    assert len(leaves_a) == len(leaves_b)
+    for la, lb in zip(leaves_a, leaves_b):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb), atol=atol, rtol=1e-4)
+
+
+def _copy_tree(tree):
+    # The fused train steps donate their carry arguments; hand each call a
+    # fresh copy so the reference trees stay alive across K values.
+    return jax.tree_util.tree_map(jnp.copy, tree)
+
+
+class TestSACFusedEquivalence:
+    def _setup(self):
+        import gymnasium as gym
+
+        from sheeprl_tpu.algos.sac.agent import build_agent
+        from sheeprl_tpu.algos.sac.sac import (
+            _make_optimizer,
+            make_fused_train_step,
+            make_gradient_step,
+        )
+
+        cfg = _compose([
+            "exp=sac", "env=dummy", "env.id=continuous_dummy",
+            "env.wrapper.id=continuous_dummy", "dry_run=True",
+            "metric.log_level=0", "env.num_envs=2", "env.sync_env=True",
+            "env.capture_video=False", "algo.per_rank_batch_size=4",
+            "algo.learning_starts=0", "algo.hidden_size=8",
+            "buffer.memmap=False", "buffer.size=64", "checkpoint.every=0",
+            "fabric.accelerator=cpu", "fabric.devices=1",
+        ])
+        runtime = Runtime(devices=1, accelerator="cpu").launch()
+        runtime.seed_everything(cfg.seed)
+        obs_space = gym.spaces.Dict(
+            {k: gym.spaces.Box(-np.inf, np.inf, (3,), np.float32) for k in cfg.algo.mlp_keys.encoder}
+        )
+        action_space = gym.spaces.Box(-1.0, 1.0, (2,), np.float32)
+        agent, agent_state = build_agent(runtime, cfg, obs_space, action_space, None)
+        txs = {
+            "qf": _make_optimizer(cfg.algo.critic.optimizer),
+            "actor": _make_optimizer(cfg.algo.actor.optimizer),
+            "alpha": _make_optimizer(cfg.algo.alpha.optimizer),
+        }
+        opt_states = {
+            "qf": txs["qf"].init(agent_state["qfs"]),
+            "actor": txs["actor"].init(agent_state["actor"]),
+            "alpha": txs["alpha"].init(agent_state["log_alpha"]),
+        }
+
+        obs_dim = 3 * len(cfg.algo.mlp_keys.encoder)
+        rng = np.random.default_rng(0)
+        T, E = 32, 2
+        ring = DeviceReplayRing(64, E, obs_keys=("observations",))
+        ring.add({
+            "observations": rng.normal(size=(T, E, obs_dim)).astype(np.float32),
+            "next_observations": rng.normal(size=(T, E, obs_dim)).astype(np.float32),
+            "actions": rng.normal(size=(T, E, 2)).astype(np.float32),
+            "rewards": rng.normal(size=(T, E, 1)).astype(np.float32),
+            "terminated": (rng.random((T, E, 1)) < 0.1).astype(np.uint8),
+            "truncated": np.zeros((T, E, 1), np.uint8),
+        })
+        ring.flush()
+        sample_fn = ring.make_sample_fn(cfg.algo.per_rank_batch_size, sequence_length=1)
+        fused_fn = make_fused_train_step(agent, txs, cfg, runtime.mesh, sample_fn)
+        gradient_step = make_gradient_step(agent, txs, cfg)
+        loop_step = jax.jit(lambda carry, batch, tau: gradient_step(carry, dict(batch), tau))
+        return agent_state, opt_states, ring, sample_fn, fused_fn, loop_step
+
+    def test_fused_matches_looped(self):
+        agent_state, opt_states, ring, sample_fn, fused_fn, loop_step = self._setup()
+        sample_jit = jax.jit(sample_fn)
+        tau_eff = np.float32(0.02)
+        for k in K_VALUES:
+            key = jax.random.PRNGKey(7 + k)
+            # Mirror the fused key derivation exactly.
+            _, key2 = jax.random.split(key)
+            step_keys = jax.random.split(key2, k)
+            carry = (_copy_tree(agent_state), _copy_tree(opt_states))
+            for i in range(k):
+                k_sample, k_step = jax.random.split(step_keys[i])
+                batch = dict(sample_jit(ring.state, k_sample))
+                batch["_key"] = k_step
+                carry, _ = loop_step(carry, batch, tau_eff)
+            want_state, want_opts = carry
+
+            got_state, got_opts, metrics, _ = fused_fn(
+                _copy_tree(agent_state), _copy_tree(opt_states), ring.state,
+                jax.random.PRNGKey(7 + k), np.full(k, tau_eff, np.float32),
+            )
+            _tree_allclose(got_state, want_state)
+            _tree_allclose(got_opts, want_opts)
+            assert np.isfinite(float(metrics["value_loss"]))
+
+
+class TestDreamerV3FusedEquivalence:
+    def _setup(self, tmp_path):
+        from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
+        from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import (
+            _make_optimizer,
+            make_fused_train_step,
+            make_step_core,
+        )
+        from sheeprl_tpu.algos.ppo.agent import actions_metadata
+        from sheeprl_tpu.utils.env import make_vector_env
+        from sheeprl_tpu.utils.ops import init_moments
+
+        cfg = _compose([
+            "exp=dreamer_v3", "env=dummy", "dry_run=True", "metric.log_level=0",
+            "env.num_envs=2", "env.sync_env=True", "env.capture_video=False",
+            "algo.dense_units=8", "algo.mlp_layers=1", "algo.per_rank_batch_size=2",
+            "algo.world_model.encoder.cnn_channels_multiplier=2",
+            "algo.world_model.recurrent_model.recurrent_state_size=8",
+            "algo.world_model.representation_model.hidden_size=8",
+            "algo.world_model.transition_model.hidden_size=8",
+            "algo.world_model.stochastic_size=4", "algo.learning_starts=0",
+            "algo.run_test=False", "buffer.memmap=False", "checkpoint.every=0",
+            "fabric.accelerator=cpu", "env.screen_size=64", "algo.horizon=2",
+            "algo.per_rank_sequence_length=1", "algo.world_model.discrete_size=4",
+            "fabric.devices=1",
+        ])
+        cfg.env.frame_stack = -1
+        runtime = Runtime(devices=1, accelerator="cpu").launch()
+        runtime.seed_everything(cfg.seed)
+        envs = make_vector_env(cfg, 0, str(tmp_path))
+        observation_space = envs.single_observation_space
+        action_space = envs.single_action_space
+        envs.close()
+        actions_dim, is_continuous = actions_metadata(action_space)
+        obs_keys = list(cfg.algo.cnn_keys.encoder) + list(cfg.algo.mlp_keys.encoder)
+        agent, agent_state = build_agent(
+            runtime, actions_dim, is_continuous, cfg, observation_space,
+            None, None, None, None,
+        )
+        txs = {
+            "world_model": _make_optimizer(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients),
+            "actor": _make_optimizer(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients),
+            "critic": _make_optimizer(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients),
+        }
+        opt_states = {name: txs[name].init(agent_state[name]) for name in txs}
+        moments_state = init_moments()
+
+        rng = np.random.default_rng(1)
+        T, E = 16, 2
+        data = {}
+        for k in obs_keys:
+            space = observation_space[k]
+            if np.issubdtype(space.dtype, np.integer) or len(space.shape) == 3:
+                data[k] = rng.integers(0, 255, (T, E) + space.shape).astype(space.dtype)
+            else:
+                data[k] = rng.normal(size=(T, E) + space.shape).astype(np.float32)
+        n_act = int(np.sum(actions_dim))
+        actions = np.zeros((T, E, n_act), np.float32)
+        actions[np.arange(T)[:, None], np.arange(E)[None, :], rng.integers(0, n_act, (T, E))] = 1.0
+        data["actions"] = actions
+        data["rewards"] = rng.normal(size=(T, E, 1)).astype(np.float32)
+        data["terminated"] = (rng.random((T, E, 1)) < 0.1).astype(np.float32)
+        data["truncated"] = np.zeros((T, E, 1), np.float32)
+        data["is_first"] = (rng.random((T, E, 1)) < 0.1).astype(np.float32)
+        ring = DeviceReplayRing(
+            32, E, cnn_keys=tuple(cfg.algo.cnn_keys.encoder), obs_keys=tuple(obs_keys)
+        )
+        ring.add(data)
+        ring.flush()
+        sample_fn = ring.make_sample_fn(
+            cfg.algo.per_rank_batch_size,
+            sequence_length=cfg.algo.per_rank_sequence_length,
+            time_major=True,
+        )
+        fused_fn = make_fused_train_step(agent, txs, cfg, runtime.mesh, sample_fn)
+        step_core = make_step_core(agent, txs, cfg, runtime.mesh)
+        loop_step = jax.jit(step_core)
+        return cfg, agent_state, opt_states, moments_state, ring, sample_fn, fused_fn, loop_step
+
+    def test_fused_matches_looped(self, tmp_path):
+        from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import _target_update_taus
+
+        (cfg, agent_state, opt_states, moments_state, ring, sample_fn,
+         fused_fn, loop_step) = self._setup(tmp_path)
+        sample_jit = jax.jit(sample_fn)
+        freq = int(cfg.algo.critic.per_rank_target_network_update_freq)
+        tau = float(cfg.algo.critic.tau)
+        for k in K_VALUES:
+            # Start at cumulative step 0: taus[0] = 1.0 exercises the hard
+            # target copy inside the scan as well as the tau/0 steps.
+            taus = _target_update_taus(0, k, freq, tau)
+            key = jax.random.PRNGKey(11 + k)
+            _, key2 = jax.random.split(key)
+            step_keys = jax.random.split(key2, k)
+            state = _copy_tree(agent_state)
+            opts = _copy_tree(opt_states)
+            moments = _copy_tree(moments_state)
+            for i in range(k):
+                k_sample, k_core = jax.random.split(step_keys[i])
+                batch = sample_jit(ring.state, k_sample)
+                state, opts, moments, _ = loop_step(
+                    state, opts, moments, batch, k_core, taus[i]
+                )
+
+            got_state, got_opts, got_moments, metrics, _ = fused_fn(
+                _copy_tree(agent_state), _copy_tree(opt_states),
+                _copy_tree(moments_state), ring.state,
+                jax.random.PRNGKey(11 + k), taus,
+            )
+            _tree_allclose(got_state, state)
+            _tree_allclose(got_opts, opts)
+            _tree_allclose(got_moments, moments)
+            assert np.isfinite(float(metrics["Loss/world_model_loss"]))
+
+
+def _final_counters(root):
+    paths = glob.glob(os.path.join(root, "**", "telemetry.jsonl"), recursive=True)
+    assert paths, f"no telemetry.jsonl under {root}"
+    lines = [json.loads(line) for line in open(sorted(paths)[-1])]
+    counters = [rec for rec in lines if rec["type"] == "counters"]
+    assert counters, "no counters lines exported"
+    return counters[-1]["values"]
+
+
+def test_device_buffer_ab_transfers_and_dispatches(tmp_path, monkeypatch):
+    """Acceptance A/B: same dreamer_v3 micro workload, host path vs
+    buffer.device=true + fused K — the device run's host->device transfer
+    bytes and train dispatch count must both be strictly lower."""
+    common = [
+        "exp=dreamer_v3", "env=dummy", "metric.log_level=1", "metric.log_every=2",
+        "env.num_envs=2", "env.sync_env=True", "env.capture_video=False",
+        "algo.dense_units=8", "algo.mlp_layers=1", "algo.per_rank_batch_size=2",
+        "algo.world_model.encoder.cnn_channels_multiplier=2",
+        "algo.world_model.recurrent_model.recurrent_state_size=8",
+        "algo.world_model.representation_model.hidden_size=8",
+        "algo.world_model.transition_model.hidden_size=8",
+        "algo.world_model.stochastic_size=4", "algo.run_test=False",
+        "buffer.memmap=False", "buffer.size=256", "checkpoint.every=0",
+        "checkpoint.save_last=False", "fabric.accelerator=cpu",
+        "env.screen_size=64", "algo.horizon=2", "algo.per_rank_sequence_length=1",
+        "algo.world_model.discrete_size=4", "fabric.devices=1",
+        "algo.total_steps=16", "algo.learning_starts=4", "algo.replay_ratio=4.0",
+        "telemetry.enabled=True",
+    ]
+    host_dir = tmp_path / "host"
+    dev_dir = tmp_path / "dev"
+    host_dir.mkdir()
+    dev_dir.mkdir()
+
+    monkeypatch.chdir(host_dir)
+    run(common)
+    host = _final_counters(str(host_dir))
+
+    monkeypatch.chdir(dev_dir)
+    run(common + ["buffer.device=true", "algo.fused_train_steps=4"])
+    dev = _final_counters(str(dev_dir))
+
+    assert dev.get("host_to_device_bytes", 0) > 0, "ring writes not counted"
+    assert dev["host_to_device_bytes"] < host.get("host_to_device_bytes", 0)
+    assert dev.get("train_dispatches", 0) > 0
+    assert dev["train_dispatches"] < host.get("train_dispatches", 0)

@@ -1,0 +1,184 @@
+"""DeviceReplayRing unit tests (data/device_buffer.py): write/wraparound
+content, valid-start masking at the ring seam, host-budget fallback, and
+host-buffer re-staging — the device twin of test_buffers.py."""
+
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.data.device_buffer import DeviceReplayRing, next_power_of_two
+
+
+def make_steps(t, n_envs, base=0):
+    obs = np.arange(base, base + t * n_envs, dtype=np.float32).reshape(t, n_envs, 1)
+    return {
+        "obs": obs,
+        "rewards": np.zeros((t, n_envs, 1), np.float32),
+    }
+
+
+def make_ring(capacity, n_envs, **kw):
+    kw.setdefault("obs_keys", ("obs",))
+    return DeviceReplayRing(capacity, n_envs, **kw)
+
+
+def test_next_power_of_two():
+    assert [next_power_of_two(n) for n in (1, 2, 3, 5, 8, 9)] == [1, 2, 4, 8, 8, 16]
+
+
+class TestWrite:
+    def test_add_and_flush(self):
+        ring = make_ring(8, 2)
+        ring.add(make_steps(5, 2))
+        assert ring.flush()
+        state = ring.state
+        assert np.asarray(state["pos"]).tolist() == [5, 5]
+        assert np.asarray(state["added"]).tolist() == [5, 5]
+        np.testing.assert_array_equal(
+            np.asarray(state["data"]["obs"])[:5, :, 0],
+            np.arange(10, dtype=np.float32).reshape(5, 2),
+        )
+
+    def test_wraparound_keeps_newest(self):
+        ring = make_ring(8, 1)
+        ring.add(make_steps(12, 1))
+        ring.flush()
+        state = ring.state
+        # 12 rows through a capacity-8 ring: the last 8 survive, write head
+        # wrapped to 12 % 8 = 4.
+        assert int(np.asarray(state["pos"])[0]) == 4
+        assert int(np.asarray(state["added"])[0]) == 8
+        stored = np.sort(np.asarray(state["data"]["obs"])[:, 0, 0])
+        np.testing.assert_array_equal(stored, np.arange(4, 12, dtype=np.float32))
+
+    def test_masked_env_subset_add(self):
+        ring = make_ring(8, 2)
+        ring.add(make_steps(2, 2))
+        # env 1 alone advances by one row
+        ring.add({"obs": np.full((1, 1, 1), 99.0, np.float32),
+                  "rewards": np.zeros((1, 1, 1), np.float32)}, env_idxes=[1])
+        ring.flush()
+        state = ring.state
+        assert np.asarray(state["pos"]).tolist() == [2, 3]
+        assert float(np.asarray(state["data"]["obs"])[2, 1, 0]) == 99.0
+
+    def test_ready_tracks_min_env(self):
+        ring = make_ring(8, 2)
+        assert not ring.ready(1)
+        ring.add(make_steps(2, 2))
+        ring.add(make_steps(1, 1), env_idxes=[1])
+        ring.flush()  # ready() counts flushed rows only
+        assert ring.ready(2)
+        assert not ring.ready(3)  # env 0 has only 2 rows
+        assert not ring.ready(9)  # span beyond capacity never readies
+
+
+class TestSample:
+    def test_seam_masking_and_coverage(self):
+        """After wraparound, sampled L=2 windows are always two CONSECUTIVE
+        rows (never straddling the write head), and every valid start is
+        reachable."""
+        ring = make_ring(8, 1)
+        ring.add(make_steps(12, 1))
+        ring.flush()
+        sample_fn = jax.jit(ring.make_sample_fn(16, sequence_length=2, time_major=True))
+        starts = set()
+        key = jax.random.PRNGKey(0)
+        for i in range(32):
+            key, sub = jax.random.split(key)
+            batch = np.asarray(sample_fn(ring.state, sub)["obs"])  # [2, 16, 1]
+            v0, v1 = batch[0, :, 0], batch[1, :, 0]
+            np.testing.assert_array_equal(v1 - v0, np.ones_like(v0))
+            assert v0.min() >= 4.0 and v1.max() <= 11.0
+            starts.update(v0.astype(int).tolist())
+        # 7 valid starts for L=2 over rows 4..11
+        assert starts == set(range(4, 11))
+
+    def test_partial_fill_samples_prefix_only(self):
+        ring = make_ring(8, 1)
+        ring.add(make_steps(3, 1))
+        ring.flush()
+        sample_fn = jax.jit(ring.make_sample_fn(32, sequence_length=2, time_major=True))
+        batch = np.asarray(sample_fn(ring.state, jax.random.PRNGKey(1))["obs"])
+        assert batch[0].min() >= 0.0 and batch[1].max() <= 2.0
+
+    def test_sample_next_obs(self):
+        ring = make_ring(8, 1)
+        ring.add(make_steps(6, 1))
+        ring.flush()
+        sample_fn = jax.jit(
+            ring.make_sample_fn(32, sequence_length=1, sample_next_obs=True)
+        )
+        batch = {k: np.asarray(v) for k, v in sample_fn(ring.state, jax.random.PRNGKey(2)).items()}
+        assert batch["obs"].shape == (32, 1)
+        np.testing.assert_array_equal(batch["next_obs"] - batch["obs"], np.ones((32, 1), np.float32))
+
+
+class TestFallback:
+    def test_budget_fallback_deactivates(self):
+        ring = make_ring(1024, 4, hbm_budget_bytes=16)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            ring.add(make_steps(2, 4))
+        assert not ring.active
+        assert any("falling back" in str(w.message) for w in caught)
+        assert not ring.ready(1)
+        assert not ring.flush()
+
+    def test_add_after_deactivate_is_noop(self):
+        ring = make_ring(1024, 4, hbm_budget_bytes=16)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            ring.add(make_steps(2, 4))
+        ring.add(make_steps(2, 4))
+        assert not ring.flush()
+
+
+class TestHostReload:
+    def test_load_sequential(self):
+        rb = SequentialReplayBuffer(8, 1)
+        rb.add(make_steps(12, 1))
+        ring = make_ring(8, 1)
+        ring.load_host_buffer(rb)
+        ring.flush()
+        state = ring.state
+        assert int(np.asarray(state["added"])[0]) == 8
+        # chronological order preserved: oldest surviving row first
+        np.testing.assert_array_equal(
+            np.asarray(state["data"]["obs"])[:, 0, 0],
+            np.arange(4, 12, dtype=np.float32),
+        )
+
+    def test_load_env_independent(self):
+        rb = EnvIndependentReplayBuffer(8, n_envs=2, buffer_cls=SequentialReplayBuffer)
+        rb.add(make_steps(5, 2))
+        ring = make_ring(8, 2)
+        ring.load_host_buffer(rb)
+        ring.flush()
+        state = ring.state
+        assert np.asarray(state["added"]).tolist() == [5, 5]
+        np.testing.assert_array_equal(
+            np.asarray(state["data"]["obs"])[:5, :, 0],
+            np.arange(10, dtype=np.float32).reshape(5, 2),
+        )
+
+
+class TestAmend:
+    def test_amend_staged_row(self):
+        ring = make_ring(8, 2)
+        ring.add(make_steps(3, 2))
+        ring.amend_last(1, {"rewards": np.full((1,), 7.0, np.float32)})
+        ring.flush()
+        state = ring.state
+        assert float(np.asarray(state["data"]["rewards"])[2, 1, 0]) == 7.0
+        assert float(np.asarray(state["data"]["rewards"])[2, 0, 0]) == 0.0
+
+    def test_amend_flushed_row(self):
+        ring = make_ring(8, 2)
+        ring.add(make_steps(3, 2))
+        ring.flush()
+        ring.amend_last(0, {"rewards": np.full((1,), 5.0, np.float32)})
+        assert float(np.asarray(ring.state["data"]["rewards"])[2, 0, 0]) == 5.0
