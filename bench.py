@@ -28,8 +28,11 @@ comparison stays dominated by what the benchmark measures: the
 world-model/actor/critic training step and the per-step policy latency.
 
 Workloads:
-`python bench.py [dreamer_v3|dreamer_v3_S|dreamer_v3_S_b32|dreamer_v3_S_b64|
-dreamer_v2|dreamer_v1|ppo|a2c|sac]`.
+`python bench.py [dreamer_v3|dreamer_v3_devbuf|dreamer_v3_pipe|dreamer_v3_S|
+dreamer_v3_S_b32|dreamer_v3_S_b64|dreamer_v2|dreamer_v1|ppo|a2c|sac|
+sac_devbuf|sac_pipe]`. The `*_pipe` legs are the pipelined-interaction A/B
+(fabric.async_fetch, env.pipeline_slices — core/interact.py); every result
+embeds the interaction time split and overlap fraction from the long run.
 Reference baselines from BASELINE.md (README.md:83-180); `dreamer_v3_S` is
 the north-star-scale workload (S model at the Atari-100K recipe shape) vs
 the RTX 3080's ~1.98 env-steps/s.
@@ -189,6 +192,21 @@ def _timeboxed(
         "unit": "env-steps/sec",
         "vs_baseline": round(sps / baseline_sps, 3),
     }
+    # Interaction time split from the long run (core/interact.py): where the
+    # env-facing half of each step went — env stepping vs policy dispatch vs
+    # action fetch (blocked on host vs ridden under other work). The overlap
+    # fraction is the direct readout of the async-fetch win.
+    from sheeprl_tpu.core import interact
+
+    stats = interact.last_run_stats()
+    if stats is not None:
+        result["interaction"] = {
+            "env_step_s": round(stats["env_step_s"], 3),
+            "policy_dispatch_s": round(stats["policy_dispatch_s"], 3),
+            "fetch_blocked_s": round(stats["fetch_blocked_s"], 3),
+            "fetch_ride_s": round(stats["fetch_ride_s"], 3),
+            "overlap_fraction": round(stats["overlap_fraction"], 4),
+        }
     # Report the runtime semantics the number was measured under (mirror
     # sync mode, precision), so async/stale-weights or bf16 numbers are
     # never mistaken for tied-weights f32 ones.
@@ -215,7 +233,7 @@ def bench_a2c():
     )
 
 
-def bench_sac(device_buffer: bool = False):
+def bench_sac(device_buffer: bool = False, pipelined: bool = False):
     # README.md:139-140 — 65,536 steps in 320.21 s. Off-policy: the player
     # never blocks on the weight mirror (fabric.player_sync=async,
     # core/player.py) — SAC trains every env step, so a blocking mirror
@@ -229,6 +247,12 @@ def bench_sac(device_buffer: bool = False):
         # comparable between the two rows.
         extra += ["buffer.device=true", "algo.fused_train_steps=8"]
         suffix = "_devbuf"
+    if pipelined:
+        # A/B leg: pipelined interaction (core/interact.py) — async action
+        # fetch + 2 env slices software-pipelined over the 4 bench envs —
+        # vs the serial per-step fetch above. Same workload and baseline.
+        extra += ["fabric.async_fetch=true", "env.pipeline_slices=2"]
+        suffix = "_pipe"
     result = _timeboxed(
         f"sac{suffix}_env_steps_per_sec", "sac_benchmarks", 65536, 65536 / 320.21,
         learning_starts=100, warmup_steps=1024, start_steps=4096,
@@ -237,6 +261,8 @@ def bench_sac(device_buffer: bool = False):
     if device_buffer:
         result["buffer_device"] = True
         result["fused_train_steps"] = 8
+    if pipelined:
+        result["pipeline_slices"] = 2
     return result
 
 
@@ -249,7 +275,9 @@ def _accel_precision() -> str:
     return "bf16-mixed" if jax.default_backend() != "cpu" else "32-true"
 
 
-def _bench_dreamer(version: str, baseline_seconds: float, device_buffer: bool = False):
+def _bench_dreamer(
+    version: str, baseline_seconds: float, device_buffer: bool = False, pipelined: bool = False
+):
     # Off-policy: async weight mirror (see bench_sac). Precision is passed
     # explicitly so the result JSON records the semantics the number was
     # measured under.
@@ -260,6 +288,12 @@ def _bench_dreamer(version: str, baseline_seconds: float, device_buffer: bool = 
         # host buffer + ReplayInfeed.
         extra += ["buffer.device=true", "algo.fused_train_steps=8"]
         suffix = "_devbuf"
+    if pipelined:
+        # A/B leg: async action fetch + train-dispatch-before-harvest
+        # (core/interact.py). The bench recipe runs 1 env, so no slicing —
+        # the win here is the fetch riding under the fused-train dispatch.
+        extra += ["fabric.async_fetch=true"]
+        suffix = "_pipe"
     result = _timeboxed(
         f"dreamer_v{version}{suffix}_env_steps_per_sec",
         f"dreamer_v{version}_benchmarks",
@@ -362,6 +396,7 @@ def main() -> None:
     result = {
         "dreamer_v3": bench_dreamer_v3,
         "dreamer_v3_devbuf": lambda: _bench_dreamer("3", 1589.30, device_buffer=True),
+        "dreamer_v3_pipe": lambda: _bench_dreamer("3", 1589.30, pipelined=True),
         "dreamer_v3_S": bench_dreamer_v3_S,
         "dreamer_v3_S_b32": lambda: bench_dreamer_v3_S(batch=32),
         "dreamer_v3_S_b64": lambda: bench_dreamer_v3_S(batch=64),
@@ -371,6 +406,7 @@ def main() -> None:
         "a2c": bench_a2c,
         "sac": bench_sac,
         "sac_devbuf": lambda: bench_sac(device_buffer=True),
+        "sac_pipe": lambda: bench_sac(pipelined=True),
     }[which]()
     result["backend"] = jax.default_backend()
     print(json.dumps(result))

@@ -32,4 +32,9 @@ python -m sheeprl_tpu.analysis --no-baseline sheeprl_tpu/telemetry/ || rc=1
 echo "== graftlint (data, no baseline) =="
 python -m sheeprl_tpu.analysis --no-baseline sheeprl_tpu/data/ || rc=1
 
+# The interaction pipeline is the module whose whole point is removing
+# blocking fetches (GL006): zero findings, no baseline, forever.
+echo "== graftlint (interact, no baseline) =="
+python -m sheeprl_tpu.analysis --no-baseline sheeprl_tpu/core/interact.py || rc=1
+
 exit "$rc"

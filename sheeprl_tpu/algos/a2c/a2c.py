@@ -25,6 +25,7 @@ from sheeprl_tpu.algos.a2c.utils import prepare_obs, test
 from sheeprl_tpu.algos.ppo.agent import PPOAgent, actions_metadata, build_agent
 from sheeprl_tpu.algos.ppo.loss import entropy_loss
 from sheeprl_tpu.config.instantiate import instantiate, locate
+from sheeprl_tpu.core.interact import InteractionPipeline
 from sheeprl_tpu.core.mesh import DATA_AXIS
 from sheeprl_tpu.core.player import PlayerPlacement
 from sheeprl_tpu.core.rollout import fuse_gae_pool, ship_rollout
@@ -219,6 +220,11 @@ def main(runtime, cfg: Dict[str, Any]):
     rollout_key, train_key = jax.random.split(jax.random.fold_in(runtime.root_key, rank))
     rollout_key = placement.put(rollout_key)
 
+    # Async-capable action fetch (core/interact.py): with fabric.async_fetch
+    # the D2H copy is submitted at dispatch time and harvested right before
+    # envs.step; off it is op-for-op the old blocking fetch.
+    pipeline = InteractionPipeline.from_config(cfg)
+
     # Coalesced loss fetch + interval bounding (telemetry/step_timer.py):
     # ONE block_until_ready + ONE device_get per log interval.
     train_timer = telemetry.step_timer("train", timer_key="Time/train_time")
@@ -242,10 +248,9 @@ def main(runtime, cfg: Dict[str, Any]):
                         placement.params(), np_obs, rollout_key
                     )
                     # Structural per-step sync (actions feed env.step):
-                    # accounted through the telemetry fetch.
-                    actions, real_actions_np, logprobs, values = telemetry.fetch(
-                        step_out, label="player_actions"
-                    )
+                    # submitted at dispatch, harvested at the use site.
+                    pending = pipeline.fetch(step_out, label="player_actions")
+                actions, real_actions_np, logprobs, values = pending.harvest()
 
                 obs, rewards, terminated, truncated, info = envs.step(
                     real_actions_np.reshape(envs.action_space.shape)
@@ -259,7 +264,10 @@ def main(runtime, cfg: Dict[str, Any]):
                     }
                     with placement.ctx():
                         jnp_next = prepare_obs(real_next_obs, mlp_keys=obs_keys, num_envs=len(truncated_envs))
-                        vals = np.asarray(get_values_fn(placement.params(), jnp_next))
+                        vals_pending = pipeline.fetch(
+                            get_values_fn(placement.params(), jnp_next), label="trunc_bootstrap"
+                        )
+                    vals = np.asarray(vals_pending.harvest())
                     rewards[truncated_envs] += cfg.algo.gamma * vals.reshape(rewards[truncated_envs].shape)
                 dones = np.logical_or(terminated, truncated).reshape(cfg.env.num_envs, -1).astype(np.uint8)
                 rewards = rewards.reshape(cfg.env.num_envs, -1).astype(np.float32)
@@ -370,6 +378,7 @@ def main(runtime, cfg: Dict[str, Any]):
             if runtime.is_global_zero:
                 save_checkpoint(ckpt_path, ckpt_state, keep_last=cfg.checkpoint.keep_last)
 
+    pipeline.publish()
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:
         test(agent, params, runtime, cfg, log_dir, logger)

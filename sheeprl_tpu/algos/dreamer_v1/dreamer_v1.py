@@ -36,6 +36,7 @@ from sheeprl_tpu.algos.dreamer_v1.utils import (
 from sheeprl_tpu.algos.dreamer_v2.dreamer_v2 import _make_optimizer
 from sheeprl_tpu.algos.ppo.agent import actions_metadata
 from sheeprl_tpu.config.instantiate import instantiate
+from sheeprl_tpu.core.interact import InteractionPipeline
 from sheeprl_tpu.core.mesh import DATA_AXIS
 from sheeprl_tpu.core.player import PlayerPlacement
 from sheeprl_tpu.data.infeed import ReplayInfeed
@@ -408,6 +409,11 @@ def main(runtime, cfg: Dict[str, Any]):
     rollout_key, train_key = jax.random.split(jax.random.fold_in(runtime.root_key, rank))
     rollout_key = placement.put(rollout_key)
 
+    # Async-capable action fetch (core/interact.py): with fabric.async_fetch
+    # the D2H copy is submitted at dispatch time and harvested right before
+    # envs.step; off it is op-for-op the old blocking fetch.
+    pipeline = InteractionPipeline.from_config(cfg)
+
     step_data = {}
     obs = envs.reset(seed=cfg.seed)[0]
     for k in obs_keys:
@@ -458,13 +464,12 @@ def main(runtime, cfg: Dict[str, Any]):
                     )
                 # One host fetch for both arrays: each separate np.asarray
                 # is a full device->host roundtrip (painful over a tunneled
-                # chip). Structural per-step sync: accounted through the
-                # telemetry fetch (one device_get, span + byte count).
-                actions, real_actions = telemetry.fetch(
-                    (actions_cat, real_actions_j), label="player_actions"
-                )
+                # chip). Submitted at dispatch, harvested at the last moment
+                # so the copy rides under the host bookkeeping in between.
+                pending = pipeline.fetch((actions_cat, real_actions_j), label="player_actions")
                 if aggregator and not aggregator.disabled:
                     aggregator.update("Params/exploration_amount", amount)
+                actions, real_actions = pending.harvest()
 
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 real_actions.reshape(envs.action_space.shape)
@@ -620,6 +625,7 @@ def main(runtime, cfg: Dict[str, Any]):
                 save_checkpoint(ckpt_path, ckpt_state, keep_last=cfg.checkpoint.keep_last)
 
     infeed.close()
+    pipeline.publish()
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:
         test(agent, agent_state, runtime, cfg, log_dir, logger)

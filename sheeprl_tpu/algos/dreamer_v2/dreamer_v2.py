@@ -36,6 +36,7 @@ from sheeprl_tpu.algos.dreamer_v2.loss import reconstruction_loss
 from sheeprl_tpu.algos.dreamer_v2.utils import compute_lambda_values, normalize_player_obs, prepare_obs, test
 from sheeprl_tpu.algos.ppo.agent import actions_metadata
 from sheeprl_tpu.config.instantiate import instantiate, locate
+from sheeprl_tpu.core.interact import InteractionPipeline
 from sheeprl_tpu.core.mesh import DATA_AXIS
 from sheeprl_tpu.core.player import PlayerPlacement
 from sheeprl_tpu.data.infeed import ReplayInfeed
@@ -473,6 +474,11 @@ def main(runtime, cfg: Dict[str, Any]):
     rollout_key, train_key = jax.random.split(jax.random.fold_in(runtime.root_key, rank))
     rollout_key = placement.put(rollout_key)
 
+    # Async-capable action fetch (core/interact.py): with fabric.async_fetch
+    # the D2H copy is submitted at dispatch time and harvested right before
+    # envs.step; off it is op-for-op the old blocking fetch.
+    pipeline = InteractionPipeline.from_config(cfg)
+
     step_data = {}
     obs = envs.reset(seed=cfg.seed)[0]
     for k in obs_keys:
@@ -501,6 +507,7 @@ def main(runtime, cfg: Dict[str, Any]):
         policy_step += policy_steps_per_iter
         telemetry.advance(policy_step)
 
+        pending = None
         with timer("Time/env_interaction_time"):
             if iter_num <= learning_starts and cfg.checkpoint.resume_from is None:
                 real_actions = actions = np.array(envs.action_space.sample())
@@ -521,15 +528,15 @@ def main(runtime, cfg: Dict[str, Any]):
                     )
                 # One host fetch for both arrays: each separate np.asarray
                 # is a full device->host roundtrip (painful over a tunneled
-                # chip). Structural per-step sync: accounted through the
-                # telemetry fetch (one device_get, span + byte count).
-                actions, real_actions = telemetry.fetch(
-                    (actions_cat, real_actions_j), label="player_actions"
-                )
+                # chip). Submitted at dispatch, harvested after the is_first
+                # bookkeeping so the copy rides under that host work.
+                pending = pipeline.fetch((actions_cat, real_actions_j), label="player_actions")
 
             step_data["is_first"] = copy.deepcopy(
                 np.logical_or(step_data["terminated"], step_data["truncated"]).astype(np.float32)
             )
+            if pending is not None:
+                actions, real_actions = pending.harvest()
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 real_actions.reshape(envs.action_space.shape)
             )
@@ -701,6 +708,7 @@ def main(runtime, cfg: Dict[str, Any]):
                 save_checkpoint(ckpt_path, ckpt_state, keep_last=cfg.checkpoint.keep_last)
 
     infeed.close()
+    pipeline.publish()
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:
         test(agent, agent_state, runtime, cfg, log_dir, logger)

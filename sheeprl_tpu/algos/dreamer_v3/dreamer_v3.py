@@ -40,6 +40,7 @@ from sheeprl_tpu.algos.dreamer_v3.loss import reconstruction_loss
 from sheeprl_tpu.algos.dreamer_v3.utils import normalize_player_obs, prepare_obs, test
 from sheeprl_tpu.algos.ppo.agent import actions_metadata
 from sheeprl_tpu.config.instantiate import instantiate, locate
+from sheeprl_tpu.core.interact import InteractionPipeline
 from sheeprl_tpu.core.mesh import DATA_AXIS
 from sheeprl_tpu.core.player import PlayerPlacement
 from sheeprl_tpu.data.infeed import ReplayInfeed
@@ -643,8 +644,34 @@ def main(runtime, cfg: Dict[str, Any]):
     rollout_key, train_key = jax.random.split(jax.random.fold_in(runtime.root_key, rank))
     rollout_key = placement.put(rollout_key)
 
+    # Pipelined interaction (core/interact.py): per-slice policy dispatch +
+    # async action fetch + double-buffered obs staging, with the recurrent
+    # player latents and the rollout PRNG key held per slice. slices=1/async
+    # off is bit-identical to the serial loop.
+    pipeline = InteractionPipeline.from_config(cfg)
+    pipeline.set_key(rollout_key)
+    single_action_shape = envs.single_action_space.shape
+    player_cnn_cfg_keys = cfg.algo.cnn_keys.encoder
+
+    def _pipeline_policy(np_obs, state, key):
+        with placement.ctx():
+            pp = placement.params()
+            actions_cat, real_actions_j, new_state, next_key = player_step_fn(
+                pp["world_model"], pp["actor"], state, np_obs, key
+            )
+        # One host fetch for both arrays: each separate np.asarray is a full
+        # device->host roundtrip (painful over a tunneled chip).
+        return (actions_cat, real_actions_j), new_state, next_key
+
+    def _prepare_slice(obs_slice, out=None):
+        n = len(next(iter(obs_slice.values())))
+        return prepare_obs(obs_slice, cnn_keys=player_cnn_cfg_keys, num_envs=n, out=out)
+
+    def _to_env_actions(host_outputs, n_envs):
+        return host_outputs[1].reshape((n_envs, *single_action_shape))
+
     step_data = {}
-    obs = envs.reset(seed=cfg.seed)[0]
+    obs = pipeline.stash_obs(envs.reset(seed=cfg.seed)[0])
     for k in obs_keys:
         step_data[k] = obs[k][np.newaxis]
     step_data["rewards"] = np.zeros((1, cfg.env.num_envs, 1), np.float32)
@@ -652,7 +679,7 @@ def main(runtime, cfg: Dict[str, Any]):
     step_data["terminated"] = np.zeros((1, cfg.env.num_envs, 1), np.float32)
     step_data["is_first"] = np.ones_like(step_data["terminated"])
     with placement.ctx():
-        player_state = init_player_fn(placement.params()["world_model"], cfg.env.num_envs)
+        pipeline.init_state(lambda n, _rng: init_player_fn(placement.params()["world_model"], n))
 
     cumulative_per_rank_gradient_steps = 0
     # Bound async in-flight train dispatches (core/runtime.py: an
@@ -665,10 +692,108 @@ def main(runtime, cfg: Dict[str, Any]):
     # memory is negligible.
     train_timer = telemetry.step_timer("train", timer_key="Time/train_time")
     keep_train_metrics = aggregator is not None and not aggregator.disabled and cfg.metric.log_level > 0
+
+    # The iteration's gradient steps, factored out so the pipelined
+    # interaction can dispatch them between the action-fetch submit and its
+    # harvest (pipeline.overlap_train): train compute then overlaps the D2H
+    # copy and the host env step, at the cost of train batches lagging the
+    # buffer by one transition.
+    def run_train(iter_num: int) -> None:
+        nonlocal agent_state, opt_states, moments_state, train_key
+        nonlocal cumulative_per_rank_gradient_steps, train_step_count
+        if iter_num < learning_starts:
+            return
+        ratio_steps = policy_step - prefill_steps * policy_steps_per_iter
+        per_rank_gradient_steps = ratio(ratio_steps / world_size)
+        if per_rank_gradient_steps > 0:
+            # Ship this interval's staged rollout rows in ONE donated
+            # write, then (if enough history is device-resident) train
+            # entirely from the ring: no host sampling, no per-step H2D.
+            if ring is not None and ring.active:
+                ring.flush()
+            use_ring = (
+                ring is not None
+                and ring.active
+                and ring.ready(cfg.algo.per_rank_sequence_length)
+            )
+            if use_ring:
+                with timer("Time/train_time"):
+                    remaining = per_rank_gradient_steps
+                    while remaining > 0:
+                        # Power-of-two buckets bound the number of fused
+                        # graphs to log2(fused_train_steps).
+                        k = 1 << (min(remaining, fused_train_steps).bit_length() - 1)
+                        taus = _target_update_taus(
+                            cumulative_per_rank_gradient_steps,
+                            k,
+                            cfg.algo.critic.per_rank_target_network_update_freq,
+                            cfg.algo.critic.tau,
+                        )
+                        with train_timer.step():
+                            agent_state, opt_states, moments_state, train_metrics, train_key = fused_train_fn(
+                                agent_state, opt_states, moments_state, ring.state,
+                                train_key, taus,
+                            )
+                        # Mean losses over the bucket (the scan stacks
+                        # them; one tree per dispatch keeps the flush
+                        # cheap).
+                        train_timer.pend(
+                            agent_state["world_model"],
+                            train_metrics if keep_train_metrics else None,
+                        )
+                        dispatch_throttle.add(train_metrics)
+                        cumulative_per_rank_gradient_steps += k
+                        remaining -= k
+                    placement.push(
+                        {"world_model": agent_state["world_model"], "actor": agent_state["actor"]}
+                    )
+                    train_step_count += world_size
+            else:
+                batches = infeed.take_or_sample(per_rank_gradient_steps)
+                with timer("Time/train_time"):
+                    for i in range(per_rank_gradient_steps):
+                        if (
+                            cumulative_per_rank_gradient_steps
+                            % cfg.algo.critic.per_rank_target_network_update_freq
+                            == 0
+                        ):
+                            tau = 1.0 if cumulative_per_rank_gradient_steps == 0 else cfg.algo.critic.tau
+                        else:
+                            tau = 0.0
+                        batch = batches[i]
+                        with train_timer.step():
+                            agent_state, opt_states, moments_state, train_metrics, train_key = train_fn(
+                                agent_state, opt_states, moments_state, batch, train_key,
+                                np.asarray(tau, np.float32),
+                            )
+                        # Feed EVERY gradient step's losses toward the log
+                        # (only sampling the last one under-reports the
+                        # training signal). No sync here: the dispatch stays
+                        # fully async — the StepTimer queues the scalars
+                        # device-side and bounds the interval's wall-clock
+                        # with ONE block at the log-interval flush.
+                        train_timer.pend(
+                            agent_state["world_model"],
+                            train_metrics if keep_train_metrics else None,
+                        )
+                        dispatch_throttle.add(train_metrics)
+                        cumulative_per_rank_gradient_steps += 1
+                    # One mirror refresh per train call (the player only acts
+                    # again after the whole gradient-step loop, so this is
+                    # exactly the reference's tied-weights freshness).
+                    placement.push(
+                        {"world_model": agent_state["world_model"], "actor": agent_state["actor"]}
+                    )
+                    train_step_count += world_size
+                # Sample on the main thread (no buffer race); stage the device
+                # copies to overlap the next env-step phase.
+                infeed.stage(per_rank_gradient_steps)
+
     for iter_num in range(start_iter, total_iters + 1):
         policy_step += policy_steps_per_iter
         telemetry.advance(policy_step)
 
+        trained_in_flight = False
         with timer("Time/env_interaction_time"):
             if iter_num <= learning_starts and cfg.checkpoint.resume_from is None:
                 real_actions = actions = np.array(envs.action_space.sample())
@@ -680,30 +805,43 @@ def main(runtime, cfg: Dict[str, Any]):
                         ],
                         axis=-1,
                     )
-            else:
-                with placement.ctx():
-                    np_obs = prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
-                    pp = placement.params()
-                    actions_cat, real_actions_j, player_state, rollout_key = player_step_fn(
-                        pp["world_model"], pp["actor"], player_state, np_obs, rollout_key
-                    )
-                # One host fetch for both arrays: each separate np.asarray
-                # is a full device->host roundtrip (painful over a tunneled
-                # chip). This per-step sync is structural (the actions must
-                # reach env.step on host), so it goes through the telemetry
-                # fetch — one device_get, accounted with a span + byte count.
-                actions, real_actions = telemetry.fetch(
-                    (actions_cat, real_actions_j), label="player_actions"
+                step_data["actions"] = actions.reshape((1, cfg.env.num_envs, -1))
+                rb.add(step_data, validate_args=cfg.buffer.validate_args)
+                if ring is not None:
+                    ring.add(step_data)
+                next_obs, rewards, terminated, truncated, infos = envs.step(
+                    real_actions.reshape(envs.action_space.shape)
                 )
-
-            step_data["actions"] = actions.reshape((1, cfg.env.num_envs, -1))
-            rb.add(step_data, validate_args=cfg.buffer.validate_args)
-            if ring is not None:
-                ring.add(step_data)
-
-            next_obs, rewards, terminated, truncated, infos = envs.step(
-                real_actions.reshape(envs.action_space.shape)
-            )
+                next_obs = pipeline.stash_obs(next_obs)
+            else:
+                # Overlap the train dispatch with the action copy + env step
+                # only once the buffer holds the serial order's transitions
+                # (train batches then lag the buffer by one step).
+                trained_in_flight = pipeline.overlap_train and iter_num > learning_starts + 1
+                res = pipeline.interact(
+                    envs,
+                    obs,
+                    _pipeline_policy,
+                    prepare=_prepare_slice,
+                    to_env_actions=_to_env_actions,
+                    before_harvest=(lambda: run_train(iter_num)) if trained_in_flight else None,
+                )
+                actions, real_actions = res.outputs
+                # The buffer row for step t (pre-step obs + the actions just
+                # taken) is written after the pipelined env step; nothing in
+                # it depends on the step's results, so the contents match the
+                # serial order exactly.
+                step_data["actions"] = actions.reshape((1, cfg.env.num_envs, -1))
+                rb.add(step_data, validate_args=cfg.buffer.validate_args)
+                if ring is not None:
+                    ring.add(step_data)
+                next_obs, rewards, terminated, truncated, infos = (
+                    res.obs,
+                    res.rewards,
+                    res.terminated,
+                    res.truncated,
+                    res.infos,
+                )
             dones = np.logical_or(terminated, truncated).astype(np.uint8)
 
         step_data["is_first"] = np.zeros_like(step_data["terminated"])
@@ -782,98 +920,19 @@ def main(runtime, cfg: Dict[str, Any]):
             step_data["is_first"][:, dones_idxes] = np.ones_like(step_data["is_first"][:, dones_idxes])
             reset_mask = np.zeros((cfg.env.num_envs,), np.float32)
             reset_mask[dones_idxes] = 1.0
-            with placement.ctx():
-                player_state = reset_player_fn(
-                    placement.params()["world_model"], player_state, jnp.asarray(reset_mask)
-                )
+
+            def _reset_slice_state(state, slice_range):
+                s0, s1 = slice_range
+                with placement.ctx():
+                    return reset_player_fn(
+                        placement.params()["world_model"], state, jnp.asarray(reset_mask[s0:s1])
+                    )
+
+            pipeline.map_state(_reset_slice_state)
 
         # ------------------------------------------------------- training
-        if iter_num >= learning_starts:
-            ratio_steps = policy_step - prefill_steps * policy_steps_per_iter
-            per_rank_gradient_steps = ratio(ratio_steps / world_size)
-            if per_rank_gradient_steps > 0:
-                # Ship this interval's staged rollout rows in ONE donated
-                # write, then (if enough history is device-resident) train
-                # entirely from the ring: no host sampling, no per-step H2D.
-                if ring is not None and ring.active:
-                    ring.flush()
-                use_ring = (
-                    ring is not None
-                    and ring.active
-                    and ring.ready(cfg.algo.per_rank_sequence_length)
-                )
-                if use_ring:
-                    with timer("Time/train_time"):
-                        remaining = per_rank_gradient_steps
-                        while remaining > 0:
-                            # Power-of-two buckets bound the number of fused
-                            # graphs to log2(fused_train_steps).
-                            k = 1 << (min(remaining, fused_train_steps).bit_length() - 1)
-                            taus = _target_update_taus(
-                                cumulative_per_rank_gradient_steps,
-                                k,
-                                cfg.algo.critic.per_rank_target_network_update_freq,
-                                cfg.algo.critic.tau,
-                            )
-                            with train_timer.step():
-                                agent_state, opt_states, moments_state, train_metrics, train_key = fused_train_fn(
-                                    agent_state, opt_states, moments_state, ring.state,
-                                    train_key, taus,
-                                )
-                            # Mean losses over the bucket (the scan stacks
-                            # them; one tree per dispatch keeps the flush
-                            # cheap).
-                            train_timer.pend(
-                                agent_state["world_model"],
-                                train_metrics if keep_train_metrics else None,
-                            )
-                            dispatch_throttle.add(train_metrics)
-                            cumulative_per_rank_gradient_steps += k
-                            remaining -= k
-                        placement.push(
-                            {"world_model": agent_state["world_model"], "actor": agent_state["actor"]}
-                        )
-                        train_step_count += world_size
-                else:
-                    batches = infeed.take_or_sample(per_rank_gradient_steps)
-                    with timer("Time/train_time"):
-                        for i in range(per_rank_gradient_steps):
-                            if (
-                                cumulative_per_rank_gradient_steps
-                                % cfg.algo.critic.per_rank_target_network_update_freq
-                                == 0
-                            ):
-                                tau = 1.0 if cumulative_per_rank_gradient_steps == 0 else cfg.algo.critic.tau
-                            else:
-                                tau = 0.0
-                            batch = batches[i]
-                            with train_timer.step():
-                                agent_state, opt_states, moments_state, train_metrics, train_key = train_fn(
-                                    agent_state, opt_states, moments_state, batch, train_key,
-                                    np.asarray(tau, np.float32),
-                                )
-                            # Feed EVERY gradient step's losses toward the log
-                            # (only sampling the last one under-reports the
-                            # training signal). No sync here: the dispatch stays
-                            # fully async — the StepTimer queues the scalars
-                            # device-side and bounds the interval's wall-clock
-                            # with ONE block at the log-interval flush.
-                            train_timer.pend(
-                                agent_state["world_model"],
-                                train_metrics if keep_train_metrics else None,
-                            )
-                            dispatch_throttle.add(train_metrics)
-                            cumulative_per_rank_gradient_steps += 1
-                        # One mirror refresh per train call (the player only acts
-                        # again after the whole gradient-step loop, so this is
-                        # exactly the reference's tied-weights freshness).
-                        placement.push(
-                            {"world_model": agent_state["world_model"], "actor": agent_state["actor"]}
-                        )
-                        train_step_count += world_size
-                    # Sample on the main thread (no buffer race); stage the device
-                    # copies to overlap the next env-step phase.
-                    infeed.stage(per_rank_gradient_steps)
+        if not trained_in_flight:
+            run_train(iter_num)
 
         # -------------------------------------------------------- logging
         should_log = cfg.metric.log_level > 0 and (
@@ -946,6 +1005,7 @@ def main(runtime, cfg: Dict[str, Any]):
             if runtime.is_global_zero:
                 save_checkpoint(ckpt_path, ckpt_state, keep_last=cfg.checkpoint.keep_last)
 
+    pipeline.publish()
     infeed.close()
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:

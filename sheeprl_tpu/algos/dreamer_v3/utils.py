@@ -32,23 +32,39 @@ MODELS_TO_REGISTER = {"world_model", "actor", "critic", "target_critic", "moment
 
 
 def prepare_obs(
-    obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), num_envs: int = 1, **kwargs: Any
+    obs: Dict[str, np.ndarray],
+    *,
+    cnn_keys: Sequence[str] = (),
+    num_envs: int = 1,
+    out: Dict[str, np.ndarray] = None,
+    **kwargs: Any,
 ) -> Dict[str, np.ndarray]:
     """Host obs → numpy arrays [num_envs, ...] ready to be jit inputs
     (reference: utils.py:80-91, without the CHW reshape — HWC layout).
 
     Pure numpy on purpose: each eager jnp op here would be a separate device
     dispatch per env step. Pixels stay uint8 and cross host→device packed;
-    `normalize_player_obs` applies the [-0.5, 0.5] scaling in-graph."""
-    out: Dict[str, np.ndarray] = {}
+    `normalize_player_obs` applies the [-0.5, 0.5] scaling in-graph.
+    ``out`` is a previous result reused as a preallocated staging dict
+    (core/interact.py ObsStager): float32 casts land in place; uint8 pixel
+    entries are zero-copy views either way."""
+    if out is not None:
+        for k, v in obs.items():
+            arr = np.asarray(v)
+            if k in cnn_keys:
+                out[k] = arr.reshape(num_envs, *arr.shape[-3:])
+            else:
+                np.copyto(out[k], arr.reshape(num_envs, -1))
+        return out
+    prepared: Dict[str, np.ndarray] = {}
     for k, v in obs.items():
         arr = np.asarray(v)
         if k in cnn_keys:
             arr = arr.reshape(num_envs, *arr.shape[-3:])
         else:
             arr = arr.reshape(num_envs, -1).astype(np.float32)
-        out[k] = arr
-    return out
+        prepared[k] = arr
+    return prepared
 
 
 def normalize_player_obs(obs: Dict[str, jax.Array], cnn_keys: Sequence[str]) -> Dict[str, jax.Array]:

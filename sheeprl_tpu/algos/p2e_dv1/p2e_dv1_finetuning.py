@@ -25,6 +25,7 @@ from sheeprl_tpu.algos.dreamer_v2.dreamer_v2 import _make_optimizer
 from sheeprl_tpu.algos.p2e_dv1.utils import exploration_amount, normalize_player_obs, prepare_obs, test
 from sheeprl_tpu.algos.ppo.agent import actions_metadata
 from sheeprl_tpu.config.instantiate import instantiate
+from sheeprl_tpu.core.interact import InteractionPipeline
 from sheeprl_tpu.core.player import PlayerPlacement
 from sheeprl_tpu.data.infeed import ReplayInfeed
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
@@ -230,6 +231,11 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
     rollout_key, train_key = jax.random.split(jax.random.fold_in(runtime.root_key, rank))
     rollout_key = placement.put(rollout_key)
 
+    # Async-capable action fetch (core/interact.py): with fabric.async_fetch
+    # the D2H copy is submitted at dispatch time and harvested right before
+    # envs.step; off it is op-for-op the old blocking fetch.
+    pipeline = InteractionPipeline.from_config(cfg)
+
     step_data = {}
     obs = envs.reset(seed=cfg.seed)[0]
     for k in obs_keys:
@@ -270,12 +276,12 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
                     rollout_key,
                     np.asarray(amount, np.float32),
                 )
-            # One host fetch for both arrays (single roundtrip).
-            actions, real_actions = telemetry.fetch(
-                (actions_cat, real_actions_j), label="player_actions"
-            )
+            # One host fetch for both arrays (single roundtrip): submitted
+            # at dispatch, harvested after the host bookkeeping in between.
+            pending = pipeline.fetch((actions_cat, real_actions_j), label="player_actions")
             if aggregator and not aggregator.disabled:
                 aggregator.update("Params/exploration_amount", amount)
+            actions, real_actions = pending.harvest()
 
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 real_actions.reshape(envs.action_space.shape)
@@ -435,6 +441,7 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
                 save_checkpoint(ckpt_path, ckpt_state, keep_last=cfg.checkpoint.keep_last)
 
     infeed.close()
+    pipeline.publish()
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:
         test(agent, agent_state, runtime, cfg, log_dir, logger)

@@ -35,6 +35,7 @@ import optax
 from sheeprl_tpu.algos.ppo.agent import PPOAgent, actions_metadata, build_agent
 from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
 from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
+from sheeprl_tpu.core.interact import InteractionPipeline
 from sheeprl_tpu.core.mesh import DATA_AXIS
 from sheeprl_tpu.core.player import PlayerPlacement
 from sheeprl_tpu.core.rollout import fuse_gae_pool, ship_rollout
@@ -290,13 +291,33 @@ def main(runtime, cfg: Dict[str, Any]):
     rollout_key, train_key = jax.random.split(jax.random.fold_in(runtime.root_key, rank))
     rollout_key = placement.put(rollout_key)
 
+    # Pipelined interaction (core/interact.py): per-slice policy dispatch +
+    # async action fetch + double-buffered obs staging. No train overlap here:
+    # on-policy keeps fresh-weights semantics (the whole rollout must see the
+    # post-update params, so train stays strictly between rollouts).
+    pipeline = InteractionPipeline.from_config(cfg)
+    pipeline.set_key(rollout_key)
+    single_action_shape = envs.single_action_space.shape
+
+    def _pipeline_policy(np_obs, state, key):
+        with placement.ctx():
+            *step_out, next_key = player_step_fn(placement.params(), np_obs, key)
+        return tuple(step_out), state, next_key
+
+    def _prepare_slice(obs_slice, out=None):
+        n = len(next(iter(obs_slice.values())))
+        return prepare_obs(obs_slice, cnn_keys=cnn_keys, num_envs=n, out=out)
+
+    def _to_env_actions(host_outputs, n_envs):
+        return host_outputs[1].reshape((n_envs, *single_action_shape))
+
     # --------------------------------------------------------------- loop
     # Coalesced loss fetch + interval bounding (telemetry/step_timer.py):
     # ONE block_until_ready + ONE device_get per log interval.
     train_timer = telemetry.step_timer("train", timer_key="Time/train_time")
     keep_train_metrics = aggregator is not None and not aggregator.disabled
     step_data = {}
-    next_obs = envs.reset(seed=cfg.seed)[0]
+    next_obs = pipeline.stash_obs(envs.reset(seed=cfg.seed)[0])
     for k in obs_keys:
         step_data[k] = next_obs[k][np.newaxis]
 
@@ -306,23 +327,24 @@ def main(runtime, cfg: Dict[str, Any]):
             policy_step += cfg.env.num_envs * world_size
 
             with timer("Time/env_interaction_time"):
-                with placement.ctx():
-                    # prepare_obs is pure numpy and the PRNG split + pixel
-                    # normalization live inside player_step: the jitted call
-                    # is the step's only device dispatch, and ONE host fetch
-                    # collects all outputs.
-                    np_obs = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
-                    *step_out, rollout_key = player_step_fn(
-                        placement.params(), np_obs, rollout_key
-                    )
-                    # Structural per-step sync (actions feed env.step):
-                    # accounted through the telemetry fetch.
-                    actions, real_actions_np, logprobs, values = telemetry.fetch(
-                        step_out, label="player_actions"
-                    )
-
-                obs, rewards, terminated, truncated, info = envs.step(
-                    real_actions_np.reshape(envs.action_space.shape)
+                # prepare_obs is pure numpy and the PRNG split + pixel
+                # normalization live inside player_step: the jitted call is
+                # the step's only device dispatch, and ONE (possibly async)
+                # fetch collects all outputs.
+                res = pipeline.interact(
+                    envs,
+                    next_obs,
+                    _pipeline_policy,
+                    prepare=_prepare_slice,
+                    to_env_actions=_to_env_actions,
+                )
+                actions, real_actions_np, logprobs, values = res.outputs
+                obs, rewards, terminated, truncated, info = (
+                    res.obs,
+                    res.rewards,
+                    res.terminated,
+                    res.truncated,
+                    res.infos,
                 )
                 truncated_envs = np.nonzero(truncated)[0]
                 if len(truncated_envs) > 0:
@@ -335,7 +357,10 @@ def main(runtime, cfg: Dict[str, Any]):
                     }
                     with placement.ctx():
                         jnp_next = prepare_obs(real_next_obs, cnn_keys=cnn_keys, num_envs=len(truncated_envs))
-                        vals = np.asarray(get_values_fn(placement.params(), jnp_next))
+                        vals_pending = pipeline.fetch(
+                            get_values_fn(placement.params(), jnp_next), label="trunc_bootstrap"
+                        )
+                    vals = np.asarray(vals_pending.harvest())
                     rewards[truncated_envs] += cfg.algo.gamma * vals.reshape(rewards[truncated_envs].shape)
                 dones = np.logical_or(terminated, truncated).reshape(cfg.env.num_envs, -1).astype(np.uint8)
                 rewards = clip_rewards_fn(rewards).reshape(cfg.env.num_envs, -1).astype(np.float32)
@@ -475,6 +500,7 @@ def main(runtime, cfg: Dict[str, Any]):
             if runtime.is_global_zero:
                 save_checkpoint(ckpt_path, ckpt_state, keep_last=cfg.checkpoint.keep_last)
 
+    pipeline.publish()
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:
         test(agent, params, runtime, cfg, log_dir, logger)

@@ -27,14 +27,30 @@ def normalize_obs(
 
 
 def prepare_obs(
-    obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), num_envs: int = 1, **kwargs: Any
+    obs: Dict[str, np.ndarray],
+    *,
+    cnn_keys: Sequence[str] = (),
+    num_envs: int = 1,
+    out: Dict[str, np.ndarray] = None,
+    **kwargs: Any,
 ) -> Dict[str, np.ndarray]:
     """Host obs dict → numpy arrays [num_envs, ...] ready to be jit inputs
     (reference: utils.py:25-35; no CHW reshape — pixels are already HWC).
 
     Pure numpy on purpose: each eager jnp op here would be a separate device
     dispatch per env step. Pixels stay uint8 (normalize_obs runs INSIDE the
-    player/train jits); vector keys become float32."""
+    player/train jits); vector keys become float32. ``out`` is a previous
+    result reused as a preallocated staging dict (core/interact.py
+    ObsStager): float32 casts land in place; uint8 pixel entries are
+    zero-copy views either way."""
+    if out is not None:
+        for k, v in obs.items():
+            arr = np.asarray(v)
+            if k not in cnn_keys:
+                np.copyto(out[k], arr.reshape(num_envs, -1))
+            else:
+                out[k] = arr.reshape(num_envs, *arr.shape[-3:])
+        return out
     np_obs = {}
     for k, v in obs.items():
         arr = np.asarray(v)

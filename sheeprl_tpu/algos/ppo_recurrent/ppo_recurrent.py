@@ -34,6 +34,7 @@ from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs
 from sheeprl_tpu.algos.ppo_recurrent.agent import RecurrentPPOAgent, build_agent
 from sheeprl_tpu.algos.ppo_recurrent.utils import test
 from sheeprl_tpu.config.instantiate import instantiate
+from sheeprl_tpu.core.interact import InteractionPipeline
 from sheeprl_tpu.core.mesh import DATA_AXIS
 from sheeprl_tpu.core.player import PlayerPlacement
 from sheeprl_tpu.data.buffers import ReplayBuffer
@@ -267,6 +268,11 @@ def main(runtime, cfg: Dict[str, Any]):
     rollout_key, train_key = jax.random.split(jax.random.fold_in(runtime.root_key, rank))
     rollout_key = placement.put(rollout_key)
 
+    # Async-capable action fetch (core/interact.py): with fabric.async_fetch
+    # the D2H copy is submitted at dispatch time and harvested right before
+    # envs.step; off it is op-for-op the old blocking fetch.
+    pipeline = InteractionPipeline.from_config(cfg)
+
     # ----------------------------------------------------------------- loop
     step_data = {}
     next_obs = envs.reset(seed=cfg.seed)[0]
@@ -295,12 +301,12 @@ def main(runtime, cfg: Dict[str, Any]):
                 # Single host fetch for the step outputs AND the pre-step
                 # carry snapshot the buffer stores (the post-step carry stays
                 # on device) — one device->host roundtrip instead of six.
-                # Structural per-step sync: accounted through the telemetry
-                # fetch (span + byte count).
-                actions, real_actions_np, logprobs, values, prev_cx_np, prev_hx_np = telemetry.fetch(
+                # Submitted at dispatch, harvested at the use site.
+                pending = pipeline.fetch(
                     (actions_j, real_actions_j, logprobs_j, values_j, prev_carry[0], prev_carry[1]),
                     label="player_actions",
                 )
+                actions, real_actions_np, logprobs, values, prev_cx_np, prev_hx_np = pending.harvest()
 
                 obs, rewards, terminated, truncated, info = envs.step(
                     real_actions_np.reshape(envs.action_space.shape)
@@ -317,14 +323,16 @@ def main(runtime, cfg: Dict[str, Any]):
                     with placement.ctx():
                         jnp_next = prepare_obs(real_next_obs, cnn_keys=cnn_keys, num_envs=len(truncated_envs))
                         trunc_carry = tuple(s[truncated_envs] for s in carry)
-                        vals = np.asarray(
+                        vals_pending = pipeline.fetch(
                             get_values_fn(
                                 placement.params(),
                                 jnp_next,
                                 jnp.asarray(actions[truncated_envs]),
                                 trunc_carry,
-                            )
+                            ),
+                            label="trunc_bootstrap",
                         )
+                    vals = np.asarray(vals_pending.harvest())
                     rewards[truncated_envs] += cfg.algo.gamma * vals.reshape(rewards[truncated_envs].shape)
                 dones = np.logical_or(terminated, truncated).reshape(cfg.env.num_envs, -1).astype(np.float32)
                 rewards = clip_rewards_fn(rewards).reshape(cfg.env.num_envs, -1).astype(np.float32)
@@ -504,6 +512,7 @@ def main(runtime, cfg: Dict[str, Any]):
             if runtime.is_global_zero:
                 save_checkpoint(ckpt_path, ckpt_state, keep_last=cfg.checkpoint.keep_last)
 
+    pipeline.publish()
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:
         test(agent, params, runtime, cfg, log_dir, logger)

@@ -32,6 +32,7 @@ from sheeprl_tpu.algos.sac.agent import SACAgent, build_agent
 from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.config.instantiate import instantiate, locate
+from sheeprl_tpu.core.interact import InteractionPipeline
 from sheeprl_tpu.core.mesh import DATA_AXIS
 from sheeprl_tpu.core.player import PlayerPlacement
 from sheeprl_tpu.data.buffers import ReplayBuffer
@@ -324,8 +325,27 @@ def main(runtime, cfg: Dict[str, Any]):
     rollout_key, train_key = jax.random.split(jax.random.fold_in(runtime.root_key, rank))
     rollout_key = placement.put(rollout_key)
 
+    # Pipelined interaction (core/interact.py): per-slice policy dispatch +
+    # async action fetch + double-buffered obs staging. slices=1/async off is
+    # bit-identical to the serial loop.
+    pipeline = InteractionPipeline.from_config(cfg)
+    pipeline.set_key(rollout_key)
+    single_action_shape = envs.single_action_space.shape
+
+    def _pipeline_policy(np_obs, state, key):
+        with placement.ctx():
+            actions_j, next_key = player_fn(placement.params(), np_obs, key)
+        return actions_j, state, next_key
+
+    def _prepare_slice(obs_slice, out=None):
+        n = len(next(iter(obs_slice.values())))
+        return prepare_obs(obs_slice, mlp_keys=mlp_keys, num_envs=n, out=out)
+
+    def _to_env_actions(host_actions, n_envs):
+        return host_actions.reshape((n_envs, *single_action_shape))
+
     step_data = {}
-    obs = envs.reset(seed=cfg.seed)[0]
+    obs = pipeline.stash_obs(envs.reset(seed=cfg.seed)[0])
 
     cumulative_per_rank_gradient_steps = 0
     # Bound async in-flight train dispatches (core/runtime.py: an
@@ -338,23 +358,111 @@ def main(runtime, cfg: Dict[str, Any]):
     # memory is negligible.
     train_timer = telemetry.step_timer("train", timer_key="Time/train_time")
     keep_train_metrics = aggregator is not None and not aggregator.disabled and cfg.metric.log_level > 0
+
+    # The iteration's gradient steps, factored out so the pipelined
+    # interaction can dispatch them between the action-fetch submit and its
+    # harvest (pipeline.overlap_train): train compute then overlaps the D2H
+    # copy and the host env step, at the cost of train batches lagging the
+    # buffer by one transition.
+    def run_train(iter_num: int) -> None:
+        nonlocal agent_state, opt_states, train_key, train_step_count, cumulative_per_rank_gradient_steps
+        if iter_num < learning_starts:
+            return
+        per_rank_gradient_steps = ratio((policy_step - prefill_steps + policy_steps_per_iter) / world_size)
+        if per_rank_gradient_steps > 0:
+            if ring is not None and ring.active:
+                ring.flush()
+            use_ring = ring is not None and ring.active and ring.ready(ring_span)
+            if use_ring:
+                with timer("Time/train_time"):
+                    do_ema = iter_num % target_freq_iters == 0
+                    tau_eff = np.float32(agent.tau if do_ema else 0.0)
+                    remaining = per_rank_gradient_steps
+                    while remaining > 0:
+                        # Power-of-two buckets bound the fused graphs to
+                        # log2(fused_train_steps) variants.
+                        k = 1 << (min(remaining, fused_train_steps).bit_length() - 1)
+                        with train_timer.step():
+                            agent_state, opt_states, train_metrics, train_key = fused_train_fn(
+                                agent_state, opt_states, ring.state, train_key,
+                                np.full(k, tau_eff, np.float32),
+                            )
+                        train_timer.pend(
+                            agent_state["actor"], train_metrics if keep_train_metrics else None
+                        )
+                        dispatch_throttle.add(train_metrics)
+                        cumulative_per_rank_gradient_steps += k
+                        remaining -= k
+                    placement.push(agent_state["actor"])
+                train_step_count += world_size
+            else:
+                sample = rb.sample_tensors(
+                    batch_size=per_rank_gradient_steps * cfg.algo.per_rank_batch_size,
+                    sample_next_obs=cfg.buffer.sample_next_obs,
+                )
+                data = {
+                    k: np.asarray(v)
+                    .astype(np.float32)
+                    .reshape(per_rank_gradient_steps, cfg.algo.per_rank_batch_size, *np.asarray(v).shape[2:])
+                    for k, v in sample.items()
+                }
+                with timer("Time/train_time"):
+                    do_ema = iter_num % target_freq_iters == 0
+                    # tau as numpy (an eager jnp.asarray would dispatch);
+                    # the PRNG split happens inside the jit.
+                    with train_timer.step():
+                        agent_state, opt_states, train_metrics, train_key = train_fn(
+                            agent_state,
+                            opt_states,
+                            data,
+                            train_key,
+                            np.asarray(agent.tau if do_ema else 0.0, np.float32),
+                        )
+                    # No sync here: the dispatch stays fully async — the
+                    # StepTimer queues the loss scalars device-side and
+                    # bounds the interval with ONE block at the flush below.
+                    train_timer.pend(
+                        agent_state["actor"], train_metrics if keep_train_metrics else None
+                    )
+                    dispatch_throttle.add(train_metrics)
+                    placement.push(agent_state["actor"])
+                    cumulative_per_rank_gradient_steps += per_rank_gradient_steps
+                train_step_count += world_size
+
     for iter_num in range(start_iter, total_iters + 1):
         policy_step += policy_steps_per_iter
         telemetry.advance(policy_step)
 
+        trained_in_flight = False
         with timer("Time/env_interaction_time"):
             if iter_num <= learning_starts:
                 actions = envs.action_space.sample()
+                next_obs, rewards, terminated, truncated, infos = envs.step(
+                    actions.reshape(envs.action_space.shape)
+                )
+                next_obs = pipeline.stash_obs(next_obs)
             else:
-                with placement.ctx():
-                    np_obs = prepare_obs(obs, mlp_keys=mlp_keys, num_envs=cfg.env.num_envs)
-                    actions_j, rollout_key = player_fn(placement.params(), np_obs, rollout_key)
-                    # Structural per-step sync (actions must reach env.step on
-                    # host): accounted through the telemetry fetch.
-                    actions = telemetry.fetch(actions_j, label="player_actions")
-            next_obs, rewards, terminated, truncated, infos = envs.step(
-                actions.reshape(envs.action_space.shape)
-            )
+                # Overlap the train dispatch with the action copy + env step
+                # only once the buffer has at least one post-prefill
+                # transition (at the very first train the buffer would
+                # otherwise be one step short).
+                trained_in_flight = pipeline.overlap_train and iter_num > learning_starts + 1
+                res = pipeline.interact(
+                    envs,
+                    obs,
+                    _pipeline_policy,
+                    prepare=_prepare_slice,
+                    to_env_actions=_to_env_actions,
+                    before_harvest=(lambda: run_train(iter_num)) if trained_in_flight else None,
+                )
+                actions, next_obs, rewards, terminated, truncated, infos = (
+                    res.outputs,
+                    res.obs,
+                    res.rewards,
+                    res.terminated,
+                    res.truncated,
+                    res.infos,
+                )
             rewards = rewards.reshape(cfg.env.num_envs, -1)
 
         if cfg.metric.log_level > 0 and "final_info" in infos:
@@ -392,67 +500,8 @@ def main(runtime, cfg: Dict[str, Any]):
 
         obs = next_obs
 
-        if iter_num >= learning_starts:
-            per_rank_gradient_steps = ratio((policy_step - prefill_steps + policy_steps_per_iter) / world_size)
-            if per_rank_gradient_steps > 0:
-                if ring is not None and ring.active:
-                    ring.flush()
-                use_ring = ring is not None and ring.active and ring.ready(ring_span)
-                if use_ring:
-                    with timer("Time/train_time"):
-                        do_ema = iter_num % target_freq_iters == 0
-                        tau_eff = np.float32(agent.tau if do_ema else 0.0)
-                        remaining = per_rank_gradient_steps
-                        while remaining > 0:
-                            # Power-of-two buckets bound the fused graphs to
-                            # log2(fused_train_steps) variants.
-                            k = 1 << (min(remaining, fused_train_steps).bit_length() - 1)
-                            with train_timer.step():
-                                agent_state, opt_states, train_metrics, train_key = fused_train_fn(
-                                    agent_state, opt_states, ring.state, train_key,
-                                    np.full(k, tau_eff, np.float32),
-                                )
-                            train_timer.pend(
-                                agent_state["actor"], train_metrics if keep_train_metrics else None
-                            )
-                            dispatch_throttle.add(train_metrics)
-                            cumulative_per_rank_gradient_steps += k
-                            remaining -= k
-                        placement.push(agent_state["actor"])
-                    train_step_count += world_size
-                else:
-                    sample = rb.sample_tensors(
-                        batch_size=per_rank_gradient_steps * cfg.algo.per_rank_batch_size,
-                        sample_next_obs=cfg.buffer.sample_next_obs,
-                    )
-                    data = {
-                        k: np.asarray(v)
-                        .astype(np.float32)
-                        .reshape(per_rank_gradient_steps, cfg.algo.per_rank_batch_size, *np.asarray(v).shape[2:])
-                        for k, v in sample.items()
-                    }
-                    with timer("Time/train_time"):
-                        do_ema = iter_num % target_freq_iters == 0
-                        # tau as numpy (an eager jnp.asarray would dispatch);
-                        # the PRNG split happens inside the jit.
-                        with train_timer.step():
-                            agent_state, opt_states, train_metrics, train_key = train_fn(
-                                agent_state,
-                                opt_states,
-                                data,
-                                train_key,
-                                np.asarray(agent.tau if do_ema else 0.0, np.float32),
-                            )
-                        # No sync here: the dispatch stays fully async — the
-                        # StepTimer queues the loss scalars device-side and
-                        # bounds the interval with ONE block at the flush below.
-                        train_timer.pend(
-                            agent_state["actor"], train_metrics if keep_train_metrics else None
-                        )
-                        dispatch_throttle.add(train_metrics)
-                        placement.push(agent_state["actor"])
-                        cumulative_per_rank_gradient_steps += per_rank_gradient_steps
-                    train_step_count += world_size
+        if not trained_in_flight:
+            run_train(iter_num)
 
         should_log = cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
@@ -526,6 +575,7 @@ def main(runtime, cfg: Dict[str, Any]):
             if saved_tail is not None:
                 rb["truncated"][tail, :] = saved_tail
 
+    pipeline.publish()
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:
         test(agent, agent_state, runtime, cfg, log_dir, logger)

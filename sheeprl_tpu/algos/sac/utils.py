@@ -21,11 +21,25 @@ MODELS_TO_REGISTER = {"agent"}
 
 
 def prepare_obs(
-    obs: Dict[str, np.ndarray], *, mlp_keys: Sequence[str] = (), num_envs: int = 1, **kwargs: Any
+    obs: Dict[str, np.ndarray],
+    *,
+    mlp_keys: Sequence[str] = (),
+    num_envs: int = 1,
+    out: np.ndarray = None,
+    **kwargs: Any,
 ) -> np.ndarray:
     """Vector obs → single concatenated float32 numpy array [num_envs, D]
     (reference: utils.py:31-36). Numpy on purpose: eager jnp ops here would
-    each be a device dispatch per env step."""
+    each be a device dispatch per env step. ``out`` is a preallocated
+    [num_envs, D] staging buffer (core/interact.py ObsStager) written in
+    place instead of allocating."""
+    if out is not None:
+        col = 0
+        for k in mlp_keys:
+            a = np.asarray(obs[k], np.float32).reshape(num_envs, -1)
+            out[:, col : col + a.shape[1]] = a
+            col += a.shape[1]
+        return out
     return np.concatenate(
         [np.asarray(obs[k], np.float32) for k in mlp_keys], axis=-1
     ).reshape(num_envs, -1)

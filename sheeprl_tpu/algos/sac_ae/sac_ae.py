@@ -35,6 +35,7 @@ from sheeprl_tpu.algos.sac.sac import _make_optimizer
 from sheeprl_tpu.algos.sac_ae.agent import SACAEAgent, build_agent
 from sheeprl_tpu.algos.sac_ae.utils import normalize_pixels, prepare_obs, preprocess_obs, test
 from sheeprl_tpu.config.instantiate import instantiate
+from sheeprl_tpu.core.interact import InteractionPipeline
 from sheeprl_tpu.core.mesh import DATA_AXIS
 from sheeprl_tpu.core.player import PlayerPlacement
 from sheeprl_tpu.data.buffers import ReplayBuffer
@@ -335,8 +336,28 @@ def main(runtime, cfg: Dict[str, Any]):
     rollout_key, train_key = jax.random.split(jax.random.fold_in(runtime.root_key, rank))
     rollout_key = placement.put(rollout_key)
 
+    # Pipelined interaction (core/interact.py): per-slice policy dispatch +
+    # async action fetch + double-buffered obs staging. slices=1/async off is
+    # bit-identical to the serial loop. (No train overlap: sac_ae's train
+    # step is not fused, so the dispatch itself is the host work.)
+    pipeline = InteractionPipeline.from_config(cfg)
+    pipeline.set_key(rollout_key)
+    single_action_shape = envs.single_action_space.shape
+
+    def _pipeline_policy(np_obs, state, key):
+        with placement.ctx():
+            actions_j, next_key = player_fn(placement.params(), np_obs, key)
+        return actions_j, state, next_key
+
+    def _prepare_slice(obs_slice, out=None):
+        n = len(next(iter(obs_slice.values())))
+        return prepare_obs(obs_slice, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=n, out=out)
+
+    def _to_env_actions(host_actions, n_envs):
+        return host_actions.reshape((n_envs, *single_action_shape))
+
     step_data = {}
-    obs = envs.reset(seed=cfg.seed)[0]
+    obs = pipeline.stash_obs(envs.reset(seed=cfg.seed)[0])
 
     cumulative_per_rank_gradient_steps = 0
     # Bound async in-flight train dispatches (core/runtime.py: an
@@ -353,16 +374,26 @@ def main(runtime, cfg: Dict[str, Any]):
         with timer("Time/env_interaction_time"):
             if iter_num <= learning_starts:
                 actions = envs.action_space.sample()
+                next_obs, rewards, terminated, truncated, infos = envs.step(
+                    actions.reshape(envs.action_space.shape)
+                )
+                next_obs = pipeline.stash_obs(next_obs)
             else:
-                with placement.ctx():
-                    np_obs = prepare_obs(obs, cnn_keys=cnn_keys, mlp_keys=mlp_keys, num_envs=cfg.env.num_envs)
-                    actions_j, rollout_key = player_fn(placement.params(), np_obs, rollout_key)
-                    # Structural per-step sync (actions feed env.step):
-                    # accounted through the telemetry fetch.
-                    actions = telemetry.fetch(actions_j, label="player_actions")
-            next_obs, rewards, terminated, truncated, infos = envs.step(
-                actions.reshape(envs.action_space.shape)
-            )
+                res = pipeline.interact(
+                    envs,
+                    obs,
+                    _pipeline_policy,
+                    prepare=_prepare_slice,
+                    to_env_actions=_to_env_actions,
+                )
+                actions, next_obs, rewards, terminated, truncated, infos = (
+                    res.outputs,
+                    res.obs,
+                    res.rewards,
+                    res.terminated,
+                    res.truncated,
+                    res.infos,
+                )
             rewards = rewards.reshape(cfg.env.num_envs, -1)
 
         if cfg.metric.log_level > 0 and "final_info" in infos:
@@ -521,6 +552,7 @@ def main(runtime, cfg: Dict[str, Any]):
             if saved_tail is not None:
                 rb["truncated"][tail, :] = saved_tail
 
+    pipeline.publish()
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:
         test(agent, agent_state, runtime, cfg, log_dir, logger)

@@ -35,9 +35,24 @@ def preprocess_obs(obs: jax.Array, key: jax.Array, bits: int = 8) -> jax.Array:
 
 
 def prepare_obs(
-    obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), mlp_keys: Sequence[str] = (), num_envs: int = 1
+    obs: Dict[str, np.ndarray],
+    *,
+    cnn_keys: Sequence[str] = (),
+    mlp_keys: Sequence[str] = (),
+    num_envs: int = 1,
+    out: Dict[str, np.ndarray] = None,
 ) -> Dict[str, jax.Array]:
-    """Host obs dict -> device dict; pixels stay uint8 (normalized in-graph)."""
+    """Host obs dict -> device dict; pixels stay uint8 (normalized in-graph).
+    ``out`` is a previous result reused as a preallocated staging dict
+    (core/interact.py ObsStager): float32 casts land in place; uint8 pixel
+    entries are zero-copy views either way."""
+    if out is not None:
+        for k in cnn_keys:
+            arr = np.asarray(obs[k])
+            out[k] = arr.reshape(num_envs, *arr.shape[-3:])
+        for k in mlp_keys:
+            np.copyto(out[k], np.asarray(obs[k]).reshape(num_envs, -1))
+        return out
     out = {}
     for k in cnn_keys:
         arr = np.asarray(obs[k])

@@ -6,4 +6,5 @@ from sheeprl_tpu.analysis.rules import (  # noqa: F401
     gl003_import_surface,
     gl004_recompile,
     gl005_donation,
+    gl006_blocking_fetch,
 )

@@ -1,0 +1,175 @@
+"""GL006: blocking fetch in an interaction loop that has the async pipeline.
+
+Once a module imports `sheeprl_tpu.core.interact`, the async action-fetch
+helper is available, and the interaction hot path (the innermost loop that
+steps an env) has no excuse for a synchronous device->host fetch: a
+`jax.device_get` / `np.asarray` / `np.array` on an in-flight device value
+there blocks the host exactly where `InteractionPipeline.fetch(...)` +
+`pending.harvest()` would have let the transfer ride under the env step and
+host bookkeeping. GL002 covers generic per-iteration syncs; this rule is the
+stricter, targeted tier for interaction loops where the fix is mechanical.
+
+"In-flight device value" is approximated syntactically: the fetched name was
+bound from a call inside the same loop (the policy/jit dispatch), and the
+fetch sits in harvest position — an assignment RHS or a bare statement.
+Plain host arrays (subscripts, literals, loop-invariant names) and host->
+device staging (`np.asarray(x, dtype)` nested inside a dispatch call's
+arguments) do not fire.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional, Set
+
+from sheeprl_tpu.analysis.context import LintContext
+from sheeprl_tpu.analysis.registry import Rule, register_rule
+
+_BLOCKING_CALLS = {
+    "jax.device_get": "jax.device_get",
+    "jax.block_until_ready": "jax.block_until_ready",
+    "numpy.asarray": "np.asarray",
+    "numpy.array": "np.array",
+}
+_INTERACT_MODULE = "sheeprl_tpu.core.interact"
+
+
+def _imports_interact(tree: ast.Module) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            if any(a.name.startswith(_INTERACT_MODULE) for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            if node.module.startswith(_INTERACT_MODULE):
+                return True
+            if node.module == "sheeprl_tpu.core" and any(a.name == "interact" for a in node.names):
+                return True
+    return False
+
+
+def _is_env_step_call(node: ast.AST) -> bool:
+    """`<name-containing-env>.step(...)` — the vector-env step boundary."""
+    if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+        return False
+    if node.func.attr != "step":
+        return False
+    recv = node.func.value
+    return isinstance(recv, ast.Name) and "env" in recv.id.lower()
+
+
+def _loop_subtree(loop: ast.AST):
+    """Loop-body nodes, not descending into nested defs (their bodies run on
+    their own schedule) or nested loops (those are their own innermost hot
+    path)."""
+    stack = list(ast.iter_child_nodes(loop))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _call_bound_names(loop: ast.AST) -> Set[str]:
+    """Names assigned from a call inside the loop — in-flight dispatch
+    results (policy outputs, jit step outputs)."""
+    bound: Set[str] = set()
+    for node in _loop_subtree(loop):
+        value = None
+        targets = []
+        if isinstance(node, ast.Assign):
+            value, targets = node.value, node.targets
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)) and getattr(node, "value", None):
+            value, targets = node.value, [node.target]
+        if not isinstance(value, ast.Call):
+            continue
+        for t in targets:
+            elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+            for e in elts:
+                if isinstance(e, ast.Name):
+                    bound.add(e.id)
+    return bound
+
+
+@register_rule
+class BlockingFetchRule(Rule):
+    id = "GL006"
+    name = "blocking-fetch-in-interaction-loop"
+    rationale = (
+        "A synchronous device->host fetch inside the env interaction loop "
+        "blocks the host where InteractionPipeline.fetch would let the "
+        "transfer overlap env stepping."
+    )
+
+    def check(self, ctx: LintContext) -> None:
+        if not _imports_interact(ctx.tree):
+            return
+        innermost = _innermost_loop_index(ctx.tree)
+        # Loops that step an env directly in their own body tier.
+        interaction_loops: Dict[int, bool] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                interaction_loops[id(node)] = any(
+                    _is_env_step_call(n) for n in _loop_subtree(node)
+                )
+        bound_cache: Dict[int, Set[str]] = {}
+        for node in _harvest_position_calls(ctx.tree):
+            path = ctx.resolver.resolve(node.func)
+            if path not in _BLOCKING_CALLS:
+                continue
+            loop = innermost.get(id(node))
+            if loop is None or not interaction_loops.get(id(loop), False):
+                continue
+            if not node.args or not isinstance(node.args[0], ast.Name):
+                continue
+            if id(loop) not in bound_cache:
+                bound_cache[id(loop)] = _call_bound_names(loop)
+            if node.args[0].id not in bound_cache[id(loop)]:
+                continue
+            ctx.report(
+                self.id,
+                node,
+                f"`{_BLOCKING_CALLS[path]}` on in-flight `{node.args[0].id}` "
+                "inside the env interaction loop blocks the host; submit with "
+                "InteractionPipeline.fetch(...) at dispatch and harvest() "
+                "just before envs.step so the copy rides under host work",
+            )
+
+
+def _harvest_position_calls(tree: ast.Module):
+    """Calls in harvest position: an assignment RHS or a bare statement.
+    A blocking call nested inside another call's arguments is host->device
+    staging for the dispatch, not a device->host harvest."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            yield node.value
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)) and isinstance(
+            getattr(node, "value", None), ast.Call
+        ):
+            yield node.value
+        elif isinstance(node, ast.Expr) and isinstance(node.value, ast.Call):
+            yield node.value
+
+
+def _innermost_loop_index(tree: ast.Module) -> Dict[int, Optional[ast.AST]]:
+    """id(node) -> innermost enclosing for/while, None outside any loop.
+    Function boundaries reset the stack: a closure body is not 'inside' the
+    loop that merely defines it."""
+    index: Dict[int, Optional[ast.AST]] = {}
+
+    def visit(node: ast.AST, loop: Optional[ast.AST]) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_loop = loop
+            if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                child_loop = node
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                index[id(child)] = child_loop
+                visit(child, None)
+                continue
+            index[id(child)] = child_loop
+            visit(child, child_loop)
+
+    visit(tree, None)
+    return index

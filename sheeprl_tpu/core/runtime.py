@@ -240,6 +240,7 @@ class Runtime:
         model_axis: int = 1,
         player_device: str = "auto",
         player_sync: str = "fresh",
+        async_fetch: bool = False,
     ) -> None:
         self.requested_devices = devices
         self.num_nodes = num_nodes
@@ -247,10 +248,12 @@ class Runtime:
         self.accelerator = accelerator
         self.precision: Precision = resolve_precision(precision)
         self.model_axis = int(model_axis)
-        # Consumed by PlayerPlacement.resolve via cfg.fabric (core/player.py);
+        # Consumed by PlayerPlacement.resolve via cfg.fabric (core/player.py)
+        # and InteractionPipeline.from_config via cfg.fabric (core/interact.py);
         # mirrored here so `instantiate(cfg.fabric)` accepts the keys.
         self.player_device = str(player_device)
         self.player_sync = str(player_sync)
+        self.async_fetch = bool(async_fetch)
         self._mesh: Optional[mesh_lib.Mesh] = None
         self._launched = False
         self.seed: Optional[int] = None

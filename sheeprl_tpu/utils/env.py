@@ -288,6 +288,22 @@ def make_vector_env(
     if restart_on_exception:
         thunks = [partial(RestartOnException, t) for t in thunks]
     cls = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
-    envs = cls(thunks, autoreset_mode=gym.vector.AutoresetMode.SAME_STEP)
+    slices = int(cfg.env.get("pipeline_slices", 1) or 1)
+    if slices <= 1:
+        envs: gym.vector.VectorEnv = cls(
+            thunks, autoreset_mode=gym.vector.AutoresetMode.SAME_STEP
+        )
+    else:
+        # env.pipeline_slices > 1: one sub vector env per contiguous column
+        # range, presented as one num_envs-wide env (core/interact.py). Env
+        # order — and therefore per-env seeds, video capture on global env 0,
+        # and sub-env RNG streams — is preserved.
+        from sheeprl_tpu.core.interact import EnvSliceGroup, split_ranges
+
+        sub_envs = [
+            cls(thunks[s0:s1], autoreset_mode=gym.vector.AutoresetMode.SAME_STEP)
+            for s0, s1 in split_ranges(cfg.env.num_envs, slices)
+        ]
+        envs = EnvSliceGroup(sub_envs)
     seed_vector_spaces(envs, cfg.seed + base)
     return envs

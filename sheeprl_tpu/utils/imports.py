@@ -29,6 +29,32 @@ _IS_MLFLOW_AVAILABLE = module_available("mlflow")
 _IS_SUPER_MARIO_BROS_AVAILABLE = module_available("gym_super_mario_bros")
 
 
+_DMC_RUNTIME_REASON: "str | None | type[Ellipsis]" = ...
+
+
+def dmc_runtime_unusable_reason() -> "str | None":
+    """``None`` when a dm_control env can actually be constructed in this
+    process, else the capability error. Import availability alone is not
+    enough: dm_control can be installed yet unusable (e.g. headless
+    containers where ``MUJOCO_GL=egl`` finds no EGL driver and mujoco's GL
+    import fails). Probed once per process, with the cheapest vector-only
+    task."""
+    global _DMC_RUNTIME_REASON
+    if not _IS_DMC_AVAILABLE:
+        return "dm_control not installed"
+    if _DMC_RUNTIME_REASON is ...:
+        try:
+            from sheeprl_tpu.envs.dmc import DMCWrapper
+
+            env = DMCWrapper("cartpole", "balance", from_pixels=False, from_vectors=True, seed=0)
+            env.reset(seed=0)
+            env.close()
+            _DMC_RUNTIME_REASON = None
+        except Exception as e:  # capability probe: any failure means unusable
+            _DMC_RUNTIME_REASON = f"dm_control unusable here: {type(e).__name__}: {e}"
+    return _DMC_RUNTIME_REASON
+
+
 def require(flag: bool, package: str, extra: str) -> None:
     """Raise a uniform gate error for a missing optional dependency."""
     if not flag:

@@ -199,6 +199,13 @@ class TestDreamerV3:
     def test_dry_run_dmc_pixel_and_vector(self, tmp_path, monkeypatch):
         # Real dm_control walker-walk with the dual rgb+state observation.
         pytest.importorskip("dm_control")
+        # Capability gate, not just import gate: dm_control can be installed
+        # but unusable (headless container without an EGL driver).
+        from sheeprl_tpu.utils.imports import dmc_runtime_unusable_reason
+
+        reason = dmc_runtime_unusable_reason()
+        if reason is not None:
+            pytest.skip(reason)
         monkeypatch.setenv("MUJOCO_GL", os.environ.get("MUJOCO_GL", "egl"))
         args = dv3_overrides(**{"env.num_envs": 1})
         args = [a for a in args if not a.startswith("env=")]

@@ -1,0 +1,39 @@
+"""GL006 clean: actions ride the async pipeline, host-resident arrays stay
+unflagged, and the one knowingly-synchronous debug fetch is suppressed."""
+
+import jax
+import numpy as np
+
+from sheeprl_tpu.core.interact import InteractionPipeline
+
+
+def rollout(envs, policy_fn, params, obs, steps, pipeline: InteractionPipeline):
+    # The sanctioned shape: submit at dispatch, harvest just before step.
+    for _ in range(steps):
+        actions_j = policy_fn(params, obs)
+        pending = pipeline.fetch(actions_j, label="player_actions")
+        actions = pending.harvest()
+        obs, reward, term, trunc, info = envs.step(actions)
+    return obs
+
+
+def replay_rollout(envs, recorded_actions, steps):
+    # Host-resident actions: nothing in flight, nothing to overlap.
+    for t in range(steps):
+        acts = np.asarray(recorded_actions[t])
+        envs.step(acts)
+
+
+def fetch_after_rollout(outputs):
+    # One coalesced fetch outside any interaction loop.
+    return jax.device_get(outputs)
+
+
+def debug_rollout(envs, policy_fn, params, obs, steps):
+    for _ in range(steps):
+        out = policy_fn(params, obs)
+        # Deliberately synchronous: isolates device errors to the step
+        # that produced them while debugging NaNs.
+        acts = jax.device_get(out)  # graftlint: disable=GL006,GL002
+        obs, *_ = envs.step(acts)
+    return obs

@@ -1,0 +1,32 @@
+"""GL006 true positives: the async pipeline helper is imported, yet the
+interaction loop still fetches in-flight policy outputs synchronously."""
+
+import jax
+import numpy as np
+
+from sheeprl_tpu.core.interact import InteractionPipeline  # noqa: F401
+
+
+def rollout(envs, policy_fn, params, obs, steps):
+    for _ in range(steps):
+        actions_j = policy_fn(params, obs)
+        actions = jax.device_get(actions_j)  # <- GL006  # graftlint: disable=GL002
+        obs, reward, term, trunc, info = envs.step(actions)
+    return obs
+
+
+def rollout_asarray(vec_envs, policy_fn, params, obs):
+    while True:
+        out = policy_fn(params, obs)
+        actions = np.asarray(out)  # <- GL006
+        obs, reward, term, trunc, info = vec_envs.step(actions)
+        if term.all():
+            return obs
+
+
+def rollout_block(envs, step_fn, state, obs, steps):
+    for _ in range(steps):
+        acts = step_fn(state, obs)
+        jax.block_until_ready(acts)  # <- GL006  # graftlint: disable=GL002
+        obs, *_ = envs.step(acts)
+    return obs

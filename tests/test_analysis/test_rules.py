@@ -9,7 +9,7 @@ from sheeprl_tpu.analysis import lint_file
 
 FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
 
-ALL_RULE_IDS = ("GL001", "GL002", "GL003", "GL004", "GL005")
+ALL_RULE_IDS = ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006")
 
 
 def _lint_fixture(name):
@@ -77,4 +77,30 @@ def test_gl004_static_argnames_branching_is_allowed():
 
 def test_gl005_rebinding_result_is_allowed():
     findings, _ = _lint_fixture("gl005_clean.py")
+    assert findings == []
+
+
+def test_gl006_needs_the_interact_import():
+    """The rule only fires where the async helper is actually available —
+    the same loop without the import is GL002 territory, not GL006."""
+    from sheeprl_tpu.analysis import lint_source
+
+    src = (
+        "import jax\n"
+        "def rollout(envs, policy, obs, steps):\n"
+        "    for _ in range(steps):\n"
+        "        out = policy(obs)\n"
+        "        acts = jax.device_get(out)  # graftlint: disable=GL002\n"
+        "        obs, *_ = envs.step(acts)\n"
+    )
+    findings, _ = lint_source(src, path="no_import.py")
+    assert not any(f.rule == "GL006" for f in findings)
+    findings, _ = lint_source(
+        "from sheeprl_tpu.core import interact  # noqa: F401\n" + src, path="with_import.py"
+    )
+    assert any(f.rule == "GL006" for f in findings)
+
+
+def test_gl006_ignores_host_arrays_and_code_outside_the_loop():
+    findings, _ = _lint_fixture("gl006_clean.py")
     assert findings == []

@@ -1,0 +1,488 @@
+"""Pipelined interaction correctness (core/interact.py).
+
+The contract under test: ``pipeline_slices=1`` with async fetch off is
+BIT-identical to the serial loop; slicing changes nothing observable for a
+deterministic (key-free) policy — same trajectories, same autoreset
+bookkeeping, same recurrent-state evolution — because EnvSliceGroup seeds and
+steps its slices exactly like one big SyncVectorEnv; and async fetch strictly
+removes blocking device_get syncs from the hot path."""
+
+import time
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.core import interact as interact_mod
+from sheeprl_tpu.core.interact import (
+    EnvSliceGroup,
+    InteractionPipeline,
+    ObsStager,
+    merge_infos,
+    split_ranges,
+    tree_concat,
+    tree_slice,
+)
+from sheeprl_tpu.utils.env import seed_vector_spaces
+
+
+class ActEchoEnv(gym.Env):
+    """Deterministic env whose obs encodes (env_id, step, running action sum)
+    so any mis-routing of actions, slices, or autoresets changes the
+    trajectory bit-for-bit."""
+
+    def __init__(self, env_id: int, horizon: int):
+        self.observation_space = gym.spaces.Dict(
+            {"state": gym.spaces.Box(-np.inf, np.inf, (3,), np.float32)}
+        )
+        self.action_space = gym.spaces.Box(-1.0, 1.0, (2,), np.float32)
+        self._env_id = env_id
+        self._horizon = horizon
+        self._t = 0
+        self._acc = 0.0
+
+    def _obs(self):
+        return {"state": np.array([self._env_id, self._t, self._acc], np.float32)}
+
+    def reset(self, seed=None, options=None):
+        super().reset(seed=seed)
+        self._t = 0
+        # Seed-dependent start so slice seed offsets are part of the contract.
+        self._acc = 0.0 if seed is None else float(seed % 7)
+        return self._obs(), {}
+
+    def step(self, action):
+        a = float(np.sum(action))
+        self._t += 1
+        self._acc += a
+        terminated = self._t >= self._horizon
+        return self._obs(), a + self._env_id, terminated, False, {}
+
+
+def make_envs(num_envs, slices, horizons=None, seed=11):
+    horizons = horizons if horizons is not None else [4 + i for i in range(num_envs)]
+    thunks = [
+        (lambda i=i: gym.wrappers.RecordEpisodeStatistics(ActEchoEnv(i, horizons[i])))
+        for i in range(num_envs)
+    ]
+    if slices == 1:
+        envs = gym.vector.SyncVectorEnv(
+            thunks, autoreset_mode=gym.vector.AutoresetMode.SAME_STEP
+        )
+    else:
+        subs = [
+            gym.vector.SyncVectorEnv(
+                thunks[s0:s1], autoreset_mode=gym.vector.AutoresetMode.SAME_STEP
+            )
+            for s0, s1 in split_ranges(num_envs, slices)
+        ]
+        envs = EnvSliceGroup(subs)
+    seed_vector_spaces(envs, seed)
+    return envs
+
+
+def assert_infos_equal(a, b, path=""):
+    """Recursive info comparison, skipping the episode wall-clock keys
+    (``episode["t"]``/``"_t"`` measure real elapsed seconds and are
+    inherently nondeterministic)."""
+    if isinstance(a, dict):
+        assert isinstance(b, dict), path
+        keys_a = {k for k in a if k not in ("t", "_t")}
+        keys_b = {k for k in b if k not in ("t", "_t")}
+        assert keys_a == keys_b, f"{path}: {keys_a} != {keys_b}"
+        for k in keys_a:
+            assert_infos_equal(a[k], b[k], f"{path}/{k}")
+        return
+    arr_a, arr_b = np.asarray(a), np.asarray(b)
+    assert arr_a.shape == arr_b.shape, path
+    if arr_a.dtype == object:
+        for i, (xa, xb) in enumerate(zip(arr_a.ravel(), arr_b.ravel())):
+            if xa is None or xb is None:
+                assert xa is None and xb is None, f"{path}[{i}]"
+            else:
+                assert_infos_equal(xa, xb, f"{path}[{i}]")
+    else:
+        np.testing.assert_array_equal(arr_a, arr_b, err_msg=path)
+
+
+# ----------------------------------------------------------------- primitives
+def test_split_ranges_partition():
+    assert split_ranges(8, 1) == [(0, 8)]
+    assert split_ranges(8, 3) == [(0, 3), (3, 6), (6, 8)]
+    assert split_ranges(4, 4) == [(0, 1), (1, 2), (2, 3), (3, 4)]
+    with pytest.raises(ValueError):
+        split_ranges(2, 3)
+    with pytest.raises(ValueError):
+        split_ranges(2, 0)
+
+
+def test_tree_slice_concat_roundtrip():
+    tree = {"a": np.arange(12).reshape(6, 2), "b": {"c": np.arange(6)}}
+    parts = [tree_slice(tree, s0, s1) for s0, s1 in split_ranges(6, 3)]
+    back = tree_concat(parts)
+    np.testing.assert_array_equal(back["a"], tree["a"])
+    np.testing.assert_array_equal(back["b"]["c"], tree["b"]["c"])
+
+
+def test_merge_infos_fills_missing_slices():
+    infos = [
+        {},
+        {"final_obs": np.array([{"state": np.ones(3)}], dtype=object), "_final_obs": np.array([True])},
+    ]
+    merged = merge_infos(infos, [2, 1])
+    assert merged["_final_obs"].tolist() == [False, False, True]
+    assert merged["final_obs"][0] is None and merged["final_obs"][1] is None
+    assert merged["final_obs"][2] is not None
+
+
+# -------------------------------------------------- EnvSliceGroup equivalence
+@pytest.mark.parametrize("slices", [2, 4])
+def test_env_slice_group_matches_monolithic(slices):
+    """Same seeds, same actions -> bit-identical obs/rewards/flags/infos
+    (incl. SAME_STEP autoreset final_obs/final_info merging)."""
+    E, T = 4, 10
+    horizons = [4, 6, 3, 5]
+    env_a = make_envs(E, 1, horizons)
+    env_b = make_envs(E, slices, horizons)
+
+    obs_a, info_a = env_a.reset(seed=7)
+    obs_b, info_b = env_b.reset(seed=7)
+    assert_infos_equal(obs_a, obs_b)
+    assert_infos_equal(info_a, info_b)
+
+    # Batched action-space sampling parity (the off-policy prefill path).
+    np.testing.assert_array_equal(env_a.action_space.sample(), env_b.action_space.sample())
+
+    rng = np.random.default_rng(0)
+    for t in range(T):
+        acts = rng.uniform(-1.0, 1.0, (E, 2)).astype(np.float32)
+        res_a = env_a.step(acts)
+        res_b = env_b.step(acts)
+        assert_infos_equal(res_a[0], res_b[0])
+        np.testing.assert_array_equal(res_a[1], res_b[1])
+        np.testing.assert_array_equal(res_a[2], res_b[2])
+        np.testing.assert_array_equal(res_a[3], res_b[3])
+        assert_infos_equal(res_a[4], res_b[4])
+    env_a.close()
+    env_b.close()
+
+
+# ----------------------------------------------------- interact() equivalence
+def _prepare(obs_slice, out=None):
+    return np.asarray(obs_slice["state"], np.float32)
+
+
+def _to_env_actions(host, n):
+    return np.asarray(host).reshape(n, 2)
+
+
+def _rollout_serial_manual(T, seed=7):
+    """The exact loop every algo ran before this module existed."""
+    envs = make_envs(4, 1)
+    policy = jax.jit(
+        lambda s, k: (
+            jnp.tanh(s[:, :2] * 0.1)
+            + 0.01 * jax.random.normal(jax.random.split(k)[1], (s.shape[0], 2)),
+            jax.random.split(k)[0],
+        )
+    )
+    key = jax.random.PRNGKey(3)
+    obs = envs.reset(seed=seed)[0]
+    traj = []
+    for _ in range(T):
+        acts_j, key = policy(np.asarray(obs["state"], np.float32), key)
+        acts = jax.device_get(acts_j)
+        obs, rew, term, trunc, infos = envs.step(acts.reshape(4, 2))
+        traj.append((acts.copy(), obs["state"].copy(), rew.copy(), term.copy(), trunc.copy()))
+    envs.close()
+    return traj
+
+
+def test_interact_serial_bit_identical():
+    """slices=1 + async off: pipeline.interact is op-for-op the manual loop,
+    stochastic policy key threading included."""
+    T = 10
+    expected = _rollout_serial_manual(T)
+
+    envs = make_envs(4, 1)
+    policy = jax.jit(
+        lambda s, k: (
+            jnp.tanh(s[:, :2] * 0.1)
+            + 0.01 * jax.random.normal(jax.random.split(k)[1], (s.shape[0], 2)),
+            jax.random.split(k)[0],
+        )
+    )
+    pipeline = InteractionPipeline(4, slices=1, async_fetch=False)
+    pipeline.set_key(jax.random.PRNGKey(3))
+
+    def _policy(np_obs, state, key):
+        acts, next_key = policy(np_obs, key)
+        return acts, state, next_key
+
+    obs = pipeline.stash_obs(envs.reset(seed=7)[0])
+    for t in range(T):
+        res = pipeline.interact(envs, obs, _policy, prepare=_prepare, to_env_actions=_to_env_actions)
+        acts_e, obs_e, rew_e, term_e, trunc_e = expected[t]
+        np.testing.assert_array_equal(np.asarray(res.outputs), acts_e)
+        np.testing.assert_array_equal(res.obs["state"], obs_e)
+        np.testing.assert_array_equal(res.rewards, rew_e)
+        np.testing.assert_array_equal(res.terminated, term_e)
+        np.testing.assert_array_equal(res.truncated, trunc_e)
+        obs = res.obs
+    assert pipeline.stats.blocking_fetches == T
+    assert pipeline.stats.async_fetches == 0
+    envs.close()
+
+
+def _rollout_pipelined(slices, T=12, async_fetch=False, horizons=(4, 6, 3, 5)):
+    """Deterministic (key-free) policy rollout at a given slice count."""
+    envs = make_envs(4, slices, list(horizons))
+    policy = jax.jit(lambda s: jnp.tanh(s * 0.1)[:, :2])
+    pipeline = InteractionPipeline(4, slices=slices, async_fetch=async_fetch)
+
+    def _policy(np_obs, state, key):
+        return policy(np_obs), state, key
+
+    obs = pipeline.stash_obs(envs.reset(seed=7)[0])
+    traj = []
+    for _ in range(T):
+        res = pipeline.interact(envs, obs, _policy, prepare=_prepare, to_env_actions=_to_env_actions)
+        traj.append(
+            (
+                np.asarray(res.outputs).copy(),
+                res.obs["state"].copy(),
+                res.rewards.copy(),
+                np.asarray(res.terminated).copy(),
+                np.asarray(res.truncated).copy(),
+                res.infos,
+            )
+        )
+        obs = res.obs
+    envs.close()
+    return traj, pipeline
+
+
+@pytest.mark.parametrize("slices", [2, 4])
+def test_interact_sliced_matches_serial(slices):
+    """pipeline_slices in {1,2,4} with a deterministic policy: identical
+    trajectories AND identical autoreset info bookkeeping. Horizon 3 on env 2
+    puts an autoreset exactly at the slice boundary env of the S=2 split."""
+    base, _ = _rollout_pipelined(1)
+    other, _ = _rollout_pipelined(slices)
+    terminated_any = False
+    for t, (a, b) in enumerate(zip(base, other)):
+        for x, y in zip(a[:5], b[:5]):
+            np.testing.assert_array_equal(x, y, err_msg=f"step {t}")
+        assert_infos_equal(a[5], b[5], f"step {t} infos")
+        terminated_any = terminated_any or bool(a[3].any())
+    assert terminated_any, "test must cover autoresets"
+
+
+@pytest.mark.parametrize("slices", [2, 4])
+def test_interact_recurrent_state_sliced_matches_serial(slices):
+    """Per-slice recurrent state (init_state/map_state): running-sum carry
+    with masked reset on done envs, bit-identical across slice counts."""
+
+    def run(S, T=12):
+        envs = make_envs(4, S, [4, 6, 3, 5])
+        # clip/add/mul only: bit-stable across batch shapes (XLA's tanh
+        # codegen is not, and that would mask real routing bugs here).
+        step_fn = jax.jit(
+            lambda s, c: (
+                jnp.clip((c + s.sum(1, keepdims=True)) * 0.05, -1.0, 1.0).repeat(2, 1),
+                c + s.sum(1, keepdims=True),
+            )
+        )
+        pipeline = InteractionPipeline(4, slices=S)
+        pipeline.init_state(lambda n, rng: jnp.zeros((n, 1), jnp.float32))
+
+        def _policy(np_obs, state, key):
+            acts, new_state = step_fn(np_obs, state)
+            return acts, new_state, key
+
+        obs = pipeline.stash_obs(envs.reset(seed=7)[0])
+        traj = []
+        for _ in range(T):
+            res = pipeline.interact(
+                envs, obs, _policy, prepare=_prepare, to_env_actions=_to_env_actions
+            )
+            dones = np.logical_or(res.terminated, res.truncated).astype(np.float32)
+            if dones.any():
+                pipeline.map_state(
+                    lambda st, rng: st * (1.0 - dones[rng[0] : rng[1], None])
+                )
+            traj.append((np.asarray(res.outputs).copy(), res.obs["state"].copy(), dones.copy()))
+            obs = res.obs
+        final_state = np.asarray(tree_concat([np.asarray(s) for s in pipeline.states]))
+        envs.close()
+        return traj, final_state
+
+    base, state_base = run(1)
+    other, state_other = run(slices)
+    for t, (a, b) in enumerate(zip(base, other)):
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y, err_msg=f"step {t}")
+    np.testing.assert_array_equal(state_base, state_other)
+
+
+# ------------------------------------------------------------ async fetch A/B
+def test_async_fetch_strictly_fewer_blocking_syncs():
+    """The acceptance A/B: per rollout, the pipelined (async) path performs
+    STRICTLY fewer blocking fetch syncs than the serial path — zero, vs one
+    per step per slice."""
+    T = 12
+    _, serial = _rollout_pipelined(2, T=T, async_fetch=False)
+    _, pipelined = _rollout_pipelined(2, T=T, async_fetch=True)
+    assert serial.stats.blocking_fetches == T * 2
+    assert serial.stats.async_fetches == 0
+    assert pipelined.stats.blocking_fetches == 0
+    assert pipelined.stats.async_fetches == T * 2
+    assert pipelined.stats.async_fetch_bytes > 0
+    assert pipelined.stats.blocking_fetches < serial.stats.blocking_fetches
+
+
+def test_overlap_fraction_positive_with_async_fetch():
+    """With async fetch on and host work between submit and harvest (the
+    before_harvest train slot), ride time accrues: overlap_fraction > 0."""
+    envs = make_envs(4, 1)
+    policy = jax.jit(lambda s: jnp.tanh(s * 0.1)[:, :2])
+    pipeline = InteractionPipeline(4, slices=1, async_fetch=True)
+
+    def _policy(np_obs, state, key):
+        return policy(np_obs), state, key
+
+    obs = pipeline.stash_obs(envs.reset(seed=7)[0])
+    for _ in range(4):
+        res = pipeline.interact(
+            envs,
+            obs,
+            _policy,
+            prepare=_prepare,
+            to_env_actions=_to_env_actions,
+            before_harvest=lambda: time.sleep(0.002),
+        )
+        obs = res.obs
+    stats = pipeline.publish()
+    assert stats["overlap_fraction"] > 0.0
+    assert interact_mod.last_run_stats() == stats
+    envs.close()
+
+
+# ------------------------------------------------------------------ ObsStager
+def test_obs_stager_ping_pongs_two_buffers():
+    calls = []
+
+    def prepare(obs, out=None):
+        if out is None:
+            out = {"state": obs["state"].astype(np.float32).copy()}
+        else:
+            np.copyto(out["state"], obs["state"])
+        calls.append(out)
+        return out
+
+    stager = ObsStager(prepare)
+    a = stager({"state": np.full((2, 3), 1.0)})
+    b = stager({"state": np.full((2, 3), 2.0)})
+    c = stager({"state": np.full((2, 3), 3.0)})
+    d = stager({"state": np.full((2, 3), 4.0)})
+    assert a["state"] is c["state"] and b["state"] is d["state"]
+    assert a["state"] is not b["state"]
+    # Buffer t-1 stays intact while t stages (the in-flight-transfer window).
+    np.testing.assert_array_equal(c["state"], np.full((2, 3), 3.0))
+    np.testing.assert_array_equal(d["state"], np.full((2, 3), 4.0))
+
+
+def test_stash_obs_survives_env_buffer_reuse():
+    pipeline = InteractionPipeline(2)
+    env_buf = {"state": np.zeros((2, 3), np.float32)}
+    first = pipeline.stash_obs(env_buf)
+    env_buf["state"][:] = 99.0  # the vector env overwriting its buffer
+    np.testing.assert_array_equal(first["state"], np.zeros((2, 3)))
+    second = pipeline.stash_obs(env_buf)
+    np.testing.assert_array_equal(second["state"], np.full((2, 3), 99.0))
+    np.testing.assert_array_equal(first["state"], np.zeros((2, 3)))
+    third = pipeline.stash_obs(env_buf)
+    assert third["state"] is first["state"]  # ping-pong reuse
+
+
+# ------------------------------------------------------- end-to-end algo runs
+class TestAlgoPipelined:
+    """Full training runs with the pipeline enabled via config: sliced envs
+    (env.pipeline_slices=2) + async action fetch (fabric.async_fetch=True)
+    through make_vector_env, Runtime, and the threaded train loops."""
+
+    @pytest.fixture(autouse=True)
+    def _chdir_tmp(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)  # runs write ./logs relative to cwd
+
+    def test_sac_async_sliced(self):
+        from sheeprl_tpu.cli import run
+
+        run([
+            "exp=sac",
+            "env=dummy",
+            "env.id=continuous_dummy",
+            "env.wrapper.id=continuous_dummy",
+            "metric.log_level=0",
+            "env.num_envs=2",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "env.pipeline_slices=2",
+            "fabric.async_fetch=True",
+            "algo.total_steps=16",
+            "algo.per_rank_batch_size=4",
+            "algo.learning_starts=4",
+            "algo.hidden_size=8",
+            "buffer.memmap=False",
+            "buffer.size=64",
+            "checkpoint.every=0",
+            "fabric.accelerator=cpu",
+        ])
+        stats = interact_mod.last_run_stats()
+        assert stats is not None and stats["steps"] > 0
+        assert stats["async_fetches"] > 0 and stats["blocking_fetches"] == 0
+
+    def test_ppo_async_sliced(self):
+        from sheeprl_tpu.cli import run
+
+        run([
+            "exp=ppo",
+            "env=dummy",
+            "dry_run=True",
+            "metric.log_level=0",
+            "env.num_envs=2",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "env.pipeline_slices=2",
+            "fabric.async_fetch=True",
+            "algo.rollout_steps=8",
+            "algo.per_rank_batch_size=4",
+            "algo.update_epochs=2",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.encoder.cnn_features_dim=16",
+            "algo.encoder.mlp_features_dim=8",
+            "algo.mlp_keys.encoder=[state]",
+            "buffer.memmap=False",
+            "checkpoint.every=0",
+            "fabric.accelerator=cpu",
+        ])
+        stats = interact_mod.last_run_stats()
+        assert stats is not None and stats["steps"] > 0
+        assert stats["async_fetches"] > 0 and stats["blocking_fetches"] == 0
+
+
+def test_interact_rejects_mismatched_slice_env():
+    envs = make_envs(4, 1)
+    pipeline = InteractionPipeline(4, slices=2)
+    with pytest.raises(ValueError, match="EnvSliceGroup"):
+        pipeline.interact(
+            envs,
+            envs.reset(seed=0)[0],
+            lambda o, s, k: (np.zeros((4, 2), np.float32), s, k),
+            prepare=_prepare,
+            to_env_actions=_to_env_actions,
+        )
+    envs.close()

@@ -96,6 +96,12 @@ def test_two_process_allgather_and_log_dir_broadcast(tmp_path):
         for p in procs:
             if p.poll() is None:
                 p.kill()
+    # Capability gate: some jaxlib builds simply do not implement
+    # multi-process coordination on the CPU backend. That is an environment
+    # limitation, not a regression in the primitives under test.
+    _CPU_BACKEND_UNSUPPORTED = "Multiprocess computations aren't implemented on the CPU backend"
+    if any(p.returncode != 0 and _CPU_BACKEND_UNSUPPORTED in out for p, out in zip(procs, outs)):
+        pytest.skip(f"jaxlib capability: {_CPU_BACKEND_UNSUPPORTED}")
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out}"
         assert f"proc {i} OK" in out

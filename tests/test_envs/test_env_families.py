@@ -16,12 +16,17 @@ from sheeprl_tpu.utils.imports import (
     _IS_MINEDOJO_AVAILABLE,
     _IS_MINERL_AVAILABLE,
     _IS_SUPER_MARIO_BROS_AVAILABLE,
+    dmc_runtime_unusable_reason,
 )
 
 os.environ.setdefault("MUJOCO_GL", "egl")
 
+# Capability gate, not just import gate: dm_control can be installed but
+# unusable (headless container without an EGL driver) — probe a real env.
+_DMC_UNUSABLE = dmc_runtime_unusable_reason()
 
-@pytest.mark.skipif(not _IS_DMC_AVAILABLE, reason="dm_control not installed")
+
+@pytest.mark.skipif(_DMC_UNUSABLE is not None, reason=str(_DMC_UNUSABLE))
 class TestDMC:
     def test_dual_observation_and_rescaled_actions(self):
         from sheeprl_tpu.envs.dmc import DMCWrapper
