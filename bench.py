@@ -30,9 +30,12 @@ world-model/actor/critic training step and the per-step policy latency.
 Workloads:
 `python bench.py [dreamer_v3|dreamer_v3_devbuf|dreamer_v3_pipe|dreamer_v3_S|
 dreamer_v3_S_b32|dreamer_v3_S_b64|dreamer_v2|dreamer_v1|ppo|a2c|sac|
-sac_devbuf|sac_pipe]`. The `*_pipe` legs are the pipelined-interaction A/B
-(fabric.async_fetch, env.pipeline_slices — core/interact.py); every result
-embeds the interaction time split and overlap fraction from the long run.
+sac_devbuf|sac_pipe|sac_resilience]`. The `*_pipe` legs are the
+pipelined-interaction A/B (fabric.async_fetch, env.pipeline_slices —
+core/interact.py); every result embeds the interaction time split and
+overlap fraction from the long run. `sac_resilience` is the fault-tolerance
+A/B (resilience=on vs the plain `sac` row, <2% target) and also reports the
+atomic checkpoint save cost directly.
 Reference baselines from BASELINE.md (README.md:83-180); `dreamer_v3_S` is
 the north-star-scale workload (S model at the Atari-100K recipe shape) vs
 the RTX 3080's ~1.98 env-steps/s.
@@ -266,6 +269,65 @@ def bench_sac(device_buffer: bool = False, pipelined: bool = False):
     return result
 
 
+def _bench_checkpoint_save(reps: int = 5):
+    """Direct cost of one atomic checkpoint save — stage + digest + fsync +
+    rename (utils/checkpoint.py) — on a synthetic SAC-sized state (six
+    256-wide f32 layers plus Adam moments, ~3 MB of leaves)."""
+    import tempfile
+
+    import numpy as np
+
+    from sheeprl_tpu.utils.checkpoint import save_checkpoint
+
+    rng = np.random.default_rng(0)
+
+    def layer():
+        return {"w": rng.standard_normal((256, 256)).astype(np.float32), "b": np.zeros(256, np.float32)}
+
+    state = {
+        "agent": {f"layer{i}": layer() for i in range(6)},
+        "opt": {f"layer{i}": {"m": layer(), "v": layer()} for i in range(2)},
+        "iter_num": 1,
+    }
+    payload_mb = sum(
+        a.nbytes for g in ("agent", "opt") for a in _tree_leaves(state[g])
+    ) / 2**20
+    times = []
+    with tempfile.TemporaryDirectory() as d:
+        for r in range(reps):
+            t0 = time.perf_counter()
+            save_checkpoint(os.path.join(d, f"ckpt_{8 * (r + 1)}_0.ckpt"), state, keep_last=2)
+            times.append(time.perf_counter() - t0)
+    return {
+        "median_s": round(sorted(times)[len(times) // 2], 4),
+        "reps": reps,
+        "payload_mb": round(payload_mb, 1),
+    }
+
+
+def _tree_leaves(tree):
+    import jax
+
+    return jax.tree_util.tree_leaves(tree)
+
+
+def bench_sac_resilience():
+    # A/B leg: the full fault-tolerance stack armed (preemption guard, env
+    # supervisor, dispatch watchdog — core/resilience.py) on the same SAC
+    # workload and baseline as the plain `sac` row. The acceptance target is
+    # this row's env-steps/s within 2% of `sac`'s: the guard is a flag check
+    # per iteration, the supervisor a try/except per slice step, the watchdog
+    # one condvar arm/disarm per dispatch.
+    result = _timeboxed(
+        "sac_resilience_env_steps_per_sec", "sac_benchmarks", 65536, 65536 / 320.21,
+        learning_starts=100, warmup_steps=1024, start_steps=4096,
+        extra=("fabric.player_sync=async", "resilience=on"),
+    )
+    result["resilience"] = {"preemption": True, "supervisor": True, "watchdog": True}
+    result["checkpoint_save"] = _bench_checkpoint_save()
+    return result
+
+
 def _accel_precision() -> str:
     """bf16-mixed on an accelerator (the TPU recipe default, PROFILE.md A/B);
     32-true on a CPU fallback — XLA:CPU bf16 is emulation, and the reference
@@ -407,6 +469,7 @@ def main() -> None:
         "sac": bench_sac,
         "sac_devbuf": lambda: bench_sac(device_buffer=True),
         "sac_pipe": lambda: bench_sac(pipelined=True),
+        "sac_resilience": bench_sac_resilience,
     }[which]()
     result["backend"] = jax.default_backend()
     print(json.dumps(result))

@@ -37,4 +37,11 @@ python -m sheeprl_tpu.analysis --no-baseline sheeprl_tpu/data/ || rc=1
 echo "== graftlint (interact, no baseline) =="
 python -m sheeprl_tpu.analysis --no-baseline sheeprl_tpu/core/interact.py || rc=1
 
+# The fault-tolerance surface must itself be fault-tolerant: the atomic
+# checkpoint writer and the resilience/chaos modules hold zero findings
+# (GL007 non-atomic persistence included), no baseline, forever.
+echo "== graftlint (resilience + checkpoint, no baseline) =="
+python -m sheeprl_tpu.analysis --no-baseline \
+    sheeprl_tpu/core/resilience.py sheeprl_tpu/core/chaos.py sheeprl_tpu/utils/checkpoint.py || rc=1
+
 exit "$rc"

@@ -121,6 +121,7 @@ def main(runtime, cfg: Dict[str, Any]):
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
     telemetry = runtime.telemetry.open(log_dir, rank_zero=runtime.is_global_zero, device=runtime.device)
+    guard = runtime.resilience.guard(rank_zero=runtime.is_global_zero)
     runtime.print(f"Log dir: {log_dir}")
 
     envs = make_vector_env(cfg, rank, log_dir)
@@ -236,6 +237,7 @@ def main(runtime, cfg: Dict[str, Any]):
 
     for iter_num in range(start_iter, total_iters + 1):
         telemetry.advance(policy_step)
+        guard.advance(policy_step)
         for _ in range(0, cfg.algo.rollout_steps):
             policy_step += cfg.env.num_envs * world_size
 
@@ -363,7 +365,7 @@ def main(runtime, cfg: Dict[str, Any]):
             opt_state.hyperparams["lr"] = jnp.asarray(new_lr, jnp.float32)
 
         if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
-            iter_num == total_iters and cfg.checkpoint.save_last
+            (iter_num == total_iters or guard.preempted) and cfg.checkpoint.save_last
         ):
             last_checkpoint = policy_step
             ckpt_state = {
@@ -378,11 +380,15 @@ def main(runtime, cfg: Dict[str, Any]):
             if runtime.is_global_zero:
                 save_checkpoint(ckpt_path, ckpt_state, keep_last=cfg.checkpoint.keep_last)
 
+        if guard.preempted:
+            runtime.print(f"Preemption: exiting cleanly after final checkpoint at policy step {policy_step}")
+            break
     pipeline.publish()
     envs.close()
-    if runtime.is_global_zero and cfg.algo.run_test:
+    if runtime.is_global_zero and cfg.algo.run_test and not guard.preempted:
         test(agent, params, runtime, cfg, log_dir, logger)
 
+    guard.close()
     telemetry.close()
     if logger is not None:
         logger.close()

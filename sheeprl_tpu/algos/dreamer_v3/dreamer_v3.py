@@ -41,6 +41,7 @@ from sheeprl_tpu.algos.dreamer_v3.utils import normalize_player_obs, prepare_obs
 from sheeprl_tpu.algos.ppo.agent import actions_metadata
 from sheeprl_tpu.config.instantiate import instantiate, locate
 from sheeprl_tpu.core.interact import InteractionPipeline
+from sheeprl_tpu.core.resilience import watch
 from sheeprl_tpu.core.mesh import DATA_AXIS
 from sheeprl_tpu.core.player import PlayerPlacement
 from sheeprl_tpu.data.infeed import ReplayInfeed
@@ -456,6 +457,8 @@ def main(runtime, cfg: Dict[str, Any]):
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
     runtime.print(f"Log dir: {log_dir}")
     telemetry = runtime.telemetry.open(log_dir, rank_zero=runtime.is_global_zero, device=runtime.device)
+    guard = runtime.resilience.guard(rank_zero=runtime.is_global_zero)
+    watchdog = runtime.resilience.watchdog
 
     envs = make_vector_env(cfg, rank, log_dir, restart_on_exception=True)
     action_space = envs.single_action_space
@@ -649,6 +652,7 @@ def main(runtime, cfg: Dict[str, Any]):
     # player latents and the rollout PRNG key held per slice. slices=1/async
     # off is bit-identical to the serial loop.
     pipeline = InteractionPipeline.from_config(cfg)
+    pipeline.watchdog = watchdog
     pipeline.set_key(rollout_key)
     single_action_shape = envs.single_action_space.shape
     player_cnn_cfg_keys = cfg.algo.cnn_keys.encoder
@@ -729,7 +733,7 @@ def main(runtime, cfg: Dict[str, Any]):
                             cfg.algo.critic.per_rank_target_network_update_freq,
                             cfg.algo.critic.tau,
                         )
-                        with train_timer.step():
+                        with train_timer.step(), watch(watchdog, "train_dispatch"):
                             agent_state, opt_states, moments_state, train_metrics, train_key = fused_train_fn(
                                 agent_state, opt_states, moments_state, ring.state,
                                 train_key, taus,
@@ -761,7 +765,7 @@ def main(runtime, cfg: Dict[str, Any]):
                         else:
                             tau = 0.0
                         batch = batches[i]
-                        with train_timer.step():
+                        with train_timer.step(), watch(watchdog, "train_dispatch"):
                             agent_state, opt_states, moments_state, train_metrics, train_key = train_fn(
                                 agent_state, opt_states, moments_state, batch, train_key,
                                 np.asarray(tau, np.float32),
@@ -792,6 +796,7 @@ def main(runtime, cfg: Dict[str, Any]):
     for iter_num in range(start_iter, total_iters + 1):
         policy_step += policy_steps_per_iter
         telemetry.advance(policy_step)
+        guard.advance(policy_step)
 
         trained_in_flight = False
         with timer("Time/env_interaction_time"):
@@ -981,7 +986,7 @@ def main(runtime, cfg: Dict[str, Any]):
 
         # ----------------------------------------------------- checkpoint
         if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
-            iter_num == total_iters and cfg.checkpoint.save_last
+            (iter_num == total_iters or guard.preempted) and cfg.checkpoint.save_last
         ):
             last_checkpoint = policy_step
             ckpt_state = {
@@ -1005,12 +1010,16 @@ def main(runtime, cfg: Dict[str, Any]):
             if runtime.is_global_zero:
                 save_checkpoint(ckpt_path, ckpt_state, keep_last=cfg.checkpoint.keep_last)
 
+        if guard.preempted:
+            runtime.print(f"Preemption: exiting cleanly after final checkpoint at policy step {policy_step}")
+            break
     pipeline.publish()
     infeed.close()
     envs.close()
-    if runtime.is_global_zero and cfg.algo.run_test:
+    if runtime.is_global_zero and cfg.algo.run_test and not guard.preempted:
         test(agent, agent_state, runtime, cfg, log_dir, logger)
 
+    guard.close()
     telemetry.close()
     if logger is not None:
         logger.close()

@@ -30,6 +30,7 @@ from sheeprl_tpu.algos.sac.loss import entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac.sac import _make_optimizer
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.core.interact import InteractionPipeline
+from sheeprl_tpu.core.resilience import watch
 from sheeprl_tpu.core.mesh import DATA_AXIS
 from sheeprl_tpu.core.player import PlayerPlacement
 from sheeprl_tpu.data.buffers import ReplayBuffer
@@ -226,6 +227,8 @@ def main(runtime, cfg: Dict[str, Any]):
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
     telemetry = runtime.telemetry.open(log_dir, rank_zero=runtime.is_global_zero, device=runtime.device)
+    guard = runtime.resilience.guard(rank_zero=runtime.is_global_zero)
+    watchdog = runtime.resilience.watchdog
     runtime.print(f"Log dir: {log_dir}")
 
     envs = make_vector_env(cfg, rank, log_dir)
@@ -366,6 +369,7 @@ def main(runtime, cfg: Dict[str, Any]):
     # async action fetch + double-buffered obs staging. slices=1/async off is
     # bit-identical to the serial loop.
     pipeline = InteractionPipeline.from_config(cfg)
+    pipeline.watchdog = watchdog
     pipeline.set_key(rollout_key)
     single_action_shape = envs.single_action_space.shape
 
@@ -417,7 +421,7 @@ def main(runtime, cfg: Dict[str, Any]):
                         # rides only on the LAST bucket.
                         k = 1 << (min(remaining, fused_train_steps).bit_length() - 1)
                         with_actor = remaining - k == 0
-                        with train_timer.step():
+                        with train_timer.step(), watch(watchdog, "train_dispatch"):
                             agent_state, opt_states, train_metrics, train_key = fused_train_fn(
                                 agent_state, opt_states, ring.state, train_key, k, with_actor
                             )
@@ -453,7 +457,7 @@ def main(runtime, cfg: Dict[str, Any]):
                     for k, v in actor_sample.items()
                 }
                 with timer("Time/train_time"):
-                    with train_timer.step():
+                    with train_timer.step(), watch(watchdog, "train_dispatch"):
                         agent_state, opt_states, train_metrics, train_key = train_fn(
                             agent_state, opt_states, critic_data, actor_data, train_key
                         )
@@ -471,6 +475,7 @@ def main(runtime, cfg: Dict[str, Any]):
     for iter_num in range(start_iter, total_iters + 1):
         policy_step += policy_steps_per_iter
         telemetry.advance(policy_step)
+        guard.advance(policy_step)
 
         trained_in_flight = False
         with timer("Time/env_interaction_time"):
@@ -584,7 +589,7 @@ def main(runtime, cfg: Dict[str, Any]):
             last_train = train_step_count
 
         if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
-            iter_num == total_iters and cfg.checkpoint.save_last
+            (iter_num == total_iters or guard.preempted) and cfg.checkpoint.save_last
         ):
             last_checkpoint = policy_step
             ckpt_state = {
@@ -611,11 +616,15 @@ def main(runtime, cfg: Dict[str, Any]):
             if saved_tail is not None:
                 rb["truncated"][tail, :] = saved_tail
 
+        if guard.preempted:
+            runtime.print(f"Preemption: exiting cleanly after final checkpoint at policy step {policy_step}")
+            break
     pipeline.publish()
     envs.close()
-    if runtime.is_global_zero and cfg.algo.run_test:
+    if runtime.is_global_zero and cfg.algo.run_test and not guard.preempted:
         test(agent, agent_state, runtime, cfg, log_dir, logger)
 
+    guard.close()
     telemetry.close()
     if logger is not None:
         logger.close()

@@ -81,6 +81,7 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
     runtime.print(f"Log dir: {log_dir}")
     telemetry = runtime.telemetry.open(log_dir, rank_zero=runtime.is_global_zero, device=runtime.device)
+    guard = runtime.resilience.guard(rank_zero=runtime.is_global_zero)
 
     envs = make_vector_env(cfg, rank, log_dir)
     action_space = envs.single_action_space
@@ -277,6 +278,7 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
     for iter_num in range(start_iter, total_iters + 1):
         policy_step += policy_steps_per_iter
         telemetry.advance(policy_step)
+        guard.advance(policy_step)
 
         with timer("Time/env_interaction_time"):
             with placement.ctx():
@@ -445,7 +447,7 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
 
         # ----------------------------------------------------- checkpoint
         if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
-            iter_num == total_iters and cfg.checkpoint.save_last
+            (iter_num == total_iters or guard.preempted) and cfg.checkpoint.save_last
         ):
             last_checkpoint = policy_step
             ckpt_state = {
@@ -469,12 +471,16 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
             if runtime.is_global_zero:
                 save_checkpoint(ckpt_path, ckpt_state, keep_last=cfg.checkpoint.keep_last)
 
+        if guard.preempted:
+            runtime.print(f"Preemption: exiting cleanly after final checkpoint at policy step {policy_step}")
+            break
     infeed.close()
     pipeline.publish()
     envs.close()
-    if runtime.is_global_zero and cfg.algo.run_test:
+    if runtime.is_global_zero and cfg.algo.run_test and not guard.preempted:
         test(agent, agent_state, runtime, cfg, log_dir, logger)
 
+    guard.close()
     telemetry.close()
     if logger is not None:
         logger.close()

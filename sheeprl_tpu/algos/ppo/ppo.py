@@ -36,6 +36,7 @@ from sheeprl_tpu.algos.ppo.agent import PPOAgent, actions_metadata, build_agent
 from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
 from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
 from sheeprl_tpu.core.interact import InteractionPipeline
+from sheeprl_tpu.core.resilience import watch
 from sheeprl_tpu.core.mesh import DATA_AXIS
 from sheeprl_tpu.core.player import PlayerPlacement
 from sheeprl_tpu.core.rollout import fuse_gae_pool, ship_rollout
@@ -167,6 +168,8 @@ def main(runtime, cfg: Dict[str, Any]):
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
     runtime.print(f"Log dir: {log_dir}")
     telemetry = runtime.telemetry.open(log_dir, rank_zero=runtime.is_global_zero, device=runtime.device)
+    guard = runtime.resilience.guard(rank_zero=runtime.is_global_zero)
+    watchdog = runtime.resilience.watchdog
 
     # ----------------------------------------------------------------- envs
     rank = runtime.global_rank
@@ -296,6 +299,7 @@ def main(runtime, cfg: Dict[str, Any]):
     # on-policy keeps fresh-weights semantics (the whole rollout must see the
     # post-update params, so train stays strictly between rollouts).
     pipeline = InteractionPipeline.from_config(cfg)
+    pipeline.watchdog = watchdog
     pipeline.set_key(rollout_key)
     single_action_shape = envs.single_action_space.shape
 
@@ -323,6 +327,7 @@ def main(runtime, cfg: Dict[str, Any]):
 
     for iter_num in range(start_iter, total_iters + 1):
         telemetry.advance(policy_step)
+        guard.advance(policy_step)
         for _ in range(0, cfg.algo.rollout_steps):
             policy_step += cfg.env.num_envs * world_size
 
@@ -409,7 +414,7 @@ def main(runtime, cfg: Dict[str, Any]):
         with timer("Time/train_time"):
             # PRNG split runs inside the jit (an eager split on a remote
             # device blocks the host); coefs travel as numpy.
-            with train_timer.step():
+            with train_timer.step(), watch(watchdog, "train_dispatch"):
                 params, opt_state, train_metrics, train_key = train_fn(
                     params,
                     opt_state,
@@ -485,7 +490,7 @@ def main(runtime, cfg: Dict[str, Any]):
 
         # ---------------------------------------------------- checkpoint
         if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
-            iter_num == total_iters and cfg.checkpoint.save_last
+            (iter_num == total_iters or guard.preempted) and cfg.checkpoint.save_last
         ):
             last_checkpoint = policy_step
             ckpt_state = {
@@ -500,11 +505,15 @@ def main(runtime, cfg: Dict[str, Any]):
             if runtime.is_global_zero:
                 save_checkpoint(ckpt_path, ckpt_state, keep_last=cfg.checkpoint.keep_last)
 
+        if guard.preempted:
+            runtime.print(f"Preemption: exiting cleanly after final checkpoint at policy step {policy_step}")
+            break
     pipeline.publish()
     envs.close()
-    if runtime.is_global_zero and cfg.algo.run_test:
+    if runtime.is_global_zero and cfg.algo.run_test and not guard.preempted:
         test(agent, params, runtime, cfg, log_dir, logger)
 
+    guard.close()
     telemetry.close()
     if logger is not None:
         logger.close()

@@ -33,6 +33,7 @@ from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.config.instantiate import instantiate, locate
 from sheeprl_tpu.core.interact import InteractionPipeline
+from sheeprl_tpu.core.resilience import watch
 from sheeprl_tpu.core.mesh import DATA_AXIS
 from sheeprl_tpu.core.player import PlayerPlacement
 from sheeprl_tpu.data.buffers import ReplayBuffer
@@ -187,6 +188,8 @@ def main(runtime, cfg: Dict[str, Any]):
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
     runtime.print(f"Log dir: {log_dir}")
     telemetry = runtime.telemetry.open(log_dir, rank_zero=runtime.is_global_zero, device=runtime.device)
+    guard = runtime.resilience.guard(rank_zero=runtime.is_global_zero)
+    watchdog = runtime.resilience.watchdog
 
     envs = make_vector_env(cfg, rank, log_dir)
     action_space = envs.single_action_space
@@ -329,6 +332,7 @@ def main(runtime, cfg: Dict[str, Any]):
     # async action fetch + double-buffered obs staging. slices=1/async off is
     # bit-identical to the serial loop.
     pipeline = InteractionPipeline.from_config(cfg)
+    pipeline.watchdog = watchdog
     pipeline.set_key(rollout_key)
     single_action_shape = envs.single_action_space.shape
 
@@ -382,7 +386,7 @@ def main(runtime, cfg: Dict[str, Any]):
                         # Power-of-two buckets bound the fused graphs to
                         # log2(fused_train_steps) variants.
                         k = 1 << (min(remaining, fused_train_steps).bit_length() - 1)
-                        with train_timer.step():
+                        with train_timer.step(), watch(watchdog, "train_dispatch"):
                             agent_state, opt_states, train_metrics, train_key = fused_train_fn(
                                 agent_state, opt_states, ring.state, train_key,
                                 np.full(k, tau_eff, np.float32),
@@ -410,7 +414,7 @@ def main(runtime, cfg: Dict[str, Any]):
                     do_ema = iter_num % target_freq_iters == 0
                     # tau as numpy (an eager jnp.asarray would dispatch);
                     # the PRNG split happens inside the jit.
-                    with train_timer.step():
+                    with train_timer.step(), watch(watchdog, "train_dispatch"):
                         agent_state, opt_states, train_metrics, train_key = train_fn(
                             agent_state,
                             opt_states,
@@ -432,6 +436,7 @@ def main(runtime, cfg: Dict[str, Any]):
     for iter_num in range(start_iter, total_iters + 1):
         policy_step += policy_steps_per_iter
         telemetry.advance(policy_step)
+        guard.advance(policy_step)
 
         trained_in_flight = False
         with timer("Time/env_interaction_time"):
@@ -545,7 +550,7 @@ def main(runtime, cfg: Dict[str, Any]):
             last_train = train_step_count
 
         if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
-            iter_num == total_iters and cfg.checkpoint.save_last
+            (iter_num == total_iters or guard.preempted) and cfg.checkpoint.save_last
         ):
             last_checkpoint = policy_step
             ckpt_state = {
@@ -575,11 +580,15 @@ def main(runtime, cfg: Dict[str, Any]):
             if saved_tail is not None:
                 rb["truncated"][tail, :] = saved_tail
 
+        if guard.preempted:
+            runtime.print(f"Preemption: exiting cleanly after final checkpoint at policy step {policy_step}")
+            break
     pipeline.publish()
     envs.close()
-    if runtime.is_global_zero and cfg.algo.run_test:
+    if runtime.is_global_zero and cfg.algo.run_test and not guard.preempted:
         test(agent, agent_state, runtime, cfg, log_dir, logger)
 
+    guard.close()
     telemetry.close()
     if logger is not None:
         logger.close()

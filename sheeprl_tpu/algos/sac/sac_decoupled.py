@@ -73,6 +73,7 @@ def main(runtime, cfg: Dict[str, Any]):
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
     telemetry = runtime.telemetry.open(log_dir, rank_zero=runtime.is_global_zero, device=runtime.device)
+    guard = runtime.resilience.guard(rank_zero=runtime.is_global_zero)
     runtime.print(f"Log dir: {log_dir}")
 
     # ------------------------------------------------------------ environment
@@ -232,6 +233,7 @@ def main(runtime, cfg: Dict[str, Any]):
     for iter_num in range(start_iter, total_iters + 1):
         policy_step += policy_steps_per_iter
         telemetry.advance(policy_step)
+        guard.advance(policy_step)
 
         with timer("Time/env_interaction_time"):
             if iter_num <= learning_starts:
@@ -373,7 +375,7 @@ def main(runtime, cfg: Dict[str, Any]):
             iter_num >= learning_starts
             and cfg.checkpoint.every > 0
             and policy_step - last_checkpoint >= cfg.checkpoint.every
-        ) or (iter_num == total_iters and cfg.checkpoint.save_last):
+        ) or ((iter_num == total_iters or guard.preempted) and cfg.checkpoint.save_last):
             last_checkpoint = policy_step
             ckpt_state = {
                 "agent": agent_state,
@@ -401,11 +403,15 @@ def main(runtime, cfg: Dict[str, Any]):
             if saved_tail is not None:
                 rb["truncated"][tail, :] = saved_tail
 
+        if guard.preempted:
+            runtime.print(f"Preemption: exiting cleanly after final checkpoint at policy step {policy_step}")
+            break
     envs.close()
-    if runtime.is_global_zero and cfg.algo.run_test:
+    if runtime.is_global_zero and cfg.algo.run_test and not guard.preempted:
         # flush: serve the final trained weights, not a stale async snapshot
         test(agent, {"actor": actor_mirror.flush()}, runtime, cfg, log_dir, logger)
 
+    guard.close()
     telemetry.close()
     if logger is not None:
         logger.close()

@@ -197,6 +197,7 @@ def main(runtime, cfg: Dict[str, Any]):
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
     telemetry = runtime.telemetry.open(log_dir, rank_zero=runtime.is_global_zero, device=runtime.device)
+    guard = runtime.resilience.guard(rank_zero=runtime.is_global_zero)
     runtime.print(f"Log dir: {log_dir}")
 
     envs = make_vector_env(cfg, rank, log_dir)
@@ -370,6 +371,7 @@ def main(runtime, cfg: Dict[str, Any]):
     for iter_num in range(start_iter, total_iters + 1):
         policy_step += policy_steps_per_iter
         telemetry.advance(policy_step)
+        guard.advance(policy_step)
 
         with timer("Time/env_interaction_time"):
             if iter_num <= learning_starts:
@@ -523,7 +525,7 @@ def main(runtime, cfg: Dict[str, Any]):
             last_train = train_step_count
 
         if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
-            iter_num == total_iters and cfg.checkpoint.save_last
+            (iter_num == total_iters or guard.preempted) and cfg.checkpoint.save_last
         ):
             last_checkpoint = policy_step
             ckpt_state = {
@@ -552,11 +554,15 @@ def main(runtime, cfg: Dict[str, Any]):
             if saved_tail is not None:
                 rb["truncated"][tail, :] = saved_tail
 
+        if guard.preempted:
+            runtime.print(f"Preemption: exiting cleanly after final checkpoint at policy step {policy_step}")
+            break
     pipeline.publish()
     envs.close()
-    if runtime.is_global_zero and cfg.algo.run_test:
+    if runtime.is_global_zero and cfg.algo.run_test and not guard.preempted:
         test(agent, agent_state, runtime, cfg, log_dir, logger)
 
+    guard.close()
     telemetry.close()
     if logger is not None:
         logger.close()

@@ -7,4 +7,5 @@ from sheeprl_tpu.analysis.rules import (  # noqa: F401
     gl004_recompile,
     gl005_donation,
     gl006_blocking_fetch,
+    gl007_atomic_persistence,
 )

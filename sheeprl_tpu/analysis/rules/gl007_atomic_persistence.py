@@ -1,0 +1,160 @@
+"""GL007: non-atomic persistence in checkpoint/resilience paths.
+
+A checkpoint (or any resume-critical artifact) must never have an observable
+on-disk state where the previous snapshot is gone and the new one is
+incomplete — preemptible training (Podracer, arXiv:2104.06272) kills the
+process at arbitrary bytes. Two anti-patterns give that state away
+syntactically:
+
+- **delete-then-write**: `shutil.rmtree(dest)` followed later in the same
+  function by a persistence write (`.save(...)`, `pickle.dump`, `json.dump`,
+  `open(..., "w")`). A kill between the delete and the write loses BOTH the
+  old and the new state — exactly the seed bug in `save_checkpoint`.
+- **in-place final write**: `open(final_path, "w")` in a function that never
+  calls `os.rename`/`os.replace`. A kill mid-write leaves a torn file at the
+  final path with no intact predecessor.
+
+The sanctioned shape (see `utils/checkpoint.py`): stage everything into a
+temp sibling on the same filesystem, fsync, and commit with one atomic
+rename. Paths whose source text mentions tmp/temp/trash/staging are treated
+as staging writes and exempt, as are read/append modes.
+
+Scoped to checkpoint/resilience files (path match on
+``checkpoint``/``resilien``): that is where torn writes cost a run, and
+where `scripts/lint.sh` holds a zero-findings no-baseline gate. Incremental
+writers elsewhere (memmapped buffers, JSONL telemetry appends) are
+legitimate non-atomic formats and stay out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, List, Optional, Tuple
+
+from sheeprl_tpu.analysis.context import LintContext
+from sheeprl_tpu.analysis.registry import Rule, register_rule
+
+_PATH_SCOPE_RE = re.compile(r"(checkpoint|resilien|gl007)", re.IGNORECASE)
+_TMPISH_RE = re.compile(r"(tmp|temp|trash|staging|scratch)", re.IGNORECASE)
+_RENAME_CALLS = {"os.rename", "os.replace", "os.renames"}
+_DUMP_CALLS = {
+    "pickle.dump",
+    "json.dump",
+    "numpy.save",
+    "numpy.savez",
+    "joblib.dump",
+    "yaml.dump",
+    "yaml.safe_dump",
+}
+
+
+def _scope_bodies(tree: ast.Module) -> Iterator[ast.AST]:
+    """The module itself plus every function definition — each checked as its
+    own persistence scope."""
+    yield tree
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _scope_calls(scope: ast.AST) -> Iterator[ast.Call]:
+    """Call nodes in this scope, not descending into nested function defs
+    (they are their own scopes — a commit helper's rename must not excuse its
+    caller, nor vice versa)."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _first_arg_src(call: ast.Call) -> str:
+    if call.args:
+        try:
+            return ast.unparse(call.args[0])
+        except Exception:  # noqa: BLE001 - unparse is best-effort forensics
+            return ""
+    return ""
+
+
+def _open_write_mode(call: ast.Call) -> Optional[str]:
+    """The mode string iff this is a truncating/creating open(); None for
+    reads, appends, or non-constant modes (those stay unflagged)."""
+    mode_node: Optional[ast.AST] = call.args[1] if len(call.args) > 1 else None
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode_node = kw.value
+    if mode_node is None:
+        return None
+    if not (isinstance(mode_node, ast.Constant) and isinstance(mode_node.value, str)):
+        return None
+    mode = mode_node.value
+    return mode if ("w" in mode or "x" in mode) else None
+
+
+@register_rule
+class NonAtomicPersistence(Rule):
+    id = "GL007"
+    name = "non-atomic-persistence"
+    rationale = (
+        "Checkpoint writes must stage into a temp sibling and commit with one "
+        "atomic rename; delete-then-write or in-place final writes leave a "
+        "kill-window where no valid snapshot exists on disk."
+    )
+
+    def check(self, ctx: LintContext) -> None:
+        if not _PATH_SCOPE_RE.search(ctx.path.replace("\\", "/")):
+            return
+        for scope in _scope_bodies(ctx.tree):
+            self._check_scope(ctx, scope)
+
+    def _check_scope(self, ctx: LintContext, scope: ast.AST) -> None:
+        rmtrees: List[Tuple[ast.Call, str]] = []
+        writes: List[ast.Call] = []
+        open_writes: List[Tuple[ast.Call, str]] = []
+        has_rename = False
+        for call in _scope_calls(scope):
+            resolved = ctx.resolver.resolve(call.func) or ""
+            if resolved in _RENAME_CALLS:
+                has_rename = True
+            elif resolved == "shutil.rmtree":
+                rmtrees.append((call, _first_arg_src(call)))
+            elif resolved == "open" or resolved in ("io.open", "builtins.open"):
+                mode = _open_write_mode(call)
+                if mode is not None:
+                    open_writes.append((call, _first_arg_src(call)))
+                    writes.append(call)
+            elif resolved in _DUMP_CALLS:
+                writes.append(call)
+            elif isinstance(call.func, ast.Attribute) and call.func.attr == "save":
+                # Method-style writers (Orbax checkpointer.save, np-like .save)
+                writes.append(call)
+
+        for call, arg_src in rmtrees:
+            if _TMPISH_RE.search(arg_src):
+                continue  # clearing a staging/trash dir is the sanctioned flow
+            later_writes = [w for w in writes if w.lineno > call.lineno]
+            if later_writes:
+                ctx.report(
+                    self.id,
+                    call,
+                    f"shutil.rmtree({arg_src or '...'}) before writing its replacement "
+                    f"(write at line {min(w.lineno for w in later_writes)}) — a kill in "
+                    "between loses both the old and the new state; stage into a temp "
+                    "sibling and commit with os.rename()",
+                )
+        if not has_rename:
+            for call, arg_src in open_writes:
+                if _TMPISH_RE.search(arg_src):
+                    continue
+                ctx.report(
+                    self.id,
+                    call,
+                    f"open({arg_src or '...'}, 'w') writes the final path in place with no "
+                    "os.rename/os.replace commit in this function — a kill mid-write leaves "
+                    "a torn file; write a temp sibling (fsync) and os.replace() it over",
+                )

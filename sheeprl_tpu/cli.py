@@ -63,6 +63,11 @@ def resume_from_checkpoint(cfg: dotdict) -> dotdict:
     old.get("algo", {}).pop("total_steps", None)
     old.get("algo", {}).pop("learning_starts", None)
     old.get("checkpoint", {}).pop("resume_from", None)
+    # Chaos injectors are one-shot experiment artifacts: re-inheriting them
+    # from the preempted run's config would replay the same fault right after
+    # resume (a SIGTERM-at-step-N injector becomes a preemption loop). The
+    # resuming invocation's own chaos config stays authoritative.
+    old.get("resilience", {}).pop("chaos", None)
 
     def merge(dst: Dict[str, Any], src: Dict[str, Any]) -> None:
         for k, v in src.items():
@@ -102,6 +107,25 @@ def check_configs(cfg: dotdict) -> None:
                 "telemetry.profiler window must satisfy 0 <= start_step < stop_step "
                 f"(or both -1 to disable); got [{start}, {stop})"
             )
+    res = cfg.get("resilience")
+    if res is not None:
+        wd = res.get("watchdog")
+        if wd is not None:
+            on_trip = str(wd.get("on_trip", "warn") or "warn").lower()
+            if on_trip not in ("warn", "preempt", "abort"):
+                raise ValueError(
+                    f"Unknown resilience.watchdog.on_trip '{on_trip}'. Valid: warn | preempt | abort"
+                )
+            if bool(wd.get("enabled", False)) and float(wd.get("timeout_s", 120.0) or 0.0) <= 0:
+                raise ValueError("resilience.watchdog.enabled=True requires timeout_s > 0")
+        ch = res.get("chaos")
+        if ch is not None and bool(ch.get("enabled", False)):
+            known = ("env_step_raise", "sigterm", "sigint", "fail_point", "delayed_fetch")
+            for inj in ch.get("injectors") or []:
+                if str(inj.get("kind", "")) not in known:
+                    raise ValueError(
+                        f"Unknown resilience.chaos injector kind {inj.get('kind')!r}. Valid: {known}"
+                    )
     entry = algorithm_registry[cfg.algo.name]
     if (
         entry.decoupled
@@ -237,6 +261,11 @@ def run_algorithm(cfg: dotdict) -> None:
     from sheeprl_tpu.telemetry import Telemetry
 
     runtime.telemetry = Telemetry.from_config(cfg)
+    # The run's fault-tolerance surface: preemption guard + env supervisor +
+    # dispatch watchdog + chaos injectors (howto/fault_tolerance.md).
+    from sheeprl_tpu.core.resilience import Resilience
+
+    runtime.resilience = Resilience.from_config(cfg)
     import jax
 
     # Eager ops and un-sharded jits must land on the chosen accelerator (the
@@ -255,6 +284,21 @@ def run(args: Optional[Sequence[str]] = None) -> None:
     overrides = list(args) if args is not None else sys.argv[1:]
     cfg = compose("config", overrides)
     os.environ.setdefault("OMP_NUM_THREADS", str(cfg.get("num_threads", 1)))
+    if str(cfg.checkpoint.resume_from or "").startswith("auto"):
+        # `checkpoint.resume_from=auto[:<dir>]` — follow the preemption
+        # guard's autoresume.json pointer, or fall back to the newest
+        # manifest-valid checkpoint under the search root (skipping torn or
+        # corrupt saves). See howto/fault_tolerance.md.
+        from sheeprl_tpu.core.resilience import resolve_auto_resume
+
+        resolved = resolve_auto_resume(str(cfg.checkpoint.resume_from), cfg.get("log_root"))
+        if resolved is None:
+            raise FileNotFoundError(
+                f"checkpoint.resume_from={cfg.checkpoint.resume_from!r}: no valid checkpoint "
+                "found (no autoresume.json pointer and no manifest-valid ckpt_*.ckpt)"
+            )
+        print(f"Auto-resume: resolved {cfg.checkpoint.resume_from!r} -> {resolved}")
+        cfg.checkpoint.resume_from = resolved
     if cfg.checkpoint.resume_from:
         cfg = resume_from_checkpoint(cfg)
     if cfg.metric.log_level > 0:

@@ -1,0 +1,292 @@
+"""sheeprl_tpu.core.chaos — first-party fault-injection harness.
+
+Every recovery path in core/resilience.py is only as trustworthy as the
+last time it actually ran. This module makes faults a *config input* so the
+chaos-marked test suite (and any run with ``resilience.chaos.enabled=True``)
+can exercise env-worker crashes, preemption signals, kills mid-save, and
+stalled fetches deterministically on CPU.
+
+Two layers:
+
+1. **Fail points** — named, near-zero-cost markers compiled into the hot
+   paths that must survive a kill (``utils/checkpoint.py`` brackets each
+   phase of an atomic save with :func:`maybe_fail`). Disarmed, the check is
+   one module-global bool; armed, the named point raises :class:`ChaosFault`
+   exactly where a real crash would land. :func:`maybe_delay` is the latency
+   twin used by the blocking-fetch path.
+
+2. **Config-driven injectors** (``cfg.resilience.chaos.injectors``) — a
+   list of dicts, each with a ``kind``:
+
+   - ``{"kind": "env_step_raise", "env_rank": 0, "at_step": 7}`` — env
+     worker ``env_rank`` raises on its ``at_step``-th ``step()`` call
+     (installed as a gym wrapper by ``utils/env.make_vector_env``).
+   - ``{"kind": "sigterm"|"sigint", "at_step": N}`` — deliver the signal to
+     this process once ``policy_step >= N`` (fired from
+     ``PreemptionGuard.advance`` so delivery lands at an iteration
+     boundary, exactly like a cloud preemption notice).
+   - ``{"kind": "fail_point", "name": "checkpoint.before_commit",
+     "at_step": N}`` — arm the named fail point once ``policy_step >= N``
+     (``at_step`` 0/absent arms it immediately).
+   - ``{"kind": "delayed_fetch", "seconds": 0.2, "at_step": N}`` — arm a
+     one-shot sleep inside the blocking action fetch (watchdog food).
+
+Injector firing is recorded in a process-global registry so a restarted env
+worker does not re-raise the same injected fault — one configured fault is
+one fault. Every fire increments the ``faults_injected`` telemetry counter.
+
+State is process-global on purpose (env thunks are rebuilt by the
+supervisor after a crash and must see the same registry); tests call
+:func:`reset` around each scenario.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+import warnings
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = [
+    "ChaosFault",
+    "ChaosMonkey",
+    "arm_fail_point",
+    "corrupt_checkpoint",
+    "maybe_delay",
+    "maybe_fail",
+    "reset",
+    "wrap_env_thunks",
+]
+
+
+class ChaosFault(RuntimeError):
+    """An injected fault. Deliberately a RuntimeError so production except
+    clauses treat it exactly like the organic failure it stands in for."""
+
+
+# ----------------------------------------------------------- global state
+# Fast path: one bool guards every maybe_fail/maybe_delay call site.
+_armed: bool = False
+_fail_points: Dict[str, int] = {}  # name -> remaining fires (-1 = always)
+_delays: Dict[str, float] = {}  # name -> seconds (one-shot)
+_fired: set = set()  # injector ids that already fired (survives env rebuild)
+
+
+def _count_fault(label: str) -> None:
+    try:
+        from sheeprl_tpu.telemetry import tracer as tracer_mod
+
+        tracer_mod.current().count("faults_injected")
+        tracer_mod.current().count(f"faults_injected/{label}")
+    except Exception:  # noqa: BLE001 - telemetry must never mask the fault
+        pass
+
+
+def _refresh_armed() -> None:
+    global _armed
+    _armed = bool(_fail_points or _delays)
+
+
+def arm_fail_point(name: str, times: int = 1) -> None:
+    """Arm fail point `name` to raise on its next `times` hits (-1 forever)."""
+    _fail_points[name] = int(times)
+    _refresh_armed()
+
+
+def disarm_fail_point(name: str) -> None:
+    _fail_points.pop(name, None)
+    _refresh_armed()
+
+
+def arm_delay(name: str, seconds: float) -> None:
+    """Arm a one-shot sleep at delay point `name`."""
+    _delays[name] = float(seconds)
+    _refresh_armed()
+
+
+def maybe_fail(name: str) -> None:
+    """Raise ChaosFault if fail point `name` is armed. Near-free when not."""
+    if not _armed:
+        return
+    remaining = _fail_points.get(name)
+    if remaining is None or remaining == 0:
+        return
+    if remaining > 0:
+        _fail_points[name] = remaining - 1
+        if _fail_points[name] == 0:
+            del _fail_points[name]
+        _refresh_armed()
+    _count_fault(f"fail_point:{name}")
+    raise ChaosFault(f"chaos fail point hit: {name}")
+
+
+def maybe_delay(name: str) -> None:
+    """Sleep once if delay point `name` is armed (then disarm it)."""
+    if not _armed:
+        return
+    seconds = _delays.pop(name, None)
+    _refresh_armed()
+    if seconds is not None and seconds > 0:
+        _count_fault(f"delay:{name}")
+        time.sleep(seconds)
+
+
+def fire_once(injector_id: str, label: str) -> bool:
+    """Record `injector_id` as fired; False if it already fired (so a
+    supervisor-rebuilt env does not replay the same configured fault)."""
+    if injector_id in _fired:
+        return False
+    _fired.add(injector_id)
+    _count_fault(label)
+    return True
+
+
+def reset() -> None:
+    """Clear all armed points and the fired registry (test isolation)."""
+    _fail_points.clear()
+    _delays.clear()
+    _fired.clear()
+    _refresh_armed()
+
+
+# --------------------------------------------------------- env injection
+class EnvStepChaos:
+    """Gym wrapper raising ChaosFault on this env's N-th step() call.
+
+    Kept dependency-free (plain delegation, no gym.Wrapper base) so the
+    module imports without gymnasium — only `wrap_env_thunks` needs gym
+    environments to exist.
+    """
+
+    def __init__(self, env: Any, injector_id: str, at_step: int) -> None:
+        self.env = env
+        self._injector_id = injector_id
+        self._at_step = int(at_step)
+        self._n = 0
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self.env, name)
+
+    def reset(self, **kwargs: Any) -> Any:
+        return self.env.reset(**kwargs)
+
+    def step(self, action: Any) -> Any:
+        self._n += 1
+        if self._n >= self._at_step and fire_once(self._injector_id, "env_step_raise"):
+            raise ChaosFault(
+                f"injected env-step failure ({self._injector_id}) at local step {self._n}"
+            )
+        return self.env.step(action)
+
+    def close(self) -> None:
+        self.env.close()
+
+    @property
+    def unwrapped(self) -> Any:
+        return self.env.unwrapped
+
+
+def wrap_env_thunks(
+    thunks: List[Callable[[], Any]], injectors: List[Dict[str, Any]], base: int
+) -> List[Callable[[], Any]]:
+    """Wrap env thunks with EnvStepChaos for `env_step_raise` injectors.
+
+    `base` is the rank's global env offset; injector `env_rank` addresses the
+    global env index (matching per-env seed derivation).
+    """
+    specs: Dict[int, Dict[str, Any]] = {}
+    for idx, inj in enumerate(injectors or []):
+        if str(inj.get("kind")) != "env_step_raise":
+            continue
+        env_rank = int(inj.get("env_rank", 0))
+        specs[env_rank] = {
+            "id": f"env_step_raise[{idx}]@{env_rank}",
+            "at_step": int(inj.get("at_step", 1)),
+        }
+    if not specs:
+        return thunks
+
+    def wrap(thunk: Callable[[], Any], spec: Dict[str, Any]) -> Callable[[], Any]:
+        def make() -> Any:
+            return EnvStepChaos(thunk(), spec["id"], spec["at_step"])
+
+        return make
+
+    return [
+        wrap(t, specs[base + i]) if (base + i) in specs else t
+        for i, t in enumerate(thunks)
+    ]
+
+
+# --------------------------------------------------------- step injectors
+class ChaosMonkey:
+    """Policy-step-driven injector driver (signals, fail points, delays).
+
+    Built by ``Resilience.from_config`` and pulsed once per train-loop
+    iteration via ``PreemptionGuard.advance(policy_step)``; env_step_raise
+    injectors are handled separately by :func:`wrap_env_thunks` because they
+    live inside env workers, not the train loop.
+    """
+
+    def __init__(self, injectors: Optional[List[Dict[str, Any]]]) -> None:
+        self._injectors: List[Dict[str, Any]] = []
+        for idx, inj in enumerate(injectors or []):
+            kind = str(inj.get("kind", ""))
+            if kind == "env_step_raise":
+                continue  # env-side; see wrap_env_thunks
+            if kind not in ("sigterm", "sigint", "fail_point", "delayed_fetch"):
+                warnings.warn(f"Unknown chaos injector kind {kind!r}: ignored")
+                continue
+            spec = dict(inj)
+            spec["_id"] = f"{kind}[{idx}]"
+            spec["_at"] = int(inj.get("at_step", 0) or 0)
+            self._injectors.append(spec)
+
+    def on_step(self, policy_step: int) -> None:
+        for spec in self._injectors:
+            if policy_step < spec["_at"]:
+                continue
+            if not fire_once(spec["_id"], spec["kind"]):
+                continue
+            kind = spec["kind"]
+            if kind == "sigterm":
+                os.kill(os.getpid(), signal.SIGTERM)
+            elif kind == "sigint":
+                os.kill(os.getpid(), signal.SIGINT)
+            elif kind == "fail_point":
+                arm_fail_point(str(spec["name"]), int(spec.get("times", 1)))
+            elif kind == "delayed_fetch":
+                arm_delay("fetch.harvest", float(spec.get("seconds", 0.1)))
+
+
+# --------------------------------------------------- checkpoint corruption
+def corrupt_checkpoint(ckpt_path: str, mode: str = "truncate_manifest") -> None:
+    """Damage a saved checkpoint in place — the test-side injector for the
+    torn-write scenarios `find_latest_valid_checkpoint` must survive.
+
+    Modes: ``truncate_manifest`` (cut the manifest mid-byte, like a kill
+    during the metadata write), ``delete_manifest`` (commit never happened —
+    pre-atomic-layout directory), ``garbage_manifest`` (bit rot),
+    ``delete_arrays`` (payload vanished but manifest survived).
+    """
+    manifest = os.path.join(ckpt_path, "manifest.json")
+    if mode == "truncate_manifest":
+        with open(manifest, "rb") as fp:
+            blob = fp.read()
+        with open(manifest, "wb") as fp:
+            fp.write(blob[: max(1, len(blob) // 2)])
+    elif mode == "delete_manifest":
+        os.remove(manifest)
+    elif mode == "garbage_manifest":
+        with open(manifest, "wb") as fp:
+            fp.write(b"\x00not json\xff")
+    elif mode == "delete_arrays":
+        import shutil
+
+        for name in os.listdir(ckpt_path):
+            full = os.path.join(ckpt_path, name)
+            if os.path.isdir(full):
+                shutil.rmtree(full)
+    else:
+        raise ValueError(f"Unknown corruption mode: {mode!r}")

@@ -357,8 +357,16 @@ class PendingFetch:
             return self._result
         import jax
 
+        from sheeprl_tpu.core import chaos
+
         t0 = time.perf_counter()
-        out = jax.device_get(self._tree)
+        chaos.maybe_delay("fetch.harvest")
+        watchdog = self._pipeline.watchdog
+        if watchdog is not None:
+            with watchdog.guard(f"fetch/{self._label}"):
+                out = jax.device_get(self._tree)
+        else:
+            out = jax.device_get(self._tree)
         t1 = time.perf_counter()
         stats = self._pipeline.stats
         stats.fetch_blocked_s += t1 - t0
@@ -431,6 +439,9 @@ class InteractionPipeline:
         self.name = name
         self._ranges = split_ranges(self.num_envs, self.slices)
         self.stats = FetchStats()
+        # Optional DispatchWatchdog (core/resilience.py) armed around every
+        # blocking harvest; loops install it right after construction.
+        self.watchdog: Optional[Any] = None
         self._states: Optional[List[Any]] = None
         self._keys: Optional[List[Any]] = None
         self._stagers: Dict[int, ObsStager] = {}

@@ -1,0 +1,655 @@
+"""sheeprl_tpu.core.resilience — fault tolerance for preemptible training.
+
+The north-star deployment is Podracer-style (arXiv:2104.06272): long-lived
+learners on preemptible TPU pods that survive because snapshots are cheap,
+atomic, and always resumable, with env workers treated as a managed pool
+(EnvPool, arXiv:2206.10558) rather than bare processes. This module is the
+host-side half of that story; the storage half (atomic manifest-committed
+checkpoints, `find_latest_valid_checkpoint`) lives in
+``sheeprl_tpu/utils/checkpoint.py``.
+
+Three cooperating pieces, all config-driven via the ``resilience`` Hydra
+group and surfaced to train loops through ``runtime.resilience``:
+
+- :class:`PreemptionGuard` — catches SIGTERM/SIGINT on the main thread and
+  turns them into a *clean exit at the next iteration boundary*: the loop's
+  normal end-of-iteration path already harvests pending fetches and the
+  checkpoint write blocks on every in-flight donated dispatch (``np.asarray``
+  on device leaves), so the boundary IS the drain. The guard forces a final
+  checkpoint (every loop's save condition includes ``guard.preempted``),
+  learns about it through the checkpoint post-save hook, and writes an
+  atomic ``autoresume.json`` pointer next to it so the replacement process
+  can resume with ``checkpoint.resume_from=auto:<dir>``.
+
+- :class:`EnvSupervisor` — an :class:`EnvSliceGroup` whose ``step_slice``
+  catches env-step exceptions and dead subprocesses, rebuilds the failing
+  slice from its factory with exponential backoff + jitter, reseeds it
+  deterministically from the run seed + restart count, and reports the
+  restart as a *truncated* episode boundary so the poisoned in-flight
+  episode is dropped at sequence sampling (at most one episode of data
+  lost). A slice that exhausts ``max_restarts`` trips the circuit breaker:
+  the sole slice raises; one of many is masked out (zero obs, truncated
+  rows) so the rest of the rollout keeps learning.
+
+- :class:`DispatchWatchdog` — a monotonic-deadline watchdog armed around
+  donated train dispatches and blocking action fetches. A hang past the
+  deadline logs a full thread stack dump (the dispatch is unobservable from
+  inside — the stack tells you which device call wedged), counts
+  ``watchdog_trips``, and per config warns, delivers SIGTERM to reuse the
+  clean preemption path, or aborts the process.
+
+Fault injection for all of the above lives in ``sheeprl_tpu/core/chaos.py``.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import warnings
+from contextlib import contextmanager, nullcontext
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from sheeprl_tpu.core import chaos
+from sheeprl_tpu.core.interact import EnvSliceGroup
+from sheeprl_tpu.telemetry import tracer as tracer_mod
+
+__all__ = [
+    "AUTORESUME_NAME",
+    "DispatchWatchdog",
+    "EnvSupervisor",
+    "PreemptionGuard",
+    "Resilience",
+    "resolve_auto_resume",
+    "watch",
+]
+
+AUTORESUME_NAME = "autoresume.json"
+
+
+def _cfg_get(section: Any, key: str, default: Any) -> Any:
+    if section is None:
+        return default
+    getter = getattr(section, "get", None)
+    if getter is not None:
+        value = getter(key, default)
+    else:
+        value = getattr(section, key, default)
+    return default if value is None else value
+
+
+# ------------------------------------------------------------ PreemptionGuard
+class PreemptionGuard:
+    """Main-thread SIGTERM/SIGINT -> clean checkpoint-and-exit.
+
+    Lifecycle (one guard per train loop run)::
+
+        guard = runtime.resilience.guard(rank_zero=runtime.is_global_zero)
+        for iter_num in ...:
+            guard.advance(policy_step)       # also pulses chaos injectors
+            ...
+            # save condition includes `guard.preempted` -> final snapshot
+            if guard.preempted:
+                break                        # iteration boundary == drained
+        guard.close()                        # restores previous handlers
+
+    The guard never does work inside the signal handler beyond flag + counter
+    (async-signal-safe-ish by construction); everything expensive happens at
+    the iteration boundary on the main thread. A second SIGINT re-raises
+    KeyboardInterrupt so an impatient Ctrl-C Ctrl-C still kills the run.
+
+    Handler install/restore is strictly scoped: tests run many algorithm
+    mains in one process and each must leave signal disposition as found.
+    """
+
+    def __init__(
+        self,
+        *,
+        enabled: bool = True,
+        catch_sigint: bool = True,
+        write_pointer: bool = True,
+        rank_zero: bool = True,
+        chaos_monkey: Optional[chaos.ChaosMonkey] = None,
+        on_close: Optional[Callable[[], None]] = None,
+    ) -> None:
+        self._enabled = bool(enabled)
+        self._signals: Tuple[int, ...] = (
+            (signal.SIGTERM, signal.SIGINT) if catch_sigint else (signal.SIGTERM,)
+        )
+        self._write_pointer = bool(write_pointer)
+        self._rank_zero = bool(rank_zero)
+        self._chaos = chaos_monkey
+        self._on_close = on_close
+        self._prev: Dict[int, Any] = {}
+        self._installed = False
+        self._hook_registered = False
+        self._preempted = False
+        self._signum: Optional[int] = None
+        self._policy_step = 0
+        self.last_checkpoint_path: Optional[str] = None
+
+    # ------------------------------------------------------------- install
+    def install(self) -> "PreemptionGuard":
+        if not self._enabled or self._installed:
+            return self
+        if threading.current_thread() is not threading.main_thread():
+            # Signal handlers can only live on the main thread (decoupled
+            # trainer threads, test runners): the guard still drives chaos
+            # injectors and checkpoint pointers, just not signals.
+            self._signals = ()
+        for sig in self._signals:
+            self._prev[sig] = signal.signal(sig, self._handle)
+        from sheeprl_tpu.utils import checkpoint as ckpt_mod
+
+        ckpt_mod.register_post_save_hook(self._on_save)
+        self._hook_registered = True
+        self._installed = True
+        return self
+
+    def close(self) -> None:
+        for sig, prev in self._prev.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, OSError):
+                pass
+        self._prev.clear()
+        if self._hook_registered:
+            from sheeprl_tpu.utils import checkpoint as ckpt_mod
+
+            ckpt_mod.unregister_post_save_hook(self._on_save)
+            self._hook_registered = False
+        self._installed = False
+        if self._on_close is not None:
+            self._on_close()
+
+    # ------------------------------------------------------------ signals
+    def _handle(self, signum: int, frame: Any) -> None:
+        if self._preempted and signum == signal.SIGINT:
+            raise KeyboardInterrupt
+        first = not self._preempted
+        self._preempted = True
+        self._signum = signum
+        if first:
+            tracer_mod.current().count("preemptions")
+
+    @property
+    def preempted(self) -> bool:
+        return self._preempted
+
+    def advance(self, policy_step: int) -> None:
+        """Once per train-loop iteration; also pulses step-driven chaos
+        injectors (SIGTERM-at-step-N lands here, at an iteration boundary —
+        exactly where a real preemption notice is observed)."""
+        self._policy_step = int(policy_step)
+        if self._chaos is not None:
+            self._chaos.on_step(policy_step)
+
+    # ------------------------------------------------------ save awareness
+    def _on_save(self, ckpt_path: str) -> None:
+        self.last_checkpoint_path = ckpt_path
+        if not self._preempted:
+            return
+        tracer = tracer_mod.current()
+        tracer.count("preemption_saves")
+        start = time.perf_counter()
+        if self._write_pointer and self._rank_zero:
+            self._write_pointer_file(ckpt_path)
+        tracer.add_span(
+            "resilience/preemption_save", "checkpoint", start,
+            time.perf_counter() - start,
+            {"step": self._policy_step, "signal": int(self._signum or 0)},
+        )
+
+    def _write_pointer_file(self, ckpt_path: str) -> None:
+        pointer = os.path.join(os.path.dirname(os.path.abspath(ckpt_path)), AUTORESUME_NAME)
+        payload = {
+            "ckpt_path": os.path.abspath(ckpt_path),
+            "policy_step": self._policy_step,
+            "signal": int(self._signum or 0),
+            "written_unix": time.time(),
+        }
+        tmp = f"{pointer}.tmp-{os.getpid()}"
+        with open(tmp, "w") as fp:
+            json.dump(payload, fp, indent=2)
+            fp.flush()
+            os.fsync(fp.fileno())
+        os.replace(tmp, pointer)
+
+
+# ----------------------------------------------------------- auto-resume
+def resolve_auto_resume(spec: str, search_root: Optional[str] = None) -> Optional[str]:
+    """Resolve ``checkpoint.resume_from=auto[:<dir>]`` to a checkpoint path.
+
+    Preference order: the newest ``autoresume.json`` pointer under the
+    search root whose target still validates (a preempted run's explicit
+    hand-off), else the newest manifest-valid checkpoint in any
+    ``checkpoint/`` directory under the root. Returns None when nothing
+    valid exists.
+    """
+    from sheeprl_tpu.utils.checkpoint import (
+        find_latest_valid_checkpoint,
+        parse_ckpt_name,
+        validate_checkpoint,
+    )
+
+    root = spec.split(":", 1)[1] if ":" in spec else (search_root or os.getcwd())
+    root = os.path.abspath(os.path.expanduser(root))
+    if not os.path.isdir(root):
+        return None
+
+    pointers: List[Tuple[float, str]] = []
+    ckpt_dirs: List[str] = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        if AUTORESUME_NAME in filenames:
+            full = os.path.join(dirpath, AUTORESUME_NAME)
+            try:
+                pointers.append((os.path.getmtime(full), full))
+            except OSError:
+                pass
+        if os.path.basename(dirpath) == "checkpoint":
+            ckpt_dirs.append(dirpath)
+    for _, pointer in sorted(pointers, reverse=True):
+        try:
+            with open(pointer) as fp:
+                target = json.load(fp).get("ckpt_path")
+        except (OSError, ValueError):
+            continue
+        if target and validate_checkpoint(target):
+            return target
+    best: Optional[Tuple[int, str]] = None
+    for ckpt_dir in ckpt_dirs:
+        found = find_latest_valid_checkpoint(ckpt_dir)
+        if found is None:
+            continue
+        parsed = parse_ckpt_name(found)
+        step = parsed[0] if parsed else 0
+        if best is None or step > best[0]:
+            best = (step, found)
+    return best[1] if best else None
+
+
+# ------------------------------------------------------------ EnvSupervisor
+class _SliceSlot:
+    __slots__ = ("restarts", "dead", "zero_obs")
+
+    def __init__(self) -> None:
+        self.restarts = 0
+        self.dead = False
+        self.zero_obs: Any = None
+
+
+class EnvSupervisor(EnvSliceGroup):
+    """An EnvSliceGroup that keeps stepping when a slice dies.
+
+    ``factories[k]()`` must rebuild sub vector env k from scratch (fresh
+    subprocesses included). Recovery semantics: the restarted slice comes
+    back *reset*, its step reported as rewards 0 / ``truncated=True`` with
+    ``info["env_restarted"]`` set — an episode boundary, so sequence
+    samplers never stitch across the crash and at most the poisoned
+    in-flight episode is lost. Restart seeds derive deterministically from
+    ``(seed, slice, restart_count)`` so a chaos-injected crash replays
+    bit-identically.
+    """
+
+    def __init__(
+        self,
+        envs: Sequence[Any],
+        factories: Sequence[Callable[[], Any]],
+        *,
+        seed: int = 0,
+        max_restarts: int = 3,
+        backoff_base_s: float = 0.05,
+        backoff_max_s: float = 5.0,
+        backoff_jitter: float = 0.25,
+    ) -> None:
+        super().__init__(envs)
+        if len(factories) != len(self.envs):
+            raise ValueError("EnvSupervisor needs one factory per slice")
+        self._factories: List[Callable[[], Any]] = list(factories)
+        self._slots = [_SliceSlot() for _ in self.envs]
+        self._seed = int(seed)
+        self._max_restarts = int(max_restarts)
+        self._backoff_base_s = float(backoff_base_s)
+        self._backoff_max_s = float(backoff_max_s)
+        self._backoff_jitter = float(backoff_jitter)
+        self._jitter_rng = np.random.default_rng(self._seed)
+
+    # ------------------------------------------------------------ stepping
+    def step_slice(self, k: int, actions: Any) -> Tuple[Any, Any, Any, Any, Dict[str, Any]]:
+        if self._slots[k].dead:
+            return self._masked_step(k)
+        try:
+            return self.envs[k].step(actions)
+        except Exception as exc:  # noqa: BLE001 - any worker death lands here
+            return self._recover(k, exc)
+
+    def reset(
+        self, *, seed: Optional[Any] = None, options: Optional[dict] = None
+    ) -> Tuple[Any, Dict[str, Any]]:
+        from sheeprl_tpu.core.interact import merge_infos, tree_concat
+
+        obs_parts: List[Any] = []
+        info_parts: List[Dict[str, Any]] = []
+        for k, ((s0, s1), env) in enumerate(zip(self.slice_ranges, self.envs)):
+            if isinstance(seed, int):
+                sub_seed: Optional[Any] = seed + s0
+            elif isinstance(seed, (list, tuple)):
+                sub_seed = list(seed[s0:s1])
+            else:
+                sub_seed = seed
+            if self._slots[k].dead:
+                out = self._masked_step(k)
+                obs, info = out[0], out[4]
+            else:
+                try:
+                    obs, info = env.reset(seed=sub_seed, options=options)
+                except Exception as exc:  # noqa: BLE001
+                    out = self._recover(k, exc)
+                    obs, info = out[0], out[4]
+            obs_parts.append(obs)
+            info_parts.append(info)
+        return tree_concat(obs_parts), merge_infos(info_parts, self.slice_counts)
+
+    def close(self, **kwargs: Any) -> None:
+        for env in self.envs:
+            try:
+                env.close(**kwargs)
+            except Exception:  # noqa: BLE001 - dead workers must not block exit
+                pass
+
+    # ------------------------------------------------------------ recovery
+    def restart_seed(self, k: int, restart: int) -> int:
+        """Deterministic reseed for slice k's restart-th rebuild — derived
+        from the run seed's stream, never wall clock, so chaos scenarios
+        replay exactly."""
+        return int(np.random.SeedSequence([self._seed, k, restart]).generate_state(1)[0] % (2**31 - 1))
+
+    def _backoff_s(self, restart: int) -> float:
+        base = min(self._backoff_base_s * (2 ** (restart - 1)), self._backoff_max_s)
+        return base * (1.0 + self._backoff_jitter * float(self._jitter_rng.random()))
+
+    def _recover(self, k: int, exc: BaseException) -> Tuple[Any, Any, Any, Any, Dict[str, Any]]:
+        tracer = tracer_mod.current()
+        slot = self._slots[k]
+        last_exc = exc
+        while slot.restarts < self._max_restarts:
+            slot.restarts += 1
+            delay = self._backoff_s(slot.restarts)
+            warnings.warn(
+                f"Env slice {k} failed ({type(last_exc).__name__}: {last_exc}); "
+                f"restart {slot.restarts}/{self._max_restarts} after {delay * 1e3:.0f}ms backoff"
+            )
+            time.sleep(delay)
+            try:
+                try:
+                    self.envs[k].close()
+                except Exception:  # noqa: BLE001 - the slice is already broken
+                    pass
+                start = time.perf_counter()
+                env = self._factories[k]()
+                obs, info = env.reset(seed=self.restart_seed(k, slot.restarts))
+                self.envs[k] = env
+                tracer.count("env_restarts")
+                tracer.add_span(
+                    "resilience/env_restart", "env", start, time.perf_counter() - start,
+                    {"slice": k, "restart": slot.restarts},
+                )
+                n = self.slice_counts[k]
+                info = dict(info)
+                info["env_restarted"] = np.ones(n, dtype=bool)
+                info["_env_restarted"] = np.ones(n, dtype=bool)
+                # Rewards 0, truncated=True: the crash point becomes an
+                # episode boundary, dropping the poisoned in-flight episode.
+                return (
+                    obs,
+                    np.zeros(n, dtype=np.float64),
+                    np.zeros(n, dtype=np.bool_),
+                    np.ones(n, dtype=np.bool_),
+                    info,
+                )
+            except Exception as rebuild_exc:  # noqa: BLE001
+                last_exc = rebuild_exc
+        # Circuit breaker tripped.
+        if self.slices == 1:
+            raise RuntimeError(
+                f"Env slice {k} exceeded max_restarts={self._max_restarts} and it is the "
+                f"only slice — cannot degrade, giving up"
+            ) from last_exc
+        slot.dead = True
+        tracer.count("env_slices_dead")
+        warnings.warn(
+            f"Env slice {k} exceeded max_restarts={self._max_restarts}: masking it out "
+            f"of the rollout (remaining slices keep training)"
+        )
+        return self._masked_step(k)
+
+    def _masked_step(self, k: int) -> Tuple[Any, Any, Any, Any, Dict[str, Any]]:
+        import gymnasium as gym
+
+        slot = self._slots[k]
+        n = self.slice_counts[k]
+        if slot.zero_obs is None:
+            slot.zero_obs = gym.vector.utils.create_empty_array(
+                self.single_observation_space, n, fn=np.zeros
+            )
+        info = {
+            "env_masked": np.ones(n, dtype=np.bool_),
+            "_env_masked": np.ones(n, dtype=np.bool_),
+        }
+        # Every masked row is truncated: zero-reward one-step episodes that
+        # no sequence sampler will chain across.
+        return (
+            slot.zero_obs,
+            np.zeros(n, dtype=np.float64),
+            np.zeros(n, dtype=np.bool_),
+            np.ones(n, dtype=np.bool_),
+            info,
+        )
+
+    @property
+    def dead_slices(self) -> List[int]:
+        return [k for k, slot in enumerate(self._slots) if slot.dead]
+
+    @property
+    def restart_counts(self) -> List[int]:
+        return [slot.restarts for slot in self._slots]
+
+
+# ---------------------------------------------------------- DispatchWatchdog
+class DispatchWatchdog:
+    """Monotonic-deadline watchdog for device work the host can't observe.
+
+    Arm around a donated train dispatch or a blocking fetch::
+
+        with watchdog.guard("train_dispatch"):
+            state = train_fn(state, batch)   # wedged XLA call -> trip
+
+    On trip (deadline exceeded while armed): ``watchdog_trips`` counter, a
+    telemetry span, a message + full ``faulthandler`` all-thread stack dump
+    to stderr (the only forensics available for a hung device call), then
+    per ``on_trip``: ``"warn"`` keeps waiting, ``"preempt"`` delivers
+    SIGTERM to the process so the PreemptionGuard path checkpoints and
+    exits, ``"abort"`` hard-exits (exit code 124, after the dump). One trip
+    per armed window. The monitor thread is lazy (first guard) and a
+    daemon, and `close()` joins it."""
+
+    def __init__(self, *, timeout_s: float = 120.0, on_trip: str = "warn") -> None:
+        if on_trip not in ("warn", "preempt", "abort"):
+            raise ValueError(f"watchdog on_trip must be warn|preempt|abort, got {on_trip!r}")
+        self.timeout_s = float(timeout_s)
+        self.on_trip = on_trip
+        self.trips = 0
+        self._cond = threading.Condition()
+        self._deadline: Optional[float] = None
+        self._label = ""
+        self._gen = 0
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    @contextmanager
+    def guard(self, label: str = "dispatch"):
+        if self.timeout_s <= 0 or self._closed:
+            yield
+            return
+        gen = self._arm(label)
+        try:
+            yield
+        finally:
+            self._disarm(gen)
+
+    def _arm(self, label: str) -> int:
+        with self._cond:
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, name="sheeprl-dispatch-watchdog", daemon=True
+                )
+                self._thread.start()
+            self._gen += 1
+            self._label = label
+            self._deadline = time.monotonic() + self.timeout_s
+            self._cond.notify_all()
+            return self._gen
+
+    def _disarm(self, gen: int) -> None:
+        with self._cond:
+            if self._gen == gen:
+                self._deadline = None
+                self._label = ""
+            self._cond.notify_all()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._closed and (
+                    self._deadline is None or time.monotonic() < self._deadline
+                ):
+                    if self._deadline is None:
+                        self._cond.wait()
+                    else:
+                        self._cond.wait(max(0.0, self._deadline - time.monotonic()))
+                if self._closed:
+                    return
+                label = self._label
+                self._deadline = None  # one trip per armed window
+            self._trip(label)
+
+    def _trip(self, label: str) -> None:
+        self.trips += 1
+        tracer = tracer_mod.current()
+        tracer.count("watchdog_trips")
+        tracer.add_span(
+            "resilience/watchdog_trip", "watchdog", time.perf_counter(), 0.0,
+            {"label": label, "timeout_s": self.timeout_s, "on_trip": self.on_trip},
+        )
+        sys.stderr.write(
+            f"\n[sheeprl-tpu watchdog] '{label}' exceeded {self.timeout_s:.1f}s — "
+            f"dumping all thread stacks (on_trip={self.on_trip})\n"
+        )
+        sys.stderr.flush()
+        try:
+            faulthandler.dump_traceback(all_threads=True)
+        except Exception:  # noqa: BLE001 - forensics must not kill the monitor
+            pass
+        if self.on_trip == "preempt":
+            os.kill(os.getpid(), signal.SIGTERM)
+        elif self.on_trip == "abort":
+            os._exit(124)
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+
+def watch(watchdog: Optional[DispatchWatchdog], label: str):
+    """`with watch(runtime.resilience.watchdog, "train_dispatch"):` — the
+    zero-cost-when-disabled form train loops use."""
+    return nullcontext() if watchdog is None else watchdog.guard(label)
+
+
+# --------------------------------------------------------------- Resilience
+class Resilience:
+    """Per-run facade the CLI installs on ``runtime.resilience``.
+
+    Holds the parsed ``resilience`` config section and owns the shared
+    :class:`DispatchWatchdog` and :class:`~sheeprl_tpu.core.chaos.ChaosMonkey`.
+    ``Runtime`` defaults to :meth:`noop` so bare programmatic use is
+    untouched; ``cli.run_algorithm`` swaps in :meth:`from_config`.
+    """
+
+    def __init__(self, cfg_section: Optional[Any] = None) -> None:
+        self._cfg = cfg_section
+        self._watchdog: Optional[DispatchWatchdog] = None
+        self._watchdog_built = False
+        chaos_cfg = _cfg_get(cfg_section, "chaos", None)
+        self.chaos_monkey: Optional[chaos.ChaosMonkey] = None
+        if bool(_cfg_get(chaos_cfg, "enabled", False)):
+            self.chaos_monkey = chaos.ChaosMonkey(_cfg_get(chaos_cfg, "injectors", []))
+
+    @classmethod
+    def noop(cls) -> "Resilience":
+        return cls(None)
+
+    @classmethod
+    def from_config(cls, cfg: Any) -> "Resilience":
+        return cls(_cfg_get(cfg, "resilience", None))
+
+    @property
+    def enabled(self) -> bool:
+        return self._cfg is not None
+
+    # ------------------------------------------------------------- pieces
+    def guard(self, *, rank_zero: bool = True) -> PreemptionGuard:
+        """Build + install the loop's PreemptionGuard (noop-shaped when the
+        resilience config is absent or preemption handling is off)."""
+        preemption = _cfg_get(self._cfg, "preemption", None)
+        enabled = bool(_cfg_get(preemption, "enabled", False)) if self._cfg is not None else False
+        guard = PreemptionGuard(
+            enabled=enabled,
+            catch_sigint=bool(_cfg_get(preemption, "catch_sigint", True)),
+            write_pointer=bool(_cfg_get(preemption, "write_pointer", True)),
+            rank_zero=rank_zero,
+            chaos_monkey=self.chaos_monkey,
+            on_close=self.close,
+        )
+        return guard.install()
+
+    @property
+    def watchdog(self) -> Optional[DispatchWatchdog]:
+        if not self._watchdog_built:
+            self._watchdog_built = True
+            wd_cfg = _cfg_get(self._cfg, "watchdog", None)
+            if self._cfg is not None and bool(_cfg_get(wd_cfg, "enabled", False)):
+                self._watchdog = DispatchWatchdog(
+                    timeout_s=float(_cfg_get(wd_cfg, "timeout_s", 120.0)),
+                    on_trip=str(_cfg_get(wd_cfg, "on_trip", "warn")),
+                )
+        return self._watchdog
+
+    def supervisor_kwargs(self) -> Optional[Dict[str, Any]]:
+        """EnvSupervisor constructor knobs when supervision is enabled, else
+        None (how ``make_vector_env`` decides whether to supervise)."""
+        sup = _cfg_get(self._cfg, "supervisor", None)
+        if self._cfg is None or not bool(_cfg_get(sup, "enabled", False)):
+            return None
+        return {
+            "max_restarts": int(_cfg_get(sup, "max_restarts", 3)),
+            "backoff_base_s": float(_cfg_get(sup, "backoff_base_s", 0.05)),
+            "backoff_max_s": float(_cfg_get(sup, "backoff_max_s", 5.0)),
+            "backoff_jitter": float(_cfg_get(sup, "backoff_jitter", 0.25)),
+        }
+
+    def close(self) -> None:
+        if self._watchdog is not None:
+            self._watchdog.close()
+            self._watchdog = None
+            self._watchdog_built = False

@@ -262,6 +262,12 @@ class Runtime:
         # replaces this with Telemetry.from_config(cfg); the default no-op
         # keeps direct Runtime construction (tests, scripts) zero-cost.
         self.telemetry: Telemetry = Telemetry.noop()
+        # The run's fault-tolerance surface (sheeprl_tpu/core/resilience):
+        # same contract as telemetry — the CLI installs Resilience.from_config
+        # and the no-op default keeps bare Runtime construction untouched.
+        from sheeprl_tpu.core.resilience import Resilience
+
+        self.resilience: Resilience = Resilience.noop()
 
     # ------------------------------------------------------------ lifecycle
     def launch(self) -> "Runtime":
@@ -449,4 +455,5 @@ def get_single_device_runtime(runtime: Runtime) -> Runtime:
     view.seed = runtime.seed
     view.root_key = runtime.root_key
     view.telemetry = runtime.telemetry
+    view.resilience = runtime.resilience
     return view

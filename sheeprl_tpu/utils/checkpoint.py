@@ -1,9 +1,10 @@
-"""Checkpoint write/read with keep-last garbage collection.
+"""Checkpoint write/read with atomic commit, manifests, and per-rank GC.
 
 Parity with the reference CheckpointCallback (sheeprl/utils/callback.py:14-148):
 state = model params + optimizer states + counters (+ algorithm extras such as
 replay buffers), written at `<log_dir>/checkpoint/ckpt_<policy_step>_<rank>.ckpt`
-with at most `keep_last` checkpoints retained.
+with at most `keep_last` checkpoints retained — per rank, so a multi-rank run
+never GCs another rank's newest snapshot.
 
 Backend: Orbax `StandardCheckpointer` over a pure-numpy pytree — every jax
 Array is pulled to host first so saves never hold device memory, and restores
@@ -12,22 +13,67 @@ torch's map_location). A checkpoint is a *directory* (Orbax layout), not a
 single file; the `.ckpt` suffix is kept for reference-parity path printing.
 Non-array leaves (ints, floats, strings, None) are pickled alongside in
 `aux.pkl` because Orbax handles only array-like leaves.
+
+Atomicity (the Podracer preemption model — arXiv:2104.06272 — assumes saves
+survive a kill at ANY byte): the whole checkpoint is staged in a temp sibling
+directory (`.tmp-*`, same filesystem), a `manifest.json` (schema version,
+step, rank, leaf counts, content digests) is written and fsynced last, and the
+directory is committed with a single `os.rename`. A kill mid-save leaves
+either the previous snapshot intact or a `.tmp-*` orphan that
+:func:`find_latest_valid_checkpoint` ignores — there is no observable state
+where the old checkpoint is gone and the new one incomplete. Layout::
+
+    ckpt_<step>_<rank>.ckpt/
+        arrays/         # Orbax tree
+        aux.pkl         # non-array leaves
+        manifest.json   # written + fsynced last, inside the staging dir
+
+Pre-manifest checkpoints (Orbax tree at the directory root) still load; they
+are simply never considered *valid* by the resilience fallback scan.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import pickle
 import re
 import shutil
-from typing import Any, Dict, Optional
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
-_CKPT_RE = re.compile(r"ckpt_(\d+)_\d+\.ckpt$")
+from sheeprl_tpu.core import chaos
+
+_CKPT_RE = re.compile(r"ckpt_(\d+)_(\d+)\.ckpt$")
+_TMP_PREFIX = ".tmp-"
+_TRASH_PREFIX = ".trash-"
+_STALE_TMP_S = 3600.0
+
+MANIFEST_NAME = "manifest.json"
+MANIFEST_SCHEMA_VERSION = 1
 
 _ARRAY_TYPES = (np.ndarray, np.generic, jax.Array)
+
+# Callables invoked with the final committed path after every successful
+# save — how the PreemptionGuard learns about saves without every train loop
+# having to thread a callback through its checkpoint block.
+_POST_SAVE_HOOKS: List[Callable[[str], None]] = []
+
+
+def register_post_save_hook(hook: Callable[[str], None]) -> None:
+    _POST_SAVE_HOOKS.append(hook)
+
+
+def unregister_post_save_hook(hook: Callable[[str], None]) -> None:
+    try:
+        _POST_SAVE_HOOKS.remove(hook)
+    except ValueError:
+        pass
 
 
 def _split_state(tree: Any, path: str = ""):
@@ -67,24 +113,253 @@ def _merge_state(tree: Any, aux: Dict[str, Any], path: str = "") -> Any:
     return walk(tree, path)
 
 
-def save_checkpoint(ckpt_path: str, state: Dict[str, Any], keep_last: Optional[int] = None) -> str:
-    """Write `state` (pytree) to `ckpt_path` and GC old checkpoints in the
-    same directory down to `keep_last` (reference: callback.py:30-38,144-148).
+# ------------------------------------------------------------ fsync helpers
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync a directory so the rename that committed it is durable. Best
+    effort — some filesystems refuse O_RDONLY dir fds."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+# ------------------------------------------------------------ digests
+def _digest_arrays(arrays: Any) -> Tuple[str, int]:
+    """sha256 over every array leaf (dtype+shape+bytes, flatten order) and
+    the leaf count. Restore-side recomputation matches because Orbax
+    round-trips numpy dtypes/shapes exactly and tree_leaves order is
+    structure-stable."""
+    h = hashlib.sha256()
+    leaves = jax.tree_util.tree_leaves(arrays)
+    for leaf in leaves:
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest(), len(leaves)
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fp:
+        for block in iter(lambda: fp.read(1 << 20), b""):
+            h.update(block)
+    return h.hexdigest()
+
+
+def parse_ckpt_name(ckpt_path: str) -> Optional[Tuple[int, int]]:
+    """(policy_step, rank) from a `ckpt_<step>_<rank>.ckpt` path, else None."""
+    m = _CKPT_RE.search(os.path.basename(ckpt_path))
+    return (int(m.group(1)), int(m.group(2))) if m else None
+
+
+def read_manifest(ckpt_path: str) -> Optional[Dict[str, Any]]:
+    """Parse `manifest.json` from a checkpoint dir; None if absent/corrupt."""
+    manifest_path = os.path.join(ckpt_path, MANIFEST_NAME)
+    try:
+        with open(manifest_path, "rb") as fp:
+            manifest = json.load(fp)
+    except (OSError, ValueError):
+        return None
+    return manifest if isinstance(manifest, dict) else None
+
+
+def validate_checkpoint(ckpt_path: str, verify_digest: bool = False) -> bool:
+    """True iff `ckpt_path` is a complete, committed checkpoint.
+
+    Structural validation (default): manifest parses, schema is known, and
+    the files it promises exist. With `verify_digest`, additionally rehash
+    aux.pkl and reload + rehash every array leaf against the manifest —
+    expensive, but catches bit rot, not just torn writes.
+    """
+    manifest = read_manifest(ckpt_path)
+    if manifest is None:
+        return False
+    try:
+        if int(manifest["schema_version"]) > MANIFEST_SCHEMA_VERSION:
+            return False
+        int(manifest["step"])
+        int(manifest["rank"])
+        leaf_count = int(manifest["leaf_count"])
+    except (KeyError, TypeError, ValueError):
+        return False
+    arrays_dir = os.path.join(ckpt_path, "arrays")
+    aux_file = os.path.join(ckpt_path, "aux.pkl")
+    if not os.path.isdir(arrays_dir) or not os.path.isfile(aux_file):
+        return False
+    if not verify_digest:
+        return True
+    try:
+        if _sha256_file(aux_file) != manifest.get("aux_sha256"):
+            return False
+        import orbax.checkpoint as ocp
+
+        with ocp.StandardCheckpointer() as ckptr:
+            arrays = ckptr.restore(os.path.abspath(arrays_dir))
+        digest, n = _digest_arrays(arrays)
+        return n == leaf_count and digest == manifest.get("digest")
+    except Exception:  # noqa: BLE001 - any unreadable payload means invalid
+        return False
+
+
+def find_latest_valid_checkpoint(
+    ckpt_dir: str, rank: Optional[int] = None, verify_digest: bool = False
+) -> Optional[str]:
+    """Newest checkpoint in `ckpt_dir` that passes validation, or None.
+
+    Scans `ckpt_<step>_<rank>.ckpt` entries newest-step-first (optionally for
+    one rank) and skips anything torn, truncated, or pre-manifest — the
+    fallback path a preempted run resumes through when the most recent save
+    was interrupted.
+    """
+    if not os.path.isdir(ckpt_dir):
+        return None
+    entries = []
+    for name in os.listdir(ckpt_dir):
+        m = _CKPT_RE.search(name)
+        if not m:
+            continue
+        if rank is not None and int(m.group(2)) != rank:
+            continue
+        entries.append((int(m.group(1)), name))
+    for _, name in sorted(entries, reverse=True):
+        full = os.path.join(ckpt_dir, name)
+        if validate_checkpoint(full, verify_digest=verify_digest):
+            return full
+    return None
+
+
+def _gc_stale_staging(ckpt_dir: str) -> None:
+    """Remove `.tmp-*` / `.trash-*` orphans left by killed saves, once old
+    enough that no live writer can still own them."""
+    now = time.time()
+    try:
+        names = os.listdir(ckpt_dir)
+    except OSError:
+        return
+    for name in names:
+        if not (name.startswith(_TMP_PREFIX) or name.startswith(_TRASH_PREFIX)):
+            continue
+        full = os.path.join(ckpt_dir, name)
+        try:
+            if name.startswith(_TRASH_PREFIX) or now - os.path.getmtime(full) > _STALE_TMP_S:
+                shutil.rmtree(full, ignore_errors=True)
+        except OSError:
+            continue
+
+
+def save_checkpoint(
+    ckpt_path: str,
+    state: Dict[str, Any],
+    keep_last: Optional[int] = None,
+    *,
+    step: Optional[int] = None,
+    rank: Optional[int] = None,
+) -> str:
+    """Atomically write `state` (pytree) to `ckpt_path` and GC old
+    checkpoints in the same directory down to `keep_last` per rank
+    (reference: callback.py:30-38,144-148).
+
+    The previous snapshot at `ckpt_path` (if any) stays on disk until the new
+    one is fully staged and committed; a kill at any point leaves a valid
+    prior state for :func:`find_latest_valid_checkpoint`.
     """
     import orbax.checkpoint as ocp
 
+    from sheeprl_tpu.telemetry import tracer as tracer_mod
+
     ckpt_path = os.path.abspath(ckpt_path)
-    os.makedirs(os.path.dirname(ckpt_path), exist_ok=True)
-    host_state = jax.tree_util.tree_map(lambda x: np.asarray(x) if isinstance(x, jax.Array) else x, state)
+    parent = os.path.dirname(ckpt_path)
+    basename = os.path.basename(ckpt_path)
+    os.makedirs(parent, exist_ok=True)
+    if step is None or rank is None:
+        parsed = parse_ckpt_name(basename)
+        step = step if step is not None else (parsed[0] if parsed else 0)
+        rank = rank if rank is not None else (parsed[1] if parsed else 0)
+
+    tracer = tracer_mod.current()
+    start = time.perf_counter()
+    chaos.maybe_fail("checkpoint.before_write")
+    host_state = jax.tree_util.tree_map(
+        lambda x: np.asarray(x) if isinstance(x, jax.Array) else x, state
+    )
     arrays, aux = _split_state(host_state)
-    if os.path.exists(ckpt_path):
-        shutil.rmtree(ckpt_path)
-    with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(ckpt_path, arrays)
-    with open(os.path.join(ckpt_path, "aux.pkl"), "wb") as fp:
-        pickle.dump(aux, fp)
+
+    staging = os.path.join(parent, f"{_TMP_PREFIX}{basename}-{os.getpid()}-{uuid.uuid4().hex[:8]}")
+    try:
+        # Stage the full payload in a temp sibling (same filesystem, so the
+        # final os.rename is atomic).
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(os.path.join(staging, "arrays"), arrays)
+        aux_file = os.path.join(staging, "aux.pkl")
+        with open(aux_file, "wb") as fp:
+            pickle.dump(aux, fp)
+            fp.flush()
+            os.fsync(fp.fileno())
+        chaos.maybe_fail("checkpoint.before_manifest")
+
+        digest, leaf_count = _digest_arrays(arrays)
+        manifest = {
+            "schema_version": MANIFEST_SCHEMA_VERSION,
+            "step": int(step),
+            "rank": int(rank),
+            "leaf_count": leaf_count,
+            "aux_count": len(aux),
+            "digest": digest,
+            "aux_sha256": _sha256_file(aux_file),
+            "created_unix": time.time(),
+        }
+        manifest_file = os.path.join(staging, MANIFEST_NAME)
+        with open(manifest_file, "w") as fp:
+            json.dump(manifest, fp, indent=2)
+            fp.flush()
+            os.fsync(fp.fileno())
+        _fsync_dir(staging)
+        chaos.maybe_fail("checkpoint.before_commit")
+
+        # Commit: single atomic rename (plus a swap through `.trash-*` when
+        # re-saving over an existing snapshot — the old state stays reachable
+        # until the new one is in place).
+        if os.path.lexists(ckpt_path):
+            trash = os.path.join(parent, f"{_TRASH_PREFIX}{basename}-{uuid.uuid4().hex[:8]}")
+            os.rename(ckpt_path, trash)
+            os.rename(staging, ckpt_path)
+            shutil.rmtree(trash, ignore_errors=True)
+        else:
+            os.rename(staging, ckpt_path)
+        _fsync_dir(parent)
+    except BaseException:
+        # A failed save must not leave the target half-written — it never
+        # does (we only rename at the end) — but also should not leak the
+        # staging dir on the *exception* path (a hard kill still can; see
+        # _gc_stale_staging).
+        shutil.rmtree(staging, ignore_errors=True)
+        raise
+
+    tracer.count("checkpoint_saves")
+    tracer.add_span(
+        "checkpoint/save", "checkpoint", start, time.perf_counter() - start,
+        {"step": int(step), "rank": int(rank)},
+    )
     if keep_last is not None and keep_last > 0:
-        _gc_old_checkpoints(os.path.dirname(ckpt_path), keep_last)
+        _gc_old_checkpoints(parent, keep_last)
+    _gc_stale_staging(parent)
+    for hook in list(_POST_SAVE_HOOKS):
+        hook(ckpt_path)
     return ckpt_path
 
 
@@ -100,6 +375,11 @@ def load_checkpoint(ckpt_path: str, target: Optional[Any] = None) -> Dict[str, A
     import orbax.checkpoint as ocp
 
     ckpt_path = os.path.abspath(ckpt_path)
+    # Manifest layout nests the Orbax tree under arrays/; pre-manifest
+    # checkpoints stored it at the directory root.
+    arrays_path = os.path.join(ckpt_path, "arrays")
+    if not os.path.isdir(arrays_path):
+        arrays_path = ckpt_path
     aux_file = os.path.join(ckpt_path, "aux.pkl")
     aux: Dict[str, Any] = {}
     if os.path.exists(aux_file):
@@ -110,10 +390,24 @@ def load_checkpoint(ckpt_path: str, target: Optional[Any] = None) -> Dict[str, A
             template, _ = _split_state(
                 jax.tree_util.tree_map(lambda x: np.asarray(x) if isinstance(x, jax.Array) else x, target)
             )
-            arrays = ckptr.restore(ckpt_path, template)
+            arrays = ckptr.restore(arrays_path, template)
         else:
-            arrays = ckptr.restore(ckpt_path)
+            arrays = ckptr.restore(arrays_path)
     return _merge_state(arrays, aux)
+
+
+def _keystr(path: Tuple[Any, ...]) -> str:
+    """Normalize a tree_flatten_with_path key path to `a/b/0/c` form so dict
+    keys, namedtuple fields, and list indices all print uniformly."""
+    parts = []
+    for entry in path:
+        for attr in ("key", "name", "idx"):
+            if hasattr(entry, attr):
+                parts.append(str(getattr(entry, attr)))
+                break
+        else:
+            parts.append(str(entry))
+    return "/".join(parts)
 
 
 def restore_opt_state(fresh_opt_state: Any, ckpt_opt_state: Any) -> Any:
@@ -122,28 +416,56 @@ def restore_opt_state(fresh_opt_state: Any, ckpt_opt_state: Any) -> Any:
     Checkpoints store generic containers (namedtuples degrade on restore
     without a target); the authoritative structure comes from `tx.init`.
     Raises a readable error when the two trees disagree (e.g. the optimizer
-    config changed between the run and the resume).
+    config changed between the run and the resume), naming the first few
+    key-paths where the structures diverge.
     """
     import jax.numpy as jnp
 
     structure = jax.tree_util.tree_structure(fresh_opt_state)
-    leaves = jax.tree_util.tree_leaves(jax.tree_util.tree_map(jnp.asarray, ckpt_opt_state))
+    ckpt_tree = jax.tree_util.tree_map(jnp.asarray, ckpt_opt_state)
+    leaves = jax.tree_util.tree_leaves(ckpt_tree)
     if structure.num_leaves != len(leaves):
+        fresh_paths = [
+            _keystr(p) for p, _ in jax.tree_util.tree_flatten_with_path(fresh_opt_state)[0]
+        ]
+        ckpt_paths = [
+            _keystr(p) for p, _ in jax.tree_util.tree_flatten_with_path(ckpt_tree)[0]
+        ]
+        fresh_only = [p for p in fresh_paths if p not in set(ckpt_paths)][:4]
+        ckpt_only = [p for p in ckpt_paths if p not in set(fresh_paths)][:4]
+        detail = []
+        if fresh_only:
+            detail.append(f"expected by the fresh optimizer but missing from the checkpoint: {fresh_only}")
+        if ckpt_only:
+            detail.append(f"present in the checkpoint but not in the fresh optimizer: {ckpt_only}")
+        if not detail:
+            # Same path names, different multiplicity — show where the zip
+            # first disagrees.
+            for i, (a, b) in enumerate(zip(fresh_paths, ckpt_paths)):
+                if a != b:
+                    detail.append(f"first divergence at leaf {i}: fresh={a!r} vs checkpoint={b!r}")
+                    break
         raise ValueError(
             f"Checkpointed optimizer state has {len(leaves)} leaves but the freshly-built "
-            f"optimizer expects {structure.num_leaves} — did the optimizer config change since the checkpoint?"
+            f"optimizer expects {structure.num_leaves} — did the optimizer config change since "
+            f"the checkpoint? Diverging key-paths: " + ("; ".join(detail) if detail else "(none resolvable)")
         )
     return jax.tree_util.tree_unflatten(structure, leaves)
 
 
 def _gc_old_checkpoints(ckpt_dir: str, keep_last: int) -> None:
-    """Delete all but the newest `keep_last` checkpoints, ordered by the
-    policy-step embedded in the name (reference: callback.py:144-148)."""
-    entries = []
+    """Delete all but the newest `keep_last` checkpoints **per rank**,
+    ordered by the policy-step embedded in the name (reference:
+    callback.py:144-148). Grouping by rank matters: a global sort would let
+    one rank's burst of saves GC another rank's only snapshot."""
+    by_rank: Dict[int, List[Tuple[int, str]]] = {}
     for name in os.listdir(ckpt_dir):
         m = _CKPT_RE.search(name)
         if m:
-            entries.append((int(m.group(1)), os.path.join(ckpt_dir, name)))
-    entries.sort()
-    for _, path in entries[:-keep_last] if keep_last < len(entries) else []:
-        shutil.rmtree(path, ignore_errors=True)
+            by_rank.setdefault(int(m.group(2)), []).append(
+                (int(m.group(1)), os.path.join(ckpt_dir, name))
+            )
+    for entries in by_rank.values():
+        entries.sort()
+        for _, path in entries[:-keep_last] if keep_last < len(entries) else []:
+            shutil.rmtree(path, ignore_errors=True)

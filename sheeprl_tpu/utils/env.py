@@ -287,12 +287,44 @@ def make_vector_env(
     ]
     if restart_on_exception:
         thunks = [partial(RestartOnException, t) for t in thunks]
+    res_cfg = cfg.get("resilience") or {}
+    chaos_cfg = res_cfg.get("chaos") or {}
+    if chaos_cfg.get("enabled", False):
+        # Fault injection (core/chaos.py): env_step_raise injectors wrap the
+        # targeted env thunk; a process-global fired registry keeps a
+        # supervisor-rebuilt env from replaying the same configured fault.
+        from sheeprl_tpu.core.chaos import wrap_env_thunks
+
+        thunks = wrap_env_thunks(thunks, chaos_cfg.get("injectors") or [], base)
     cls = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
     slices = int(cfg.env.get("pipeline_slices", 1) or 1)
-    if slices <= 1:
-        envs: gym.vector.VectorEnv = cls(
-            thunks, autoreset_mode=gym.vector.AutoresetMode.SAME_STEP
+    sup_cfg = res_cfg.get("supervisor") or {}
+    supervise = bool(sup_cfg.get("enabled", False))
+
+    def make_slice(s0: int, s1: int) -> gym.vector.VectorEnv:
+        return cls(thunks[s0:s1], autoreset_mode=gym.vector.AutoresetMode.SAME_STEP)
+
+    if supervise:
+        # Supervised env workers (core/resilience.py): the vector env — or
+        # each pipeline slice — becomes a restartable EnvSupervisor slot.
+        # EnvSupervisor subclasses EnvSliceGroup, so the single-slot case is
+        # still a drop-in vector env and the sliced case is still what
+        # InteractionPipeline.interact expects.
+        from sheeprl_tpu.core.interact import split_ranges
+        from sheeprl_tpu.core.resilience import EnvSupervisor
+
+        ranges = split_ranges(cfg.env.num_envs, max(1, slices))
+        envs: gym.vector.VectorEnv = EnvSupervisor(
+            [make_slice(s0, s1) for s0, s1 in ranges],
+            [partial(make_slice, s0, s1) for s0, s1 in ranges],
+            seed=cfg.seed + base,
+            max_restarts=int(sup_cfg.get("max_restarts", 3)),
+            backoff_base_s=float(sup_cfg.get("backoff_base_s", 0.05)),
+            backoff_max_s=float(sup_cfg.get("backoff_max_s", 5.0)),
+            backoff_jitter=float(sup_cfg.get("backoff_jitter", 0.25)),
         )
+    elif slices <= 1:
+        envs = make_slice(0, cfg.env.num_envs)
     else:
         # env.pipeline_slices > 1: one sub vector env per contiguous column
         # range, presented as one num_envs-wide env (core/interact.py). Env
@@ -301,8 +333,7 @@ def make_vector_env(
         from sheeprl_tpu.core.interact import EnvSliceGroup, split_ranges
 
         sub_envs = [
-            cls(thunks[s0:s1], autoreset_mode=gym.vector.AutoresetMode.SAME_STEP)
-            for s0, s1 in split_ranges(cfg.env.num_envs, slices)
+            make_slice(s0, s1) for s0, s1 in split_ranges(cfg.env.num_envs, slices)
         ]
         envs = EnvSliceGroup(sub_envs)
     seed_vector_spaces(envs, cfg.seed + base)

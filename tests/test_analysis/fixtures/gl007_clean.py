@@ -1,0 +1,45 @@
+"""GL007 clean: the sanctioned atomic shape — stage into a temp sibling,
+fsync, commit with one rename — plus reads, appends, and a suppressed
+deliberate in-place write."""
+
+import json
+import os
+import pickle
+import shutil
+
+
+def save_checkpoint_atomically(ckpt_path, ckptr, arrays, aux, manifest):
+    parent = os.path.dirname(ckpt_path)
+    staging = os.path.join(parent, f".tmp-{os.path.basename(ckpt_path)}")
+    ckptr.save(os.path.join(staging, "arrays"), arrays)
+    with open(os.path.join(staging, "aux.pkl"), "wb") as fp:
+        pickle.dump(aux, fp)
+        fp.flush()
+        os.fsync(fp.fileno())
+    with open(os.path.join(staging, "manifest.json"), "w") as fp:
+        json.dump(manifest, fp)
+        fp.flush()
+        os.fsync(fp.fileno())
+    os.rename(staging, ckpt_path)
+
+
+def gc_trash(parent, trash_dir):
+    # Deleting a commit-swap leftover writes nothing afterwards: not a
+    # delete-then-write window.
+    shutil.rmtree(os.path.join(parent, trash_dir), ignore_errors=True)
+
+
+def read_and_append(ckpt_path, event):
+    with open(os.path.join(ckpt_path, "manifest.json")) as fp:
+        manifest = json.load(fp)
+    # Append-only event logs are a legitimate non-atomic format.
+    with open(os.path.join(ckpt_path, "events.jsonl"), "a") as fp:
+        fp.write(json.dumps(event) + "\n")
+    return manifest
+
+
+def write_scratch_marker(ckpt_path, payload):
+    # A deliberate, documented in-place write (crash marker whose torn state
+    # is itself the signal) may be suppressed explicitly.
+    with open(ckpt_path + ".crashed", "w") as fp:  # graftlint: disable=GL007
+        fp.write(payload)

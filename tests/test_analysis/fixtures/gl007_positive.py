@@ -1,0 +1,28 @@
+"""GL007 true positives: the exact non-atomic checkpoint shapes that lose a
+preempted run — delete-then-write and in-place final writes."""
+
+import json
+import os
+import pickle
+import shutil
+
+
+def save_checkpoint_delete_then_write(ckpt_path, ckptr, arrays, aux):
+    # The seed bug: the old snapshot is gone before the new one exists.
+    if os.path.exists(ckpt_path):
+        shutil.rmtree(ckpt_path)  # <- GL007
+    ckptr.save(ckpt_path, arrays)
+    with open(os.path.join(ckpt_path, "aux.pkl"), "wb") as fp:  # <- GL007
+        pickle.dump(aux, fp)
+
+
+def overwrite_manifest_in_place(ckpt_path, manifest):
+    # Torn-file window: a kill mid-dump leaves invalid JSON at the final path.
+    with open(os.path.join(ckpt_path, "manifest.json"), "w") as fp:  # <- GL007
+        json.dump(manifest, fp)
+
+
+def clear_and_redump(run_dir, ckptr, state):
+    shutil.rmtree(run_dir)  # <- GL007
+    os.makedirs(run_dir)
+    ckptr.save(os.path.join(run_dir, "state"), state)

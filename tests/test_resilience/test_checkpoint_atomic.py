@@ -1,0 +1,139 @@
+"""Atomic checkpoint writer: manifest validation, crash-mid-save fallback,
+corruption tolerance, rank-aware GC, and readable restore errors.
+
+The invariant under test everywhere: there is no observable on-disk state in
+which the old snapshot is gone and the new one is incomplete."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.core import chaos
+from sheeprl_tpu.core.chaos import ChaosFault, corrupt_checkpoint
+from sheeprl_tpu.utils.checkpoint import (
+    MANIFEST_SCHEMA_VERSION,
+    find_latest_valid_checkpoint,
+    load_checkpoint,
+    read_manifest,
+    restore_opt_state,
+    save_checkpoint,
+    validate_checkpoint,
+)
+
+
+@pytest.fixture(autouse=True)
+def _chaos_clean():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def _state(v=0.0):
+    return {
+        "agent": {
+            "w": jnp.arange(6, dtype=jnp.float32).reshape(2, 3) + v,
+            "b": jnp.full((3,), v, dtype=jnp.float32),
+        },
+        "iter_num": 4 + int(v),
+        "note": "aux-payload",
+    }
+
+
+def _entries(d):
+    return sorted(n for n in os.listdir(d) if not n.startswith("."))
+
+
+def test_save_writes_manifest_and_roundtrips(tmp_path):
+    path = str(tmp_path / "ckpt_8_0.ckpt")
+    save_checkpoint(path, _state())
+    manifest = read_manifest(path)
+    assert manifest["schema_version"] == MANIFEST_SCHEMA_VERSION
+    assert manifest["step"] == 8 and manifest["rank"] == 0
+    assert manifest["leaf_count"] == 2 and manifest["aux_count"] == 2
+    assert validate_checkpoint(path, verify_digest=True)
+    loaded = load_checkpoint(path)
+    np.testing.assert_array_equal(
+        np.asarray(loaded["agent"]["w"]), np.arange(6, dtype=np.float32).reshape(2, 3)
+    )
+    assert loaded["iter_num"] == 4 and loaded["note"] == "aux-payload"
+
+
+@pytest.mark.parametrize(
+    "fail_point",
+    ["checkpoint.before_write", "checkpoint.before_manifest", "checkpoint.before_commit"],
+)
+def test_crash_mid_save_preserves_previous_snapshot(tmp_path, fail_point):
+    prev = str(tmp_path / "ckpt_8_0.ckpt")
+    save_checkpoint(prev, _state(0.0))
+    chaos.arm_fail_point(fail_point)
+    with pytest.raises(ChaosFault):
+        save_checkpoint(str(tmp_path / "ckpt_16_0.ckpt"), _state(1.0))
+    # The target never appeared, no staging leftovers, the previous snapshot
+    # is untouched and is what the resume path finds.
+    assert _entries(str(tmp_path)) == ["ckpt_8_0.ckpt"]
+    assert find_latest_valid_checkpoint(str(tmp_path)) == prev
+    assert validate_checkpoint(prev, verify_digest=True)
+
+
+def test_resave_over_existing_path_swaps_atomically(tmp_path):
+    path = str(tmp_path / "ckpt_8_0.ckpt")
+    save_checkpoint(path, _state(0.0))
+    save_checkpoint(path, _state(2.0))
+    assert _entries(str(tmp_path)) == ["ckpt_8_0.ckpt"]
+    loaded = load_checkpoint(path)
+    np.testing.assert_array_equal(
+        np.asarray(loaded["agent"]["b"]), np.full((3,), 2.0, np.float32)
+    )
+
+
+@pytest.mark.parametrize(
+    "mode", ["truncate_manifest", "delete_manifest", "garbage_manifest", "delete_arrays"]
+)
+def test_find_latest_skips_corrupt_checkpoints(tmp_path, mode):
+    older = str(tmp_path / "ckpt_8_0.ckpt")
+    newer = str(tmp_path / "ckpt_16_0.ckpt")
+    save_checkpoint(older, _state(0.0))
+    save_checkpoint(newer, _state(1.0))
+    assert find_latest_valid_checkpoint(str(tmp_path)) == newer
+    corrupt_checkpoint(newer, mode)
+    assert not validate_checkpoint(newer)
+    assert find_latest_valid_checkpoint(str(tmp_path)) == older
+
+
+def test_find_latest_rank_filter(tmp_path):
+    r0 = str(tmp_path / "ckpt_8_0.ckpt")
+    r1 = str(tmp_path / "ckpt_16_1.ckpt")
+    save_checkpoint(r0, _state())
+    save_checkpoint(r1, _state())
+    assert find_latest_valid_checkpoint(str(tmp_path)) == r1
+    assert find_latest_valid_checkpoint(str(tmp_path), rank=0) == r0
+
+
+def test_gc_is_rank_aware(tmp_path):
+    # Rank 1 saves once; rank 0 then saves a burst with keep_last=2. A global
+    # sort would GC rank 1's only snapshot — the per-rank grouping must not.
+    save_checkpoint(str(tmp_path / "ckpt_8_1.ckpt"), _state(), keep_last=2)
+    for step in (8, 16, 24):
+        save_checkpoint(str(tmp_path / f"ckpt_{step}_0.ckpt"), _state(), keep_last=2)
+    assert _entries(str(tmp_path)) == ["ckpt_16_0.ckpt", "ckpt_24_0.ckpt", "ckpt_8_1.ckpt"]
+
+
+def test_digest_verification_catches_tampered_aux(tmp_path):
+    path = str(tmp_path / "ckpt_8_0.ckpt")
+    save_checkpoint(path, _state())
+    with open(os.path.join(path, "aux.pkl"), "ab") as fp:
+        fp.write(b"\x00")
+    assert validate_checkpoint(path)  # structurally still complete
+    assert not validate_checkpoint(path, verify_digest=True)
+
+
+def test_restore_opt_state_names_diverging_keypaths():
+    fresh = {"mu": {"w": jnp.zeros((2,))}, "nu": {"w": jnp.zeros((2,))}}
+    ckpt = {"mu": {"w": np.zeros((2,))}}
+    with pytest.raises(ValueError) as exc:
+        restore_opt_state(fresh, ckpt)
+    msg = str(exc.value)
+    assert "nu/w" in msg
+    assert "missing from the checkpoint" in msg

@@ -1,0 +1,208 @@
+"""Chaos-marked end-to-end fault scenarios driven through the CLI:
+SIGTERM mid-train -> drain -> preemption save -> auto-resume, and a
+fail-point crash inside the checkpoint commit window.
+
+All scenarios run deterministically on the virtual CPU platform; the
+resumed run must land exactly where an uninterrupted run does."""
+
+import json
+import os
+
+import pytest
+
+from sheeprl_tpu.cli import run
+from sheeprl_tpu.core import chaos
+from sheeprl_tpu.core.chaos import ChaosFault
+from sheeprl_tpu.core.resilience import AUTORESUME_NAME
+from sheeprl_tpu.utils.checkpoint import (
+    find_latest_valid_checkpoint,
+    load_checkpoint,
+    parse_ckpt_name,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _isolated(tmp_path, monkeypatch):
+    # Keep logs/ out of the repo and injector state out of the next test.
+    monkeypatch.chdir(tmp_path)
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def _find_ckpts(root):
+    found = []
+    for r, dirs, _ in os.walk(root):
+        for d in dirs:
+            if d.startswith("ckpt_") and d.endswith(".ckpt"):
+                found.append(os.path.realpath(os.path.join(r, d)))
+    return sorted(found, key=lambda p: parse_ckpt_name(p)[0])
+
+
+def _find_pointers(root):
+    return [
+        os.path.join(r, f)
+        for r, _, files in os.walk(root)
+        for f in files
+        if f == AUTORESUME_NAME
+    ]
+
+
+def sac_args(total_steps=32, **extra):
+    args = [
+        "exp=sac",
+        "env=dummy",
+        "env.id=continuous_dummy",
+        "env.wrapper.id=continuous_dummy",
+        "metric.log_level=0",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "algo.per_rank_batch_size=4",
+        "algo.learning_starts=4",
+        "algo.hidden_size=8",
+        "algo.run_test=False",
+        f"algo.total_steps={total_steps}",
+        "buffer.memmap=False",
+        "buffer.size=64",
+        "buffer.checkpoint=True",
+        "checkpoint.every=0",
+        "checkpoint.save_last=True",
+        "fabric.accelerator=cpu",
+    ]
+    for k, v in extra.items():
+        args.append(f"{k}={v}")
+    return args
+
+
+def dv3_args(total_steps=8, **extra):
+    args = [
+        "exp=dreamer_v3",
+        "env=dummy",
+        "metric.log_level=0",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "env.screen_size=64",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.per_rank_batch_size=2",
+        "algo.per_rank_sequence_length=1",
+        "algo.horizon=2",
+        "algo.world_model.encoder.cnn_channels_multiplier=2",
+        "algo.world_model.recurrent_model.recurrent_state_size=8",
+        "algo.world_model.representation_model.hidden_size=8",
+        "algo.world_model.transition_model.hidden_size=8",
+        "algo.world_model.stochastic_size=4",
+        "algo.world_model.discrete_size=4",
+        "algo.learning_starts=0",
+        "algo.run_test=False",
+        f"algo.total_steps={total_steps}",
+        "buffer.memmap=False",
+        "buffer.checkpoint=True",
+        "checkpoint.every=0",
+        "checkpoint.save_last=True",
+        "fabric.accelerator=cpu",
+    ]
+    for k, v in extra.items():
+        args.append(f"{k}={v}")
+    return args
+
+
+def test_sac_sigterm_preempt_then_auto_resume_matches_baseline(tmp_path, monkeypatch):
+    # Uninterrupted baseline run.
+    base_dir = tmp_path / "baseline"
+    base_dir.mkdir()
+    monkeypatch.chdir(base_dir)
+    run(sac_args())
+    baseline = _find_ckpts(base_dir)[-1]
+    assert parse_ckpt_name(baseline)[0] == 32
+
+    # Same run with SIGTERM injected at policy step 16: the guard drains,
+    # saves, writes the auto-resume pointer, and the loop exits cleanly.
+    chaos_dir = tmp_path / "chaos"
+    chaos_dir.mkdir()
+    monkeypatch.chdir(chaos_dir)
+    run(
+        sac_args(
+            **{
+                "resilience.chaos.enabled": True,
+                "resilience.chaos.injectors": "[{kind: sigterm, at_step: 16}]",
+            }
+        )
+    )
+    preempt_ckpt = _find_ckpts(chaos_dir)[-1]
+    assert parse_ckpt_name(preempt_ckpt)[0] == 16
+    pointers = _find_pointers(chaos_dir)
+    assert len(pointers) == 1
+    with open(pointers[0]) as fp:
+        pointer = json.load(fp)
+    assert os.path.realpath(pointer["ckpt_path"]) == preempt_ckpt
+    assert pointer["signal"] == 15
+    assert pointer["policy_step"] == 16
+
+    # Resume from the pointer (checkpoint.resume_from=auto:<root>) and finish.
+    chaos.reset()
+    run(
+        sac_args(
+            **{
+                "checkpoint.resume_from": "auto:logs/runs",
+                "algo.learning_starts": 0,
+            }
+        )
+    )
+    resumed = _find_ckpts(chaos_dir)[-1]
+    assert parse_ckpt_name(resumed)[0] == 32
+
+    # Preempt + resume lands exactly where the uninterrupted run did: same
+    # iteration counter and the same replay-buffer write position.
+    a = load_checkpoint(baseline)
+    b = load_checkpoint(resumed)
+    assert a["iter_num"] == b["iter_num"]
+    assert a["rb"]._pos == b["rb"]._pos
+    assert a["rb"].buffer_size == b["rb"].buffer_size
+
+
+def test_dreamer_v3_sigterm_preempt_then_auto_resume(tmp_path):
+    run(
+        dv3_args(
+            **{
+                "resilience.chaos.enabled": True,
+                "resilience.chaos.injectors": "[{kind: sigterm, at_step: 4}]",
+            }
+        )
+    )
+    preempt_ckpt = _find_ckpts(tmp_path)[-1]
+    assert parse_ckpt_name(preempt_ckpt)[0] == 4
+    assert len(_find_pointers(tmp_path)) == 1
+
+    chaos.reset()
+    run(dv3_args(**{"checkpoint.resume_from": "auto:logs/runs"}))
+    resumed = _find_ckpts(tmp_path)[-1]
+    assert parse_ckpt_name(resumed)[0] == 8
+
+
+def test_crash_inside_commit_leaves_previous_snapshot_valid(tmp_path):
+    # Arm a fail point that detonates inside save_checkpoint's commit window
+    # at policy step 16; the periodic save at step 8 has already landed.
+    with pytest.raises(ChaosFault):
+        run(
+            sac_args(
+                **{
+                    "checkpoint.every": 8,
+                    "resilience.chaos.enabled": True,
+                    "resilience.chaos.injectors": (
+                        "[{kind: fail_point, name: checkpoint.before_commit, at_step: 16}]"
+                    ),
+                }
+            )
+        )
+    ckpts = _find_ckpts(tmp_path)
+    assert ckpts and parse_ckpt_name(ckpts[-1])[0] == 8
+    ckpt_dir = os.path.dirname(ckpts[-1])
+    # The torn save left no trace: no staging dirs, and the resume path
+    # lands on the previous valid snapshot.
+    assert not [n for n in os.listdir(ckpt_dir) if n.startswith(".tmp-")]
+    assert find_latest_valid_checkpoint(ckpt_dir) == ckpts[-1]

@@ -1,0 +1,115 @@
+"""EnvSupervisor: restart-with-backoff on worker death, deterministic
+reseeding, and the max-restarts circuit breaker (dead-slice masking)."""
+
+import gymnasium as gym
+import numpy as np
+import pytest
+
+from sheeprl_tpu.core.resilience import EnvSupervisor
+
+OBS = gym.spaces.Box(-1.0, 1.0, (3,), np.float32)
+ACT = gym.spaces.Box(-1.0, 1.0, (2,), np.float32)
+
+
+class FakeVec:
+    """Minimal vector-env surface EnvSliceGroup relies on."""
+
+    def __init__(self, n=2, fail_at=None):
+        self.num_envs = n
+        self.single_observation_space = OBS
+        self.single_action_space = ACT
+        self.metadata = {}
+        self._fail_at = fail_at
+        self._steps = 0
+        self.reset_seed = None
+        self.closed = False
+
+    def reset(self, *, seed=None, options=None):
+        self.reset_seed = seed
+        return np.zeros((self.num_envs, 3), np.float32), {}
+
+    def step(self, actions):
+        self._steps += 1
+        if self._fail_at is not None and self._steps >= self._fail_at:
+            raise RuntimeError("simulated worker death")
+        n = self.num_envs
+        obs = np.full((n, 3), float(self._steps), np.float32)
+        return obs, np.ones(n), np.zeros(n, bool), np.zeros(n, bool), {}
+
+    def close(self, **kwargs):
+        self.closed = True
+
+
+def _broken_factory():
+    raise RuntimeError("rebuild keeps failing")
+
+
+def _supervisor(envs, factories, **kw):
+    kw.setdefault("seed", 7)
+    kw.setdefault("backoff_base_s", 1e-4)
+    kw.setdefault("backoff_max_s", 1e-3)
+    return EnvSupervisor(envs, factories, **kw)
+
+
+def test_restart_reports_truncated_episode_boundary():
+    crashy = FakeVec(fail_at=2)
+    sup = _supervisor([FakeVec(), crashy], [FakeVec, FakeVec])
+    out = sup.step_slice(1, None)  # step 1: healthy
+    assert not out[3].any()
+    with pytest.warns(UserWarning, match="restart 1/"):
+        obs, rew, term, trunc, info = sup.step_slice(1, None)  # step 2: dies
+    assert crashy.closed
+    assert sup.restart_counts == [0, 1]
+    # The crash surfaces as an episode boundary: zero reward, truncated=True,
+    # never terminated — sequence samplers must not stitch across it.
+    assert trunc.all() and not term.any()
+    assert (rew == 0).all()
+    assert info["env_restarted"].all() and info["_env_restarted"].all()
+    # The replacement slice is live again.
+    out = sup.step_slice(1, None)
+    assert not out[3].any()
+
+
+def test_restart_reseed_is_deterministic():
+    crashy = FakeVec(fail_at=1)
+    sup = _supervisor([crashy], [FakeVec])
+    with pytest.warns(UserWarning):
+        sup.step_slice(0, None)
+    assert sup.envs[0].reset_seed == sup.restart_seed(0, 1)
+    # Same run seed -> same restart seed stream; different seed -> different.
+    twin = _supervisor([FakeVec()], [FakeVec])
+    assert twin.restart_seed(0, 1) == sup.restart_seed(0, 1)
+    other = _supervisor([FakeVec()], [FakeVec], seed=8)
+    assert other.restart_seed(0, 1) != sup.restart_seed(0, 1)
+
+
+def test_circuit_breaker_masks_dead_slice():
+    sup = _supervisor(
+        [FakeVec(), FakeVec(fail_at=1)], [FakeVec, _broken_factory], max_restarts=2
+    )
+    with pytest.warns(UserWarning, match="masking it out"):
+        obs, rew, term, trunc, info = sup.step_slice(1, None)
+    assert sup.dead_slices == [1]
+    assert sup.restart_counts[1] == 2
+    assert (obs == 0).all() and trunc.all() and (rew == 0).all()
+    assert info["env_masked"].all()
+    # Dead slices stay masked without new warnings; healthy ones keep going.
+    out = sup.step_slice(1, None)
+    assert out[3].all() and (out[0] == 0).all()
+    healthy = sup.step_slice(0, None)
+    assert not healthy[3].any()
+
+
+def test_single_slice_exhaustion_raises():
+    sup = _supervisor([FakeVec(fail_at=1)], [_broken_factory], max_restarts=1)
+    with pytest.warns(UserWarning):
+        with pytest.raises(RuntimeError, match="only slice"):
+            sup.step_slice(0, None)
+
+
+def test_reset_concatenates_slices_and_offsets_seeds():
+    sup = _supervisor([FakeVec(), FakeVec()], [FakeVec, FakeVec])
+    obs, _ = sup.reset(seed=3)
+    assert obs.shape == (4, 3)
+    assert sup.envs[0].reset_seed == 3
+    assert sup.envs[1].reset_seed == 5

@@ -1,0 +1,49 @@
+"""DispatchWatchdog: trips once per armed window on a hung dispatch, stays
+silent on the fast path, and validates its trip-policy knob."""
+
+import time
+from contextlib import nullcontext
+
+import pytest
+
+from sheeprl_tpu.core.resilience import DispatchWatchdog, watch
+
+
+def test_trips_once_on_hang(capsys):
+    dog = DispatchWatchdog(timeout_s=0.05, on_trip="warn")
+    try:
+        with dog.guard("train_dispatch"):
+            time.sleep(0.4)
+    finally:
+        dog.close()
+    # One trip per armed window, however long the hang outlives the deadline.
+    assert dog.trips == 1
+    err = capsys.readouterr().err
+    assert "train_dispatch" in err and "exceeded" in err
+
+
+def test_fast_path_never_trips():
+    dog = DispatchWatchdog(timeout_s=5.0)
+    try:
+        for _ in range(3):
+            with dog.guard("quick"):
+                pass
+        time.sleep(0.05)
+    finally:
+        dog.close()
+    assert dog.trips == 0
+
+
+def test_disabled_watchdog_is_inert():
+    assert isinstance(watch(None, "x"), type(nullcontext()))
+    dog = DispatchWatchdog(timeout_s=0.0)
+    with dog.guard("never-armed"):
+        pass
+    assert dog._thread is None  # monitor thread never started
+    dog.close()
+    assert dog.trips == 0
+
+
+def test_invalid_on_trip_rejected():
+    with pytest.raises(ValueError, match="warn|preempt|abort"):
+        DispatchWatchdog(on_trip="explode")
