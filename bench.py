@@ -30,12 +30,15 @@ world-model/actor/critic training step and the per-step policy latency.
 Workloads:
 `python bench.py [dreamer_v3|dreamer_v3_devbuf|dreamer_v3_pipe|dreamer_v3_S|
 dreamer_v3_S_b32|dreamer_v3_S_b64|dreamer_v2|dreamer_v1|ppo|a2c|sac|
-sac_devbuf|sac_pipe|sac_resilience]`. The `*_pipe` legs are the
+sac_devbuf|sac_pipe|sac_resilience|serve_sac]`. The `*_pipe` legs are the
 pipelined-interaction A/B (fabric.async_fetch, env.pipeline_slices —
 core/interact.py); every result embeds the interaction time split and
 overlap fraction from the long run. `sac_resilience` is the fault-tolerance
 A/B (resilience=on vs the plain `sac` row, <2% target) and also reports the
-atomic checkpoint save cost directly.
+atomic checkpoint save cost directly. `serve_sac` is the serving stack's
+closed-loop load test (sheeprl_tpu/serve): concurrent clients against the
+dynamic micro-batching engine, vs_baseline = batching speedup over one
+client.
 Reference baselines from BASELINE.md (README.md:83-180); `dreamer_v3_S` is
 the north-star-scale workload (S model at the Atari-100K recipe shape) vs
 the RTX 3080's ~1.98 env-steps/s.
@@ -328,6 +331,124 @@ def bench_sac_resilience():
     return result
 
 
+def bench_serve_sac():
+    """Closed-loop load test of the serving stack (sheeprl_tpu/serve): train
+    a tiny SAC policy, export it to an artifact, host it in an
+    InferenceEngine, then sweep concurrent in-process clients 1..max_batch.
+    Each client loops synchronous act() calls (closed loop: a client's next
+    request waits for its previous answer), so throughput scaling beyond 1x
+    comes entirely from dynamic micro-batching — the engine riding N
+    requests on one padded jitted apply. The headline value is peak
+    requests/s across the sweep; vs_baseline is peak over the single-client
+    rate (the batching speedup itself). Each sweep row embeds p50/p99
+    latency, per-bucket mean occupancy, and shed counts from the engine's
+    own histogram/telemetry."""
+    import glob
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from sheeprl_tpu.cli import check_configs
+    from sheeprl_tpu.config.loader import compose
+    from sheeprl_tpu.serve.artifact import export_artifact
+    from sheeprl_tpu.serve.engine import InferenceEngine
+
+    tmp = tempfile.mkdtemp(prefix="bench_serve_")
+    overrides = [
+        "exp=sac",
+        "env=dummy",
+        "env.id=continuous_dummy",
+        "env.wrapper.id=continuous_dummy",
+        "metric.log_level=0",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "algo.per_rank_batch_size=32",
+        "algo.learning_starts=64",
+        "algo.run_test=False",
+        "algo.total_steps=256",
+        "buffer.memmap=False",
+        "buffer.checkpoint=False",
+        "checkpoint.every=0",
+        "checkpoint.save_last=True",
+        "fabric.accelerator=cpu",
+        f"root_dir={tmp}",
+        "run_name=bench_serve",
+    ]
+    cfg = compose("config", overrides)
+    check_configs(cfg)
+    _run_silent(cfg)
+    ckpt = sorted(glob.glob(os.path.join(tmp, "**", "ckpt_*"), recursive=True))[-1]
+    artifact_path = export_artifact(ckpt)
+
+    max_batch = 8
+    engine = InferenceEngine(max_batch=max_batch, queue_capacity=512, batch_window_s=0.002)
+    card = engine.load("sac", artifact_path)
+
+    rng = np.random.default_rng(0)
+    client_obs = [
+        {k: rng.standard_normal(shape).astype(np.float32) for k, shape in card["obs_keys"].items()}
+        for _ in range(max_batch)
+    ]
+
+    # Prime the dispatch path + service-time EWMA past the first-call jitter.
+    for i in range(16):
+        engine.act("sac", client_obs[i % max_batch], mode="sample", seed=i)
+
+    window_s = float(os.environ.get("SHEEPRL_SERVE_BENCH_WINDOW_S", "4"))
+    sweep = []
+    for n_clients in [n for n in (1, 2, 4, 8, 16) if n <= max_batch]:
+        engine.reset_stats()
+        counts = [0] * n_clients
+        stop_t = time.perf_counter() + window_s
+
+        def client(i):
+            obs = client_obs[i % max_batch]
+            while time.perf_counter() < stop_t:
+                engine.act("sac", obs, mode="sample", seed=i, timeout=60)
+                counts[i] += 1
+
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(n_clients)]
+        start = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - start
+        stats = engine.stats()
+        lat = stats["latency"]
+        sweep.append(
+            {
+                "clients": n_clients,
+                "requests_per_sec": round(sum(counts) / elapsed, 2),
+                "p50_latency_s": round(lat["p50"], 5),
+                "p99_latency_s": round(lat["p99"], 5),
+                "mean_occupancy_per_bucket": {
+                    b: round(row["mean_occupancy"], 2) for b, row in stats["occupancy"].items()
+                },
+                "sheds": stats["counters"]["sheds"],
+                "timeouts": stats["counters"]["timeouts"],
+            }
+        )
+    engine.close()
+
+    single = sweep[0]["requests_per_sec"]
+    peak = max(row["requests_per_sec"] for row in sweep)
+    return {
+        "metric": "serve_sac_peak_requests_per_sec",
+        "value": peak,
+        "unit": "requests/sec",
+        # The batching speedup: peak closed-loop throughput over the
+        # single-client rate. > len(sweep[0]) clients' linear share means
+        # superlinear scaling from batch amortization.
+        "vs_baseline": round(peak / max(single, 1e-9), 3),
+        "max_batch": max_batch,
+        "window_s": window_s,
+        "sweep": sweep,
+    }
+
+
 def _accel_precision() -> str:
     """bf16-mixed on an accelerator (the TPU recipe default, PROFILE.md A/B);
     32-true on a CPU fallback — XLA:CPU bf16 is emulation, and the reference
@@ -435,7 +556,7 @@ def main() -> None:
     # outright so the accelerator plugin is never initialized for them.
     # Accelerator workloads probe the device first and fall back to CPU
     # (recorded in the output) rather than hang on a wedged plugin.
-    if which in ("ppo", "a2c", "sac"):
+    if which in ("ppo", "a2c", "sac", "serve_sac"):
         platform = "cpu"
     elif os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
         platform = "cpu"  # already pinned: nothing to probe
@@ -470,6 +591,7 @@ def main() -> None:
         "sac_devbuf": lambda: bench_sac(device_buffer=True),
         "sac_pipe": lambda: bench_sac(pipelined=True),
         "sac_resilience": bench_sac_resilience,
+        "serve_sac": bench_serve_sac,
     }[which]()
     result["backend"] = jax.default_backend()
     print(json.dumps(result))

@@ -37,6 +37,12 @@ python -m sheeprl_tpu.analysis --no-baseline sheeprl_tpu/data/ || rc=1
 echo "== graftlint (interact, no baseline) =="
 python -m sheeprl_tpu.analysis --no-baseline sheeprl_tpu/core/interact.py || rc=1
 
+# The serving subsystem is new code with no legacy to grandfather: zero
+# findings, no baseline, every rule applies (GL007 covers the artifact
+# writer; GL002 keeps the dispatcher's host syncs coalesced).
+echo "== graftlint (serve, no baseline) =="
+python -m sheeprl_tpu.analysis --no-baseline sheeprl_tpu/serve/ || rc=1
+
 # The fault-tolerance surface must itself be fault-tolerant: the atomic
 # checkpoint writer and the resilience/chaos modules hold zero findings
 # (GL007 non-atomic persistence included), no baseline, forever.

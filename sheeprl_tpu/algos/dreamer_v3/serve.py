@@ -1,0 +1,101 @@
+"""DreamerV3 policy adapter: the recurrent / world-model serving case.
+
+The artifact carries the world model + actor params (critics are training
+state) and the adapter carries the *latent-state protocol*: each serving
+session owns ``{player: {recurrent_state, stochastic_state, actions}, key}``,
+initialized exactly like the evaluate path (`dreamer_v3/utils.py test()`) —
+``init_player_state(wm, 1)`` plus a per-session PRNG key — and advanced one
+``player_step`` per request with the same ``key, sub = split(key)``
+discipline. Sessions batch by stacking their state rows on a new leading
+axis and vmapping the single-row step; the B == 1 graph skips the vmap so a
+lone session reproduces the evaluate computation exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
+from sheeprl_tpu.algos.dreamer_v3.utils import normalize_player_obs
+from sheeprl_tpu.algos.ppo.agent import actions_metadata
+from sheeprl_tpu.serve.adapter import PolicyAdapterBase, extract_policy_config, inference_runtime
+from sheeprl_tpu.serve.registry import register_policy
+
+
+@register_policy("dreamer_v3")
+class DreamerV3Policy(PolicyAdapterBase):
+    stateful = True
+
+    @classmethod
+    def export(cls, state: Dict[str, Any], cfg) -> Tuple[Any, Dict[str, Any]]:
+        return (
+            {"world_model": state["world_model"], "actor": state["actor"]},
+            extract_policy_config(cfg),
+        )
+
+    def __init__(self, spec: Dict[str, Any], params: Any) -> None:
+        from sheeprl_tpu.core.precision import resolve_precision
+
+        super().__init__(spec, params)
+        actions_dim, is_continuous = actions_metadata(self.action_space)
+        runtime = inference_runtime(resolve_precision(str(self.cfg.get("precision", "32-true"))))
+        agent, state = build_agent(
+            runtime,
+            actions_dim,
+            is_continuous,
+            self.cfg,
+            self.obs_space,
+            world_model_state=self.params["world_model"],
+            actor_state=self.params["actor"],
+        )
+        self.agent = agent
+        self.params = {"world_model": state["world_model"], "actor": state["actor"]}
+        self._init_player = None
+
+    # -------------------------------------------------------------- sessions
+    def new_session(self, seed: int) -> Any:
+        import jax
+
+        if self._init_player is None:
+            self._init_player = jax.jit(self.agent.init_player_state, static_argnums=(1,))
+        return {
+            "player": self._init_player(self.params["world_model"], 1),
+            "key": jax.random.PRNGKey(int(seed)),
+        }
+
+    # ----------------------------------------------------------------- apply
+    def make_apply(self, greedy: bool):
+        import jax
+
+        agent = self.agent
+        cnn_keys = self.cnn_keys
+
+        def row_step(params, state_row, obs_row):
+            obs1 = jax.tree_util.tree_map(lambda x: x[None], obs_row)
+            obs1 = normalize_player_obs(obs1, cnn_keys)
+            key_next, sub = jax.random.split(state_row["key"])
+            _, real_actions, new_player = agent.player_step(
+                params["world_model"],
+                params["actor"],
+                state_row["player"],
+                obs1,
+                sub,
+                greedy=greedy,
+            )
+            return real_actions[0], {"player": new_player, "key": key_next}
+
+        def apply(params, obs, seeds, state):
+            batch = jax.tree_util.tree_leaves(obs)[0].shape[0]
+            if batch == 1:
+                # Single session: identical graph to the evaluate path (no
+                # vmap wrapping), which keeps a lone episode's actions and
+                # latents on the exact evaluate trajectory.
+                action, new_state = row_step(
+                    params,
+                    jax.tree_util.tree_map(lambda x: x[0], state),
+                    jax.tree_util.tree_map(lambda x: x[0], obs),
+                )
+                return action[None], jax.tree_util.tree_map(lambda x: x[None], new_state)
+            return jax.vmap(lambda s, o: row_step(params, s, o))(state, obs)
+
+        return apply

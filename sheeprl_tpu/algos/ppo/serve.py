@@ -1,0 +1,63 @@
+"""PPO policy adapter for the serving subsystem.
+
+The whole agent params tree is exported: ``PPOAgentModule`` computes actor
+heads and value in one apply, so the critic sub-tree is structurally part of
+the inference graph (its value output is simply discarded). The greedy apply
+is the evaluate path (`ppo/utils.py test()`) — dict obs with uint8 pixels
+normalized in-graph — so single-request greedy batches are bit-identical to
+``evaluate_ppo``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+from sheeprl_tpu.algos.ppo.agent import actions_metadata, build_agent
+from sheeprl_tpu.serve.adapter import (
+    PolicyAdapterBase,
+    extract_policy_config,
+    inference_runtime,
+    seeds_to_keys,
+)
+from sheeprl_tpu.serve.registry import register_policy
+
+
+@register_policy(["ppo", "ppo_decoupled"])
+class PPOPolicy(PolicyAdapterBase):
+    stateful = False
+
+    @classmethod
+    def export(cls, state: Dict[str, Any], cfg) -> Tuple[Any, Dict[str, Any]]:
+        return state["agent"], extract_policy_config(cfg)
+
+    def __init__(self, spec: Dict[str, Any], params: Any) -> None:
+        from sheeprl_tpu.core.precision import resolve_precision
+
+        super().__init__(spec, params)
+        actions_dim, is_continuous = actions_metadata(self.action_space)
+        runtime = inference_runtime(resolve_precision(str(self.cfg.get("precision", "32-true"))))
+        self.agent, self.params = build_agent(
+            runtime, actions_dim, is_continuous, self.cfg, self.obs_space, agent_state=self.params
+        )
+
+    def make_apply(self, greedy: bool):
+        import jax
+
+        agent = self.agent
+        if greedy:
+
+            def apply(params, obs, seeds, state):
+                return agent.get_actions(params, obs, greedy=True), state
+
+            return apply
+
+        def apply(params, obs, seeds, state):
+            keys = seeds_to_keys(seeds)
+
+            def row(o, k):
+                o1 = jax.tree_util.tree_map(lambda x: x[None], o)
+                return agent.get_actions(params, o1, key=k)[0]
+
+            return jax.vmap(row)(obs, keys), state
+
+        return apply

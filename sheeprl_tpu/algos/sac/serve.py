@@ -1,0 +1,80 @@
+"""SAC policy adapter for the serving subsystem.
+
+Export keeps the actor params only — critics, targets, and the temperature
+are training-time state. The apply path is exactly the evaluate path
+(`sac/utils.py test()`): concatenated mlp-key vector obs through
+``SACAgent.get_actions``, so a single-request greedy batch is bit-identical
+to ``evaluate_sac``.
+"""
+
+from __future__ import annotations
+
+from math import prod
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from sheeprl_tpu.algos.sac.agent import SACActorModule, SACAgent
+from sheeprl_tpu.serve.adapter import PolicyAdapterBase, extract_policy_config, seeds_to_keys
+from sheeprl_tpu.serve.registry import register_policy
+
+
+@register_policy(["sac", "sac_decoupled"])
+class SACPolicy(PolicyAdapterBase):
+    stateful = False
+
+    # ------------------------------------------------------------ export side
+    @classmethod
+    def export(cls, state: Dict[str, Any], cfg) -> Tuple[Any, Dict[str, Any]]:
+        return {"actor": state["agent"]["actor"]}, extract_policy_config(cfg)
+
+    # -------------------------------------------------------------- load side
+    def __init__(self, spec: Dict[str, Any], params: Any) -> None:
+        super().__init__(spec, params)
+        act_dim = int(prod(self.action_space.shape))
+        actor = SACActorModule(
+            action_dim=act_dim,
+            hidden_size=self.cfg.algo.actor.hidden_size,
+            dtype=self.compute_dtype,
+        )
+        # Only the actor half of the agent exists at inference: critics and
+        # temperature are deliberately absent from the artifact.
+        self.agent = SACAgent(
+            actor=actor,
+            critics=None,
+            action_scale=np.asarray((self.action_space.high - self.action_space.low) / 2.0, np.float32),
+            action_bias=np.asarray((self.action_space.high + self.action_space.low) / 2.0, np.float32),
+            target_entropy=float(-act_dim),
+            tau=0.0,
+            num_critics=0,
+        )
+
+    def pack_rows(self, rows: List[Dict[str, np.ndarray]], batch: int) -> np.ndarray:
+        # prepare_obs parity: mlp keys concatenated into one float32 [B, D].
+        layout = self.row_spec()
+        width = sum(int(prod(shape)) for shape, _ in layout.values())
+        out = np.zeros((batch, width), np.float32)
+        for i, row in enumerate(rows):
+            out[i] = np.concatenate([row[k].ravel() for k in self.mlp_keys])
+        return out
+
+    def make_apply(self, greedy: bool):
+        import jax
+
+        agent = self.agent
+        if greedy:
+
+            def apply(params, obs, seeds, state):
+                return agent.get_actions(params["actor"], obs, greedy=True), state
+
+            return apply
+
+        def apply(params, obs, seeds, state):
+            keys = seeds_to_keys(seeds)
+
+            def row(o, k):
+                return agent.get_actions(params["actor"], o[None], key=k)[0]
+
+            return jax.vmap(row)(obs, keys), state
+
+        return apply

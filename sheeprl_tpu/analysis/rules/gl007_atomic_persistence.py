@@ -35,7 +35,7 @@ from typing import Iterator, List, Optional, Tuple
 from sheeprl_tpu.analysis.context import LintContext
 from sheeprl_tpu.analysis.registry import Rule, register_rule
 
-_PATH_SCOPE_RE = re.compile(r"(checkpoint|resilien|gl007)", re.IGNORECASE)
+_PATH_SCOPE_RE = re.compile(r"(checkpoint|resilien|artifact|gl007)", re.IGNORECASE)
 _TMPISH_RE = re.compile(r"(tmp|temp|trash|staging|scratch)", re.IGNORECASE)
 _RENAME_CALLS = {"os.rename", "os.replace", "os.renames"}
 _DUMP_CALLS = {

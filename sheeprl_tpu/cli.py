@@ -380,13 +380,16 @@ def evaluation(args: Optional[Sequence[str]] = None) -> None:
         ckpt_cfg = dotdict(yaml.safe_load(fp))
 
     # Start from the run's config, let CLI overrides win, force eval-time keys.
-    cfg = ckpt_cfg
-    for ov in rest:
-        from sheeprl_tpu.utils.utils import set_by_path
-        from sheeprl_tpu.config.loader import _parse_value
+    from sheeprl_tpu.config.loader import _parse_value
+    from sheeprl_tpu.utils.utils import set_by_path
 
+    cfg = ckpt_cfg
+    user_keys = set()
+    for ov in rest:
         k, v = ov.split("=", 1)
-        set_by_path(cfg, k.lstrip("+"), _parse_value(v))
+        k = k.lstrip("+")
+        user_keys.add(k)
+        set_by_path(cfg, k, _parse_value(v))
     # <run_name>/<version_N>/evaluation next to the original run
     # (reference: cli.py:393-401 — root_dir becomes the absolute run root).
     cfg.root_dir = str(checkpoint_path.parent.parent.parent.parent)
@@ -398,8 +401,13 @@ def evaluation(args: Optional[Sequence[str]] = None) -> None:
         )
     )
     cfg.checkpoint.resume_from = str(checkpoint_path)
-    cfg.env.num_envs = 1
-    cfg.fabric = dotdict(
+    # Eval-time defaults (single env, single local device) apply only where
+    # the user did not explicitly override: `env.num_envs=4` or `fabric.*`
+    # on the command line must survive this block, not be clobbered by it.
+    if "env.num_envs" not in user_keys:
+        cfg.env.num_envs = 1
+    user_fabric_keys = {k.split(".", 1)[1] for k in user_keys if k.startswith("fabric.")}
+    eval_fabric = dotdict(
         {
             "_target_": cfg.fabric.get("_target_", "sheeprl_tpu.core.runtime.Runtime"),
             "devices": 1,
@@ -410,6 +418,18 @@ def evaluation(args: Optional[Sequence[str]] = None) -> None:
             "model_axis": 1,
         }
     )
+    dropped = []
+    for key in sorted(user_fabric_keys):
+        if key in cfg.fabric:
+            eval_fabric[key] = cfg.fabric[key]
+        else:
+            dropped.append(f"fabric.{key}")
+    if dropped:
+        warnings.warn(
+            f"Evaluation ignores unknown fabric overrides: {', '.join(dropped)}",
+            stacklevel=2,
+        )
+    cfg.fabric = eval_fabric
 
     if cfg.algo.name not in evaluation_registry:
         raise RuntimeError(

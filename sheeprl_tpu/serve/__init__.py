@@ -1,0 +1,53 @@
+"""sheeprl_tpu.serve: policy artifact export + dynamic-batching inference.
+
+Layers (each usable on its own):
+
+- :mod:`~sheeprl_tpu.serve.artifact` — export/load self-contained, versioned
+  policy artifacts (params + apply spec + space/preprocessing metadata,
+  digest-verified, written atomically);
+- :mod:`~sheeprl_tpu.serve.engine` — the dynamic micro-batching
+  :class:`InferenceEngine` (bounded queue, power-of-two buckets, one donated
+  jitted apply per batch, warm-up at load, LRU multi-model hosting);
+- :mod:`~sheeprl_tpu.serve.server` — stdlib HTTP front end (``/v1/act``,
+  ``/v1/models``, ``/healthz``) with deadline-based shedding and graceful
+  SIGTERM drain, plus the in-process :class:`ServeClient`;
+- :mod:`~sheeprl_tpu.serve.adapter` / per-algorithm
+  ``sheeprl_tpu/algos/<algo>/serve.py`` — the policy adapters.
+"""
+
+from sheeprl_tpu.serve.artifact import (
+    PolicyArtifact,
+    export_artifact,
+    load_artifact,
+    make_policy,
+    read_artifact_manifest,
+    validate_artifact,
+)
+from sheeprl_tpu.serve.engine import (
+    EngineClosed,
+    EngineOverloaded,
+    InferenceEngine,
+    RequestExpired,
+    next_pow2,
+)
+from sheeprl_tpu.serve.registry import get_policy_cls, register_all_policies, register_policy
+from sheeprl_tpu.serve.server import PolicyServer, ServeClient
+
+__all__ = [
+    "EngineClosed",
+    "EngineOverloaded",
+    "InferenceEngine",
+    "PolicyArtifact",
+    "PolicyServer",
+    "RequestExpired",
+    "ServeClient",
+    "export_artifact",
+    "get_policy_cls",
+    "load_artifact",
+    "make_policy",
+    "next_pow2",
+    "read_artifact_manifest",
+    "register_all_policies",
+    "register_policy",
+    "validate_artifact",
+]

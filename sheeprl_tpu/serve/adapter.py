@@ -1,0 +1,161 @@
+"""Shared machinery for per-algorithm policy adapters.
+
+An adapter is the algorithm-specific half of the serving stack: it knows how
+to distill a training checkpoint into inference params (class method
+``export``), how to rebuild the apply path from an artifact spec (the
+constructor), and how to turn client observation rows into the batched,
+padded arrays the engine's jitted apply consumes. The engine sees only this
+interface — request queueing, bucketing, jit/donation, and telemetry live
+there; everything that mentions an agent class lives in
+``sheeprl_tpu/algos/<algo>/serve.py``.
+
+Apply contract (what ``make_apply(greedy)`` must return)::
+
+    apply(params, obs, seeds, state) -> (actions, new_state)
+
+- ``obs``: the pytree ``pack_rows`` produced, leading dim = bucket size B;
+- ``seeds``: uint32 [B] — per-row PRNG seeds for keyed-stochastic modes
+  (ignored by purely-greedy stateless paths);
+- ``state``: None for stateless policies; for stateful ones the per-session
+  state rows stacked on a new leading axis [B, ...] (``new_session`` creates
+  one row). The engine donates ``state`` (or ``obs`` when stateless) to the
+  jit, so apply must not alias its input buffers into the output.
+
+The leading dim is static at trace time (the engine compiles one graph per
+power-of-two bucket), so adapters may branch on ``B == 1`` in python to keep
+the single-request graph identical to the algorithm's ``evaluate`` path —
+that is what makes the round-trip bit-identity tests possible.
+"""
+
+from __future__ import annotations
+
+from math import prod
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from sheeprl_tpu.serve.artifact import spec_to_space
+from sheeprl_tpu.utils.utils import dotdict
+
+
+class PolicyAdapterBase:
+    stateful = False
+
+    def __init__(self, spec: Dict[str, Any], params: Any) -> None:
+        import jax
+
+        from sheeprl_tpu.core.precision import resolve_precision
+
+        self.spec = spec
+        self.cfg = dotdict(spec["config"])
+        self.obs_space = spec_to_space(spec["observation_space"])
+        self.action_space = spec_to_space(spec["action_space"])
+        self.compute_dtype = resolve_precision(
+            str(self.cfg.get("precision", "32-true"))
+        ).compute_dtype
+        # One H2D transfer at load; every batch reuses the device copy.
+        self.params = jax.device_put(params)
+
+    # ------------------------------------------------------------ row layout
+    @property
+    def mlp_keys(self) -> Tuple[str, ...]:
+        return tuple(self.cfg.algo.mlp_keys.encoder)
+
+    @property
+    def cnn_keys(self) -> Tuple[str, ...]:
+        cnn = self.cfg.algo.get("cnn_keys")
+        return tuple(cnn.encoder) if cnn else ()
+
+    def row_spec(self) -> Dict[str, Tuple[Tuple[int, ...], str]]:
+        """Per-request observation layout: key -> (shape, dtype). Vector keys
+        are flattened (prepare_obs parity); pixel keys keep HWC layout and
+        their space dtype (normalization happens in-graph)."""
+        layout: Dict[str, Tuple[Tuple[int, ...], str]] = {}
+        for k in self.cnn_keys:
+            sp = self.obs_space[k]
+            layout[k] = (tuple(sp.shape), np.dtype(sp.dtype).name)
+        for k in self.mlp_keys:
+            sp = self.obs_space[k]
+            layout[k] = ((int(prod(sp.shape)),), "float32")
+        return layout
+
+    def normalize_row(self, obs: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        """Validate/coerce one client obs row against the artifact's spec.
+        Raises ValueError (the server's 400) on missing keys or wrong sizes."""
+        if not isinstance(obs, dict):
+            raise ValueError(f"obs must be a dict of observation keys, got {type(obs).__name__}")
+        row: Dict[str, np.ndarray] = {}
+        for k, (shape, dtype) in self.row_spec().items():
+            if k not in obs:
+                raise ValueError(f"obs is missing key {k!r} (expected keys: {sorted(self.row_spec())})")
+            arr = np.asarray(obs[k])
+            if int(arr.size) != int(prod(shape)):
+                raise ValueError(
+                    f"obs[{k!r}] has {arr.size} elements, expected {int(prod(shape))} (shape {shape})"
+                )
+            row[k] = np.ascontiguousarray(arr.reshape(shape).astype(dtype, copy=False))
+        return row
+
+    def pack_rows(self, rows: List[Dict[str, np.ndarray]], batch: int) -> Any:
+        """Stack ``rows`` (already normalized) into [batch, ...] arrays,
+        zero-padding past ``len(rows)``. Default: dict-obs layout."""
+        packed: Dict[str, np.ndarray] = {}
+        for k, (shape, dtype) in self.row_spec().items():
+            out = np.zeros((batch, *shape), dtype)
+            for i, row in enumerate(rows):
+                out[i] = row[k]
+            packed[k] = out
+        return packed
+
+    # -------------------------------------------------------------- sessions
+    def new_session(self, seed: int) -> Any:  # pragma: no cover - stateless default
+        raise TypeError(f"{type(self).__name__} is stateless and has no sessions")
+
+    # ----------------------------------------------------------------- apply
+    def make_apply(self, greedy: bool):
+        raise NotImplementedError
+
+    def describe(self) -> Dict[str, Any]:
+        """Model card for /v1/models."""
+        return {
+            "algo": self.spec["algo"],
+            "stateful": self.stateful,
+            "policy_step": self.spec.get("policy_step"),
+            "env_id": self.spec.get("env_id"),
+            "obs_keys": {k: list(v[0]) for k, v in self.row_spec().items()},
+            "action_space": self.spec["action_space"],
+        }
+
+
+def extract_policy_config(cfg) -> Dict[str, Any]:
+    """The config subtree an artifact carries: everything an adapter's module
+    rebuild reads, nothing from the training side (buffers, optimizers,
+    checkpoints). ``algo`` is taken whole — module hyper-parameters live all
+    over that subtree and cherry-picking them per algorithm is how specs rot."""
+    algo = cfg.algo.as_dict() if hasattr(cfg.algo, "as_dict") else dict(cfg.algo)
+    dist = cfg.get("distribution") or {"type": "auto"}
+    return {
+        "algo": algo,
+        "distribution": dist.as_dict() if hasattr(dist, "as_dict") else dict(dist),
+        "env": {"screen_size": cfg.env.get("screen_size", 64)},
+        "precision": str(cfg.fabric.get("precision", "32-true")),
+    }
+
+
+def inference_runtime(precision):
+    """Minimal stand-in for the training Runtime, satisfying what the algo
+    ``build_agent`` factories read (precision policy + an init key — unused
+    when every param tree is restored, but the factories split it anyway)."""
+    import types
+
+    import jax
+
+    return types.SimpleNamespace(precision=precision, root_key=jax.random.PRNGKey(0))
+
+
+def seeds_to_keys(seeds):
+    """uint32 [B] seeds -> stacked PRNG keys [B, 2], in-graph (the same
+    ``jax.random.PRNGKey`` the evaluate paths derive their keys from)."""
+    import jax
+
+    return jax.vmap(jax.random.PRNGKey)(seeds)

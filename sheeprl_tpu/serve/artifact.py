@@ -1,0 +1,281 @@
+"""Policy artifacts: self-contained, versioned inference snapshots.
+
+A training checkpoint is the wrong unit to serve from: it drags optimizer
+states and replay buffers, and rebuilding its apply path needs the full
+training config plus an env to probe spaces from. ``export_artifact``
+distills a checkpoint into a *policy artifact* — the inference params pytree,
+the algorithm's apply-fn spec (the config subtree its modules rebuild from),
+and the serialized obs/action spaces with preprocessing metadata — so the
+serving host needs nothing but this directory and the ``sheeprl_tpu`` wheel.
+
+Layout (committed with the same atomic staging discipline as checkpoints —
+see :func:`sheeprl_tpu.utils.checkpoint.atomic_dir_writer`)::
+
+    <name>.policy/
+        arrays/         # Orbax tree: inference params only
+        spec.json       # schema, algo, spaces, preprocessing, config subtree
+        manifest.json   # digests over arrays + spec; written + fsynced last
+
+``manifest.json`` carries content digests so a torn copy or bit rot is
+detected at load; :func:`validate_artifact` is the serving analogue of
+``validate_checkpoint``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pathlib
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from sheeprl_tpu.utils.checkpoint import (
+    _digest_arrays,
+    atomic_dir_writer,
+    parse_ckpt_name,
+)
+
+ARTIFACT_SUFFIX = ".policy"
+SPEC_NAME = "spec.json"
+MANIFEST_NAME = "manifest.json"
+ARTIFACT_SCHEMA_VERSION = 1
+
+
+# --------------------------------------------------------------- space specs
+def space_to_spec(space) -> Dict[str, Any]:
+    """Serialize a gymnasium space to a JSON-plain dict. Box bounds collapse
+    to scalars when uniform (the common case — pixel 0..255, control ±1) so
+    image specs stay small."""
+    import gymnasium as gym
+
+    if isinstance(space, gym.spaces.Dict):
+        return {"type": "dict", "spaces": {k: space_to_spec(v) for k, v in space.spaces.items()}}
+    if isinstance(space, gym.spaces.Box):
+        low, high = np.asarray(space.low), np.asarray(space.high)
+        return {
+            "type": "box",
+            "shape": list(space.shape),
+            "dtype": np.dtype(space.dtype).name,
+            "low": float(low.flat[0]) if np.all(low == low.flat[0]) else low.tolist(),
+            "high": float(high.flat[0]) if np.all(high == high.flat[0]) else high.tolist(),
+        }
+    if isinstance(space, gym.spaces.Discrete):
+        return {"type": "discrete", "n": int(space.n)}
+    if isinstance(space, gym.spaces.MultiDiscrete):
+        return {"type": "multi_discrete", "nvec": np.asarray(space.nvec).tolist()}
+    raise TypeError(f"Cannot serialize space of type {type(space).__name__} into an artifact spec")
+
+
+def spec_to_space(spec: Dict[str, Any]):
+    import gymnasium as gym
+
+    kind = spec["type"]
+    if kind == "dict":
+        return gym.spaces.Dict({k: spec_to_space(v) for k, v in spec["spaces"].items()})
+    if kind == "box":
+        shape = tuple(spec["shape"])
+        dtype = np.dtype(spec["dtype"])
+        low = np.broadcast_to(np.asarray(spec["low"], dtype), shape)
+        high = np.broadcast_to(np.asarray(spec["high"], dtype), shape)
+        return gym.spaces.Box(low=low, high=high, shape=shape, dtype=dtype)
+    if kind == "discrete":
+        return gym.spaces.Discrete(int(spec["n"]))
+    if kind == "multi_discrete":
+        return gym.spaces.MultiDiscrete(np.asarray(spec["nvec"], np.int64))
+    raise TypeError(f"Unknown space spec type {kind!r}")
+
+
+def _plain(obj: Any) -> Any:
+    """Recursively convert dotdicts / numpy scalars into JSON-plain values."""
+    if isinstance(obj, dict):
+        return {str(k): _plain(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_plain(v) for v in obj]
+    if isinstance(obj, np.generic):
+        return obj.tolist()  # numpy scalar -> python scalar, no .item() (GL002)
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    return obj
+
+
+def _sha256_bytes(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+# ----------------------------------------------------------------- artifacts
+@dataclass(frozen=True)
+class PolicyArtifact:
+    """A loaded artifact: the parsed spec, the params pytree (numpy leaves),
+    and where it came from."""
+
+    path: str
+    spec: Dict[str, Any]
+    manifest: Dict[str, Any]
+    params: Any
+
+    @property
+    def algo(self) -> str:
+        return str(self.spec["algo"])
+
+    @property
+    def name(self) -> str:
+        return str(self.spec.get("name", os.path.basename(self.path)))
+
+
+def export_artifact(
+    checkpoint_path: str,
+    output_path: Optional[str] = None,
+    *,
+    name: Optional[str] = None,
+    cfg: Optional[Any] = None,
+) -> str:
+    """Export ``checkpoint_path`` into a policy artifact directory.
+
+    Runs on the training host: the run's ``config.yaml`` (next to the
+    checkpoint dir) supplies the algorithm identity and the env factory the
+    obs/action spaces are probed from — the produced artifact then needs
+    neither. Returns the committed artifact path.
+    """
+    import yaml
+
+    from sheeprl_tpu.serve.registry import get_policy_cls
+    from sheeprl_tpu.utils.checkpoint import load_checkpoint
+    from sheeprl_tpu.utils.env import make_env
+    from sheeprl_tpu.utils.utils import dotdict
+
+    ckpt = pathlib.Path(checkpoint_path).absolute()
+    if cfg is None:
+        with open(ckpt.parent.parent / "config.yaml") as fp:
+            cfg = dotdict(yaml.safe_load(fp))
+    algo = str(cfg.algo.name)
+    adapter_cls = get_policy_cls(algo)
+
+    # Probe the spaces exactly as training saw them (wrappers applied), then
+    # serialize them so serving never constructs an env.
+    cfg.env.capture_video = False
+    env = make_env(cfg, cfg.seed, 0)()
+    try:
+        obs_space, action_space = env.observation_space, env.action_space
+        state = load_checkpoint(str(ckpt))
+        params, policy_config = adapter_cls.export(state, cfg)
+    finally:
+        env.close()
+
+    parsed = parse_ckpt_name(str(ckpt))
+    step = parsed[0] if parsed else 0
+    if name is None:
+        name = f"{algo}_{cfg.env.id}_{step}"
+    if output_path is None:
+        output_path = str(ckpt.parent.parent / "artifacts" / f"{name}{ARTIFACT_SUFFIX}")
+
+    spec = {
+        "schema_version": ARTIFACT_SCHEMA_VERSION,
+        "name": str(name),
+        "algo": algo,
+        "stateful": bool(getattr(adapter_cls, "stateful", False)),
+        "policy_step": int(step),
+        "source_checkpoint": str(ckpt),
+        "env_id": str(cfg.env.id),
+        "observation_space": space_to_spec(obs_space),
+        "action_space": space_to_spec(action_space),
+        "config": _plain(policy_config),
+    }
+    spec_bytes = json.dumps(spec, indent=2, sort_keys=True, default=str).encode()
+
+    import jax
+    import orbax.checkpoint as ocp
+
+    np_params = jax.tree_util.tree_map(np.asarray, params)
+    digest, leaf_count = _digest_arrays(np_params)
+    manifest = {
+        "schema_version": ARTIFACT_SCHEMA_VERSION,
+        "kind": "policy_artifact",
+        "algo": algo,
+        "leaf_count": leaf_count,
+        "digest": digest,
+        "spec_sha256": _sha256_bytes(spec_bytes),
+        "created_unix": time.time(),
+    }
+
+    with atomic_dir_writer(output_path, fail_point="artifact.before_commit") as staging:
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(os.path.join(staging, "arrays"), np_params)
+        with open(os.path.join(staging, SPEC_NAME), "wb") as fp:
+            fp.write(spec_bytes)
+            fp.flush()
+            os.fsync(fp.fileno())
+        with open(os.path.join(staging, MANIFEST_NAME), "w") as fp:
+            json.dump(manifest, fp, indent=2)
+            fp.flush()
+            os.fsync(fp.fileno())
+    return os.path.abspath(output_path)
+
+
+def read_artifact_manifest(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(os.path.join(path, MANIFEST_NAME), "rb") as fp:
+            manifest = json.load(fp)
+    except (OSError, ValueError):
+        return None
+    return manifest if isinstance(manifest, dict) else None
+
+
+def validate_artifact(path: str, verify_digest: bool = False) -> bool:
+    """True iff ``path`` is a complete, committed policy artifact (structural
+    check; ``verify_digest`` additionally rehashes spec + every array leaf)."""
+    manifest = read_artifact_manifest(path)
+    if manifest is None or manifest.get("kind") != "policy_artifact":
+        return False
+    try:
+        if int(manifest["schema_version"]) > ARTIFACT_SCHEMA_VERSION:
+            return False
+        leaf_count = int(manifest["leaf_count"])
+    except (KeyError, TypeError, ValueError):
+        return False
+    spec_file = os.path.join(path, SPEC_NAME)
+    if not os.path.isdir(os.path.join(path, "arrays")) or not os.path.isfile(spec_file):
+        return False
+    if not verify_digest:
+        return True
+    try:
+        with open(spec_file, "rb") as fp:
+            if _sha256_bytes(fp.read()) != manifest.get("spec_sha256"):
+                return False
+        import orbax.checkpoint as ocp
+
+        with ocp.StandardCheckpointer() as ckptr:
+            arrays = ckptr.restore(os.path.abspath(os.path.join(path, "arrays")))
+        digest, n = _digest_arrays(arrays)
+        return n == leaf_count and digest == manifest.get("digest")
+    except Exception:  # noqa: BLE001 - any unreadable payload means invalid
+        return False
+
+
+def load_artifact(path: str, *, verify_digest: bool = False) -> PolicyArtifact:
+    """Load an artifact directory into spec + numpy params (no training
+    config, no env, no Runtime needed)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    if not validate_artifact(path, verify_digest=verify_digest):
+        raise ValueError(
+            f"{path} is not a valid policy artifact (torn export, wrong schema, or failed "
+            f"digest check) — re-run `python -m sheeprl_tpu.serve export checkpoint_path=...`"
+        )
+    with open(os.path.join(path, SPEC_NAME), "rb") as fp:
+        spec = json.load(fp)
+    manifest = read_artifact_manifest(path) or {}
+    with ocp.StandardCheckpointer() as ckptr:
+        params = ckptr.restore(os.path.join(path, "arrays"))
+    return PolicyArtifact(path=path, spec=spec, manifest=manifest, params=params)
+
+
+def make_policy(artifact: PolicyArtifact):
+    """Instantiate the registered adapter for a loaded artifact."""
+    from sheeprl_tpu.serve.registry import get_policy_cls
+
+    return get_policy_cls(artifact.algo)(artifact.spec, artifact.params)

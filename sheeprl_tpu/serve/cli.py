@@ -1,0 +1,86 @@
+"""Command-line entry points: ``python -m sheeprl_tpu.serve <export|serve>``.
+
+``export`` distills a training checkpoint into a self-contained policy
+artifact (no training config or replay state needed to load it later)::
+
+    python -m sheeprl_tpu.serve export checkpoint_path=logs/.../ckpt_1024 \
+        [output_path=my_policy.policy] [name=my_policy]
+
+``serve`` composes the ``serve_config`` root (the same Hydra-lite machinery
+every other entry point uses), loads the listed artifacts into an engine,
+and runs the HTTP server in the foreground until SIGTERM::
+
+    python -m sheeprl_tpu.serve serve 'artifacts=["my_policy.policy"]' \
+        serve.port=8080 serve.max_batch=8
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+from typing import List, Optional, Sequence
+
+
+def _export(overrides: List[str]) -> None:
+    from sheeprl_tpu.serve.artifact import export_artifact
+
+    kwargs = {}
+    for ov in overrides:
+        if "=" not in ov:
+            raise ValueError(f"export arguments are key=value pairs, got {ov!r}")
+        k, v = ov.split("=", 1)
+        kwargs[k.lstrip("+")] = v
+    checkpoint_path = kwargs.pop("checkpoint_path", None)
+    if checkpoint_path is None:
+        raise ValueError("You must specify checkpoint_path=<path-to-checkpoint>")
+    output_path = kwargs.pop("output_path", None)
+    name = kwargs.pop("name", None)
+    if kwargs:
+        raise ValueError(f"Unknown export arguments: {sorted(kwargs)}")
+    path = export_artifact(checkpoint_path, output_path, name=name)
+    print(f"Exported policy artifact: {path}")
+
+
+def _serve(overrides: List[str]) -> None:
+    import sheeprl_tpu
+    from sheeprl_tpu.config.loader import compose
+    from sheeprl_tpu.serve.engine import InferenceEngine
+    from sheeprl_tpu.serve.server import PolicyServer
+
+    sheeprl_tpu.register_all()
+    cfg = compose("serve_config", overrides)
+    artifacts = cfg.get("artifacts") or []
+    if artifacts == "???" or not isinstance(artifacts, (list, tuple)) or not artifacts:
+        raise ValueError(
+            "You must specify at least one artifact: 'artifacts=[\"path/to/policy.policy\"]'"
+        )
+    serve_cfg = cfg.serve
+    engine = InferenceEngine(
+        max_batch=int(serve_cfg.max_batch),
+        queue_capacity=int(serve_cfg.queue_capacity),
+        batch_window_s=float(serve_cfg.batch_window_ms) / 1000.0,
+        max_models=int(serve_cfg.max_models),
+        max_sessions=int(serve_cfg.max_sessions),
+    )
+    for entry in artifacts:
+        path = pathlib.Path(str(entry))
+        name = path.name[: -len(".policy")] if path.name.endswith(".policy") else path.name
+        card = engine.load(name, str(path))
+        print(f"Loaded model {name!r} ({card['algo']}) from {path}")
+    server = PolicyServer(engine, host=str(serve_cfg.host), port=int(serve_cfg.port))
+    print(f"Serving {sorted(engine.models())} on {server.address} (SIGTERM drains and exits)")
+    server.serve_forever()
+
+
+def main(args: Optional[Sequence[str]] = None) -> None:
+    argv = list(args) if args is not None else sys.argv[1:]
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return
+    command, rest = argv[0], argv[1:]
+    if command == "export":
+        _export(rest)
+    elif command == "serve":
+        _serve(rest)
+    else:
+        raise SystemExit(f"Unknown command {command!r}; expected 'export' or 'serve'.\n{__doc__}")

@@ -1,0 +1,489 @@
+"""Dynamic micro-batching inference engine.
+
+EnvPool's lesson (arXiv:2206.10558) applies on the serving side too: the
+wins come from batching the request-facing half, not faster kernels. The
+engine turns a stream of single-observation requests into batched, compiled
+policy applies:
+
+- requests land in a bounded FIFO; a dispatcher thread drains the head run
+  of same-(model, mode) requests into one batch (at most one request per
+  recurrent session), optionally lingering ``batch_window_s`` to fill it;
+- batches are padded to power-of-two buckets, exactly the trick
+  ``algo.fused_train_steps`` uses — the compiled-graph population is bounded
+  at log2(max_batch)+1 variants per (model, mode), all warmed up at load so
+  no request ever pays a compile;
+- each batch is ONE jitted apply (session state donated for recurrent
+  policies) followed by ONE coalesced ``device_get`` for the actions — the
+  dispatcher body holds no other host syncs;
+- actions are stochastic-by-seed (``jax.random.PRNGKey(seed)`` per row, the
+  same derivation the evaluate paths use) or greedy; both are deterministic
+  functions of (artifact, obs, seed) so responses are replayable;
+- multiple artifacts are hosted concurrently with LRU eviction past
+  ``max_models``.
+
+Telemetry: request latency lands in a :class:`~sheeprl_tpu.telemetry.Histogram`
+(p50/p95/p99 via ``stats()``), queue depth and batch occupancy are gauges,
+sheds/timeouts/errors are counters — all mirrored into the process tracer
+when one is installed.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from collections import OrderedDict, deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from sheeprl_tpu.serve.artifact import PolicyArtifact, load_artifact, make_policy
+from sheeprl_tpu.telemetry import tracer as tracer_mod
+from sheeprl_tpu.telemetry.histogram import Histogram
+
+MODES = ("greedy", "sample")
+
+
+class EngineClosed(RuntimeError):
+    """The engine is shut down (requests are not accepted)."""
+
+
+class EngineOverloaded(RuntimeError):
+    """Backpressure signal: queue full, or the estimated wait exceeds the
+    request deadline. Carries ``retry_after_s`` for the server's 429."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0) -> None:
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
+class RequestExpired(TimeoutError):
+    """The request's deadline passed while it waited in the queue."""
+
+
+def next_pow2(n: int) -> int:
+    return 1 << (max(int(n), 1) - 1).bit_length()
+
+
+@dataclass
+class _Request:
+    model: str
+    mode: str
+    obs: Any
+    seed: int
+    session: Optional[str]
+    deadline_t: Optional[float]  # absolute monotonic deadline, None = no deadline
+    future: Future
+    t_submit: float
+
+
+@dataclass
+class _HostedModel:
+    name: str
+    artifact: Optional[PolicyArtifact]
+    adapter: Any
+    applies: Dict[str, Any] = field(default_factory=dict)
+    sessions: "OrderedDict[str, Any]" = field(default_factory=OrderedDict)
+    dummy_session: Any = None
+
+
+class InferenceEngine:
+    def __init__(
+        self,
+        *,
+        max_batch: int = 8,
+        queue_capacity: int = 64,
+        batch_window_s: float = 0.002,
+        max_models: int = 4,
+        max_sessions: int = 256,
+        autostart: bool = True,
+    ) -> None:
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.max_batch = next_pow2(max_batch)
+        self.buckets = [1 << i for i in range((self.max_batch).bit_length())]
+        self.buckets = [b for b in self.buckets if b <= self.max_batch]
+        self.queue_capacity = int(queue_capacity)
+        self.batch_window_s = float(batch_window_s)
+        self.max_models = int(max_models)
+        self.max_sessions = int(max_sessions)
+
+        self._models: "OrderedDict[str, _HostedModel]" = OrderedDict()
+        self._queue: deque = deque()
+        self._cv = threading.Condition()
+        self._stop = False
+        self._drain_on_close = True
+        self._thread: Optional[threading.Thread] = None
+
+        self.latency = Histogram()
+        self.counters: Dict[str, int] = {
+            "requests": 0,
+            "batches": 0,
+            "sheds": 0,
+            "timeouts": 0,
+            "errors": 0,
+            "evictions": 0,
+        }
+        # bucket -> [requests_served, batches] for mean-occupancy reporting.
+        self._occupancy: Dict[int, List[int]] = {}
+        self._ewma_service_s: Optional[float] = None
+        if autostart:
+            self.start()
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._run, name="serve-dispatcher", daemon=True)
+        self._thread.start()
+
+    def close(self, drain: bool = True) -> None:
+        """Stop the dispatcher. ``drain=True`` (the SIGTERM path) serves every
+        queued request first; ``drain=False`` fails them with EngineClosed."""
+        with self._cv:
+            self._stop = True
+            self._drain_on_close = bool(drain)
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        leftovers: List[_Request] = []
+        with self._cv:
+            while self._queue:
+                leftovers.append(self._queue.popleft())
+        for req in leftovers:
+            req.future.set_exception(EngineClosed("engine closed before the request was served"))
+
+    # --------------------------------------------------------- model hosting
+    def load(self, name: str, path: str, *, warmup: bool = True) -> Dict[str, Any]:
+        """Load an artifact under ``name``, compile every (mode, bucket)
+        variant, and evict the least-recently-used model past ``max_models``."""
+        artifact = load_artifact(path)
+        return self.host(name, make_policy(artifact), artifact=artifact, warmup=warmup)
+
+    def host(
+        self,
+        name: str,
+        adapter: Any,
+        *,
+        artifact: Optional[PolicyArtifact] = None,
+        warmup: bool = True,
+    ) -> Dict[str, Any]:
+        """Mount an already-constructed adapter (the in-process path ``load``
+        goes through after reading an artifact from disk)."""
+        import jax
+
+        model = _HostedModel(name=name, artifact=artifact, adapter=adapter)
+        for mode in MODES:
+            donate = (3,) if adapter.stateful else ()
+            model.applies[mode] = jax.jit(
+                adapter.make_apply(greedy=(mode == "greedy")), donate_argnums=donate
+            )
+        if adapter.stateful:
+            model.dummy_session = adapter.new_session(0)
+        if warmup:
+            self._warmup(model)
+        evicted: List[str] = []
+        with self._cv:
+            self._models[name] = model
+            self._models.move_to_end(name)
+            while len(self._models) > self.max_models:
+                victim, _ = self._models.popitem(last=False)
+                evicted.append(victim)
+                self.counters["evictions"] += 1
+        trc = tracer_mod.current()
+        trc.count("serve_models_loaded", 1)
+        for victim in evicted:
+            trc.count("serve_models_evicted", 1)
+        return adapter.describe()
+
+    def _warmup(self, model: _HostedModel) -> None:
+        """Populate the jit cache for every (mode, bucket) so no live request
+        pays a compile. Dispatch-only (no block): compilation happens at
+        trace time; execution of the zero batches can overlap freely."""
+        start = time.perf_counter()
+        for mode in MODES:
+            for bucket in self.buckets:
+                obs = model.adapter.pack_rows([], bucket)
+                seeds = np.zeros((bucket,), np.uint32)
+                state = self._stack_sessions(model, [model.dummy_session] * bucket) if model.adapter.stateful else None
+                model.applies[mode](model.adapter.params, obs, seeds, state)
+        tracer_mod.current().add_span(
+            "serve/warmup",
+            "serve",
+            start,
+            time.perf_counter() - start,
+            {"model": model.name, "buckets": list(self.buckets)},
+        )
+
+    def unload(self, name: str) -> None:
+        with self._cv:
+            self._models.pop(name, None)
+
+    def models(self) -> Dict[str, Dict[str, Any]]:
+        with self._cv:
+            hosted = list(self._models.items())
+        return {name: model.adapter.describe() for name, model in hosted}
+
+    # --------------------------------------------------------------- ingress
+    def estimated_wait_s(self) -> float:
+        """Queue depth x EWMA per-request service time: the admission
+        estimate the deadline shed compares against."""
+        ewma = self._ewma_service_s or 0.0
+        return (len(self._queue) + 1) * ewma
+
+    def submit(
+        self,
+        model: str,
+        obs: Any,
+        *,
+        mode: str = "greedy",
+        seed: int = 0,
+        session: Optional[str] = None,
+        deadline_s: Optional[float] = None,
+    ) -> Future:
+        """Enqueue one observation; returns a Future resolving to the action
+        row (numpy). Raises KeyError (unknown model), ValueError (bad mode /
+        malformed obs / missing session), EngineOverloaded (shed), or
+        EngineClosed."""
+        if mode not in MODES:
+            raise ValueError(f"mode must be one of {MODES}, got {mode!r}")
+        with self._cv:
+            if self._stop:
+                raise EngineClosed("engine is shutting down")
+            hosted = self._models.get(model)
+        if hosted is None:
+            raise KeyError(f"No model named {model!r} is loaded. Loaded: {sorted(self.models())}")
+        if hosted.adapter.stateful and session is None:
+            raise ValueError(
+                f"model {model!r} is recurrent: requests must carry a session id "
+                "(any stable string; state is kept per session)"
+            )
+        row = hosted.adapter.normalize_row(obs)
+
+        if deadline_s is not None and self.estimated_wait_s() > float(deadline_s):
+            self.counters["sheds"] += 1
+            tracer_mod.current().count("serve_sheds", 1)
+            raise EngineOverloaded(
+                f"estimated wait {self.estimated_wait_s():.3f}s exceeds the request "
+                f"deadline {float(deadline_s):.3f}s",
+                retry_after_s=max(self.estimated_wait_s(), 0.05),
+            )
+        fut: Future = Future()
+        req = _Request(
+            model=model,
+            mode=mode,
+            obs=row,
+            seed=int(seed),
+            session=session,
+            deadline_t=(time.monotonic() + float(deadline_s)) if deadline_s is not None else None,
+            future=fut,
+            t_submit=time.perf_counter(),
+        )
+        with self._cv:
+            if self._stop:
+                raise EngineClosed("engine is shutting down")
+            if len(self._queue) >= self.queue_capacity:
+                self.counters["sheds"] += 1
+                tracer_mod.current().count("serve_sheds", 1)
+                raise EngineOverloaded(
+                    f"request queue is full ({self.queue_capacity})",
+                    retry_after_s=max(self.estimated_wait_s(), 0.05),
+                )
+            self._queue.append(req)
+            self.counters["requests"] += 1
+            self._cv.notify_all()
+        return fut
+
+    def act(
+        self,
+        model: str,
+        obs: Any,
+        *,
+        mode: str = "greedy",
+        seed: int = 0,
+        session: Optional[str] = None,
+        deadline_s: Optional[float] = None,
+        timeout: Optional[float] = 30.0,
+    ) -> np.ndarray:
+        """Synchronous submit + wait (the in-process client path)."""
+        return self.submit(
+            model, obs, mode=mode, seed=seed, session=session, deadline_s=deadline_s
+        ).result(timeout=timeout)
+
+    def new_session_id(self) -> str:
+        return uuid.uuid4().hex
+
+    def end_session(self, model: str, session: str) -> None:
+        with self._cv:
+            hosted = self._models.get(model)
+        if hosted is not None:
+            hosted.sessions.pop(session, None)
+
+    # ------------------------------------------------------------ dispatcher
+    def _run(self) -> None:
+        while True:
+            batch = self._next_batch()
+            if batch is None:
+                return
+            self._dispatch_batch(batch)
+
+    def _next_batch(self) -> Optional[List[_Request]]:
+        """Block for the next head-of-line run of batchable requests; None
+        means the dispatcher should exit (stopped and nothing left to drain)."""
+        with self._cv:
+            while True:
+                if self._queue:
+                    break
+                if self._stop:
+                    return None
+                self._cv.wait(timeout=0.1)
+            if not self._stop and self.batch_window_s > 0 and len(self._queue) < self.max_batch:
+                # Linger briefly to let the batch fill — bounded, and skipped
+                # entirely during drain.
+                deadline = time.monotonic() + self.batch_window_s
+                while len(self._queue) < self.max_batch and not self._stop:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cv.wait(timeout=remaining)
+            batch = [self._queue.popleft()]
+            sessions = {batch[0].session}
+            while self._queue and len(batch) < self.max_batch:
+                head: _Request = self._queue[0]
+                same_group = head.model == batch[0].model and head.mode == batch[0].mode
+                # One request per recurrent session per batch: a session's
+                # state advances once per apply.
+                session_free = head.session is None or head.session not in sessions
+                if not (same_group and session_free):
+                    break
+                batch.append(self._queue.popleft())
+                sessions.add(head.session)
+            return batch
+
+    def _get_session(self, model: _HostedModel, req: _Request) -> Any:
+        state = model.sessions.get(req.session)
+        if state is None:
+            state = model.adapter.new_session(req.seed)
+            model.sessions[req.session] = state
+            while len(model.sessions) > self.max_sessions:
+                model.sessions.popitem(last=False)
+        model.sessions.move_to_end(req.session)
+        return state
+
+    @staticmethod
+    def _stack_sessions(model: _HostedModel, rows: List[Any]) -> Any:
+        import jax
+        import jax.numpy as jnp
+
+        return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *rows)
+
+    def _dispatch_batch(self, batch: List[_Request]) -> None:
+        import jax
+
+        now = time.monotonic()
+        live: List[_Request] = []
+        for req in batch:
+            if req.deadline_t is not None and now > req.deadline_t:
+                self.counters["timeouts"] += 1
+                tracer_mod.current().count("serve_timeouts", 1)
+                req.future.set_exception(
+                    RequestExpired("deadline passed while the request waited in the queue")
+                )
+            else:
+                live.append(req)
+        if not live:
+            return
+        with self._cv:
+            model = self._models.get(live[0].model)
+            if model is not None:
+                self._models.move_to_end(live[0].model)
+        if model is None:
+            for req in live:
+                req.future.set_exception(KeyError(f"model {live[0].model!r} was evicted"))
+            return
+
+        mode = live[0].mode
+        bucket = min(next_pow2(len(live)), self.max_batch)
+        obs = model.adapter.pack_rows([r.obs for r in live], bucket)
+        seeds = np.zeros((bucket,), np.uint32)
+        for i, req in enumerate(live):
+            seeds[i] = np.uint32(req.seed)
+        state = None
+        if model.adapter.stateful:
+            rows = [self._get_session(model, req) for req in live]
+            rows.extend([model.dummy_session] * (bucket - len(live)))
+            state = self._stack_sessions(model, rows)
+
+        start = time.perf_counter()
+        try:
+            actions, new_state = model.applies[mode](model.adapter.params, obs, seeds, state)
+            # ONE coalesced host transfer per batch: the action rows. Session
+            # states stay on device (sliced lazily below).
+            host_actions = np.asarray(jax.device_get(actions))
+        except Exception as err:  # noqa: BLE001 - any apply failure fails the batch
+            self.counters["errors"] += 1
+            tracer_mod.current().count("serve_errors", 1)
+            for req in live:
+                req.future.set_exception(err)
+            return
+        elapsed = time.perf_counter() - start
+        if model.adapter.stateful:
+            for i, req in enumerate(live):
+                model.sessions[req.session] = jax.tree_util.tree_map(lambda x: x[i], new_state)
+
+        per_request = elapsed / len(live)
+        prev = self._ewma_service_s
+        self._ewma_service_s = per_request if prev is None else 0.2 * per_request + 0.8 * prev
+        self.counters["batches"] += 1
+        occ = self._occupancy.setdefault(bucket, [0, 0])
+        occ[0] += len(live)
+        occ[1] += 1
+
+        trc = tracer_mod.current()
+        trc.add_span(
+            "serve/batch",
+            "serve",
+            start,
+            elapsed,
+            {"model": model.name, "mode": mode, "bucket": bucket, "occupancy": len(live)},
+        )
+        trc.count("serve_batches", 1)
+        trc.count("serve_requests_served", len(live))
+        trc.set_gauge("serve/queue_depth", float(len(self._queue)))
+        trc.set_gauge("serve/batch_occupancy", float(len(live)) / float(bucket))
+
+        done = time.perf_counter()
+        for i, req in enumerate(live):
+            self.latency.record(done - req.t_submit)
+            req.future.set_result(host_actions[i])
+
+    # ----------------------------------------------------------------- stats
+    def reset_stats(self) -> None:
+        """Zero the latency histogram, occupancy table, and counters (bench
+        sweeps measure per-leg windows); the service-time EWMA is kept."""
+        with self._cv:
+            self.latency.reset()
+            self._occupancy.clear()
+            for key in self.counters:
+                self.counters[key] = 0
+
+    def stats(self) -> Dict[str, Any]:
+        occupancy = {
+            str(bucket): {
+                "batches": int(batches),
+                "mean_occupancy": (served / batches) if batches else 0.0,
+            }
+            for bucket, (served, batches) in sorted(self._occupancy.items())
+        }
+        return {
+            "queue_depth": len(self._queue),
+            "counters": dict(self.counters),
+            "latency": self.latency.summary(),
+            "ewma_service_s": self._ewma_service_s,
+            "occupancy": occupancy,
+            "models": sorted(self._models),
+            "buckets": list(self.buckets),
+        }

@@ -1,0 +1,75 @@
+"""Registry mapping algorithm names to their serving policy adapters.
+
+Mirrors the training-side pattern in :mod:`sheeprl_tpu.registry` (decorator
+registration + a ``register_all`` that imports the per-algo modules), so a
+checkpoint exported for any registered algorithm round-trips through
+``serve`` without the serving core knowing algorithm internals. An adapter
+class provides two halves of the contract:
+
+- class method ``export(state, cfg) -> (params, config)`` — extract the
+  inference-only params pytree from a training checkpoint ``state`` plus the
+  (JSON-plain) config subtree the load side needs to rebuild the modules;
+- constructor ``Adapter(spec, params)`` — rebuild the apply path from a
+  loaded artifact, exposing ``pack_rows`` / ``make_apply`` /
+  ``action_shape`` (and ``new_session`` when ``stateful``) to the engine.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Type, Union
+
+policy_registry: Dict[str, type] = {}
+_REGISTERED = False
+
+
+def register_policy(algorithms: Union[str, List[str]]):
+    """Class decorator: register a policy adapter for one or more algorithm
+    names (the ``cfg.algo.name`` recorded in the checkpoint's config)."""
+    if isinstance(algorithms, str):
+        algorithms = [algorithms]
+
+    def decorator(cls: type) -> type:
+        for name in algorithms:
+            if name in policy_registry and policy_registry[name] is not cls:
+                raise ValueError(
+                    f"A policy adapter for algorithm {name!r} is already registered "
+                    f"({policy_registry[name].__name__})"
+                )
+            policy_registry[name] = cls
+        return cls
+
+    return decorator
+
+
+def register_all_policies() -> None:
+    """Import every built-in serve adapter module (idempotent). Imports are
+    guarded so one algo family's missing optional deps never takes down the
+    others."""
+    global _REGISTERED
+    if _REGISTERED:
+        return
+    _REGISTERED = True
+    import importlib
+
+    for mod in (
+        "sheeprl_tpu.algos.sac.serve",
+        "sheeprl_tpu.algos.ppo.serve",
+        "sheeprl_tpu.algos.dreamer_v3.serve",
+    ):
+        try:
+            importlib.import_module(mod)
+        except ImportError as err:  # pragma: no cover - optional-dep guard
+            import warnings
+
+            warnings.warn(f"Serve adapter module {mod} not importable: {err}")
+
+
+def get_policy_cls(algo: str) -> Type:
+    register_all_policies()
+    try:
+        return policy_registry[algo]
+    except KeyError:
+        raise KeyError(
+            f"No serving adapter registered for algorithm {algo!r}. "
+            f"Available: {sorted(policy_registry)}"
+        ) from None

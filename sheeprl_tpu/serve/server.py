@@ -1,0 +1,205 @@
+"""Stdlib-only HTTP front end for the inference engine.
+
+One ``ThreadingHTTPServer`` (no third-party web stack — the serving path has
+the same zero-new-dependencies rule as the rest of the repo) exposing:
+
+- ``POST /v1/act`` — body ``{"model", "obs", "mode"?, "seed"?, "session"?,
+  "deadline_s"?}``; responds ``{"action": [...], "session": ...}``;
+- ``GET /v1/models`` — model cards for every hosted artifact plus engine
+  stats (latency percentiles, occupancy, counters);
+- ``GET /healthz`` — liveness + queue depth (load balancers poll this).
+
+Engine exceptions map onto transport semantics: unknown model → 404, bad
+request rows → 400, :class:`EngineOverloaded` → 429 with ``Retry-After``
+(deadline-based shedding — the engine refuses work it cannot finish in
+time rather than queueing it to die), :class:`RequestExpired` → 504, and a
+draining engine → 503.
+
+Shutdown reuses the resilience discipline: ``serve_forever`` installs a
+:class:`~sheeprl_tpu.core.resilience.PreemptionGuard` (pointer writes off —
+nothing to checkpoint) and on SIGTERM stops accepting connections, drains
+the queue through ``engine.close(drain=True)``, then exits 0.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from sheeprl_tpu.serve.engine import (
+    EngineClosed,
+    EngineOverloaded,
+    InferenceEngine,
+    RequestExpired,
+)
+
+
+def _json_bytes(payload: Dict[str, Any]) -> bytes:
+    return json.dumps(payload).encode("utf-8")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # Set by PolicyServer before the server starts.
+    engine: InferenceEngine
+
+    server_version = "sheeprl-tpu-serve/1"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, format: str, *args: Any) -> None:  # noqa: A002
+        pass  # per-request access logs would drown the tracer's signal
+
+    # ------------------------------------------------------------- plumbing
+    def _reply(self, status: int, payload: Dict[str, Any], headers: Optional[Dict[str, str]] = None) -> None:
+        body = _json_bytes(payload)
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for key, value in (headers or {}).items():
+            self.send_header(key, value)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, status: int, message: str, headers: Optional[Dict[str, str]] = None) -> None:
+        self._reply(status, {"error": message}, headers)
+
+    # --------------------------------------------------------------- routes
+    def do_GET(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
+        if self.path == "/healthz":
+            stats = self.engine.stats()
+            self._reply(200, {"status": "ok", "queue_depth": stats["queue_depth"], "models": stats["models"]})
+        elif self.path == "/v1/models":
+            self._reply(200, {"models": self.engine.models(), "stats": self.engine.stats()})
+        else:
+            self._error(404, f"no route for GET {self.path}")
+
+    def do_POST(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
+        if self.path != "/v1/act":
+            self._error(404, f"no route for POST {self.path}")
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            request = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(request, dict):
+                raise ValueError("request body must be a JSON object")
+            model = request["model"]
+            obs = request["obs"]
+        except (KeyError, ValueError, json.JSONDecodeError) as err:
+            self._error(400, f"malformed request: {err}")
+            return
+        deadline_s = request.get("deadline_s")
+        try:
+            action = self.engine.act(
+                str(model),
+                obs,
+                mode=str(request.get("mode", "greedy")),
+                seed=int(request.get("seed", 0)),
+                session=request.get("session"),
+                deadline_s=float(deadline_s) if deadline_s is not None else None,
+            )
+        except KeyError as err:
+            self._error(404, str(err))
+        except ValueError as err:
+            self._error(400, str(err))
+        except EngineOverloaded as err:
+            self._error(429, str(err), {"Retry-After": f"{err.retry_after_s:.3f}"})
+        except RequestExpired as err:
+            self._error(504, str(err))
+        except EngineClosed as err:
+            self._error(503, str(err))
+        else:
+            self._reply(
+                200,
+                {
+                    "model": str(model),
+                    "action": np.asarray(action).tolist(),
+                    "session": request.get("session"),
+                },
+            )
+
+
+class PolicyServer:
+    """Own an engine + HTTP listener pair.
+
+    ``start()`` binds and serves on a daemon thread (tests, in-process use);
+    ``serve_forever()`` is the CLI path — foreground with SIGTERM drain.
+    """
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 8080,
+    ) -> None:
+        self.engine = engine
+        handler = type("BoundHandler", (_Handler,), {"engine": engine})
+        self._http = ThreadingHTTPServer((host, port), handler)
+        self._http.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        host, port = self._http.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "PolicyServer":
+        self._thread = threading.Thread(target=self._http.serve_forever, name="serve-http", daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self, drain: bool = True) -> None:
+        self._http.shutdown()
+        self._http.server_close()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        self.engine.close(drain=drain)
+
+    def serve_forever(self, poll_s: float = 0.25) -> None:
+        """Foreground serve with graceful preemption: SIGTERM (or Ctrl-C)
+        flips the guard, we stop accepting connections, drain the queue,
+        and return — the k8s-friendly exit the training loops already use."""
+        from sheeprl_tpu.core.resilience import PreemptionGuard
+
+        guard = PreemptionGuard(enabled=True, write_pointer=False).install()
+        self.start()
+        try:
+            while not guard.preempted:
+                time.sleep(poll_s)
+        finally:
+            self.close(drain=True)
+            guard.close()
+
+
+class ServeClient:
+    """In-process client mirroring the HTTP surface (bench legs and tests
+    exercise the exact engine semantics without a socket in the loop)."""
+
+    def __init__(self, engine: InferenceEngine) -> None:
+        self.engine = engine
+
+    def act(
+        self,
+        model: str,
+        obs: Dict[str, Any],
+        *,
+        mode: str = "greedy",
+        seed: int = 0,
+        session: Optional[str] = None,
+        deadline_s: Optional[float] = None,
+        timeout: Optional[float] = 30.0,
+    ) -> np.ndarray:
+        return self.engine.act(
+            model, obs, mode=mode, seed=seed, session=session, deadline_s=deadline_s, timeout=timeout
+        )
+
+    def models(self) -> Dict[str, Any]:
+        return self.engine.models()
+
+    def stats(self) -> Dict[str, Any]:
+        return self.engine.stats()

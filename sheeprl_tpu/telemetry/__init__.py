@@ -7,6 +7,8 @@ Parts (see each module's docstring for the design):
 - :mod:`~sheeprl_tpu.telemetry.step_timer` — async-dispatch-aware step
   timing with the coalesced per-interval metric fetch (the productized
   donated-chain pattern from PROFILE.md);
+- :mod:`~sheeprl_tpu.telemetry.histogram` — streaming geometric-bucket
+  latency histogram (p50/p95/p99) used by StepTimer and the serving engine;
 - :mod:`~sheeprl_tpu.telemetry.jax_events` — compile/retrace/cache
   counters via jax.monitoring, HBM gauges, recompile-after-warmup watchdog;
 - :mod:`~sheeprl_tpu.telemetry.profiling` — config-driven jax.profiler
@@ -16,6 +18,7 @@ Parts (see each module's docstring for the design):
 """
 
 from sheeprl_tpu.telemetry import tracer
+from sheeprl_tpu.telemetry.histogram import Histogram, geometric_bounds
 from sheeprl_tpu.telemetry.jax_events import JaxEventMonitor
 from sheeprl_tpu.telemetry.profiling import ProfilerWindow
 from sheeprl_tpu.telemetry.step_timer import StepTimer
@@ -24,8 +27,10 @@ from sheeprl_tpu.telemetry.tracer import Span, Tracer
 
 __all__ = [
     "CHROME_TRACE_FILENAME",
+    "Histogram",
     "JSONL_FILENAME",
     "JaxEventMonitor",
+    "geometric_bounds",
     "ProfilerWindow",
     "Span",
     "StepTimer",

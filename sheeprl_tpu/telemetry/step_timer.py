@@ -34,6 +34,7 @@ from contextlib import contextmanager
 from typing import Any, List, Optional
 
 from sheeprl_tpu.telemetry import tracer as tracer_mod
+from sheeprl_tpu.telemetry.histogram import Histogram
 from sheeprl_tpu.utils.timer import timer
 
 
@@ -56,6 +57,11 @@ class StepTimer:
         self.bound_s = 0.0
         self.flushes = 0
         self.dropped_metrics = 0
+        # Per-dispatch enqueue-latency distribution: a mean hides the
+        # retrace/compile outliers that make a training step stall, so every
+        # dispatch wall-clock is histogrammed and flush() publishes the
+        # p50/p95/p99 as gauges.
+        self.dispatch_hist = Histogram()
 
     # ------------------------------------------------------------- dispatch
     @contextmanager
@@ -67,6 +73,7 @@ class StepTimer:
         elapsed = time.perf_counter() - start
         self.steps += 1
         self.dispatch_s += elapsed
+        self.dispatch_hist.record(elapsed)
         trc = tracer_mod.current()
         trc.add_span(f"{self.name}/dispatch", "dispatch", start, elapsed)
         # Dispatch-count counter: fused K-step trains show up as one
@@ -122,6 +129,12 @@ class StepTimer:
                 )
                 trc.count("device_get_calls", 1)
                 trc.count("device_get_bytes", nbytes)
+        trc = tracer_mod.current()
+        if trc.enabled and self.dispatch_hist.count:
+            for pct in (50.0, 95.0, 99.0):
+                trc.set_gauge(
+                    f"{self.name}/dispatch_p{pct:.0f}_s", self.dispatch_hist.percentile(pct)
+                )
         self.flushes += 1
         return fetched
 

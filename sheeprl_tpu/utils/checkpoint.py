@@ -42,7 +42,8 @@ import re
 import shutil
 import time
 import uuid
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -243,6 +244,46 @@ def find_latest_valid_checkpoint(
     return None
 
 
+@contextmanager
+def atomic_dir_writer(final_path: str, fail_point: str = "checkpoint.before_commit") -> Iterator[str]:
+    """Stage a directory payload, then commit it with a single ``os.rename``.
+
+    Yields a ``.tmp-*`` sibling of ``final_path`` (same filesystem, so the
+    rename is atomic); the caller writes the complete payload there. On
+    normal exit the staging dir is fsynced and renamed into place — swapping
+    through a ``.trash-*`` sibling when ``final_path`` already exists, so the
+    old content stays reachable until the new one is committed. On any
+    exception the staging dir is removed and nothing at ``final_path``
+    changes. This is the commit discipline shared by checkpoints and policy
+    artifacts; a kill at any byte leaves either the previous version or an
+    orphan that :func:`_gc_stale_staging` reaps.
+    """
+    final_path = os.path.abspath(final_path)
+    parent = os.path.dirname(final_path)
+    basename = os.path.basename(final_path)
+    os.makedirs(parent, exist_ok=True)
+    staging = os.path.join(parent, f"{_TMP_PREFIX}{basename}-{os.getpid()}-{uuid.uuid4().hex[:8]}")
+    try:
+        yield staging
+        _fsync_dir(staging)
+        chaos.maybe_fail(fail_point)
+        if os.path.lexists(final_path):
+            trash = os.path.join(parent, f"{_TRASH_PREFIX}{basename}-{uuid.uuid4().hex[:8]}")
+            os.rename(final_path, trash)
+            os.rename(staging, final_path)
+            shutil.rmtree(trash, ignore_errors=True)
+        else:
+            os.rename(staging, final_path)
+        _fsync_dir(parent)
+    except BaseException:
+        # A failed write must not leave the target half-written — it never
+        # does (we only rename at the end) — but also should not leak the
+        # staging dir on the *exception* path (a hard kill still can; see
+        # _gc_stale_staging).
+        shutil.rmtree(staging, ignore_errors=True)
+        raise
+
+
 def _gc_stale_staging(ckpt_dir: str) -> None:
     """Remove `.tmp-*` / `.trash-*` orphans left by killed saves, once old
     enough that no live writer can still own them."""
@@ -299,14 +340,13 @@ def save_checkpoint(
     )
     arrays, aux = _split_state(host_state)
 
-    staging = os.path.join(parent, f"{_TMP_PREFIX}{basename}-{os.getpid()}-{uuid.uuid4().hex[:8]}")
-    try:
-        # Stage the full payload in a temp sibling (same filesystem, so the
-        # final os.rename is atomic).
+    # Stage the full payload in a temp sibling (same filesystem, so the
+    # final os.rename is atomic); atomic_dir_writer owns the commit/cleanup.
+    with atomic_dir_writer(ckpt_path) as staging:
         with ocp.StandardCheckpointer() as ckptr:
             ckptr.save(os.path.join(staging, "arrays"), arrays)
-        aux_file = os.path.join(staging, "aux.pkl")
-        with open(aux_file, "wb") as fp:
+        staging_aux = os.path.join(staging, "aux.pkl")
+        with open(staging_aux, "wb") as fp:
             pickle.dump(aux, fp)
             fp.flush()
             os.fsync(fp.fileno())
@@ -320,35 +360,14 @@ def save_checkpoint(
             "leaf_count": leaf_count,
             "aux_count": len(aux),
             "digest": digest,
-            "aux_sha256": _sha256_file(aux_file),
+            "aux_sha256": _sha256_file(staging_aux),
             "created_unix": time.time(),
         }
-        manifest_file = os.path.join(staging, MANIFEST_NAME)
-        with open(manifest_file, "w") as fp:
+        staging_manifest = os.path.join(staging, MANIFEST_NAME)
+        with open(staging_manifest, "w") as fp:
             json.dump(manifest, fp, indent=2)
             fp.flush()
             os.fsync(fp.fileno())
-        _fsync_dir(staging)
-        chaos.maybe_fail("checkpoint.before_commit")
-
-        # Commit: single atomic rename (plus a swap through `.trash-*` when
-        # re-saving over an existing snapshot — the old state stays reachable
-        # until the new one is in place).
-        if os.path.lexists(ckpt_path):
-            trash = os.path.join(parent, f"{_TRASH_PREFIX}{basename}-{uuid.uuid4().hex[:8]}")
-            os.rename(ckpt_path, trash)
-            os.rename(staging, ckpt_path)
-            shutil.rmtree(trash, ignore_errors=True)
-        else:
-            os.rename(staging, ckpt_path)
-        _fsync_dir(parent)
-    except BaseException:
-        # A failed save must not leave the target half-written — it never
-        # does (we only rename at the end) — but also should not leak the
-        # staging dir on the *exception* path (a hard kill still can; see
-        # _gc_stale_staging).
-        shutil.rmtree(staging, ignore_errors=True)
-        raise
 
     tracer.count("checkpoint_saves")
     tracer.add_span(

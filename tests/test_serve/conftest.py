@@ -1,0 +1,117 @@
+"""Shared fixtures for the serving tests: one tiny CLI training run per algo
+family (session-scoped — several tests re-use each checkpoint)."""
+
+import glob
+import os
+
+import pytest
+
+
+def _run_and_find_ckpt(args, root):
+    from sheeprl_tpu.cli import run
+
+    run(args + [f"root_dir={root}", "run_name=serve_fixture"])
+    ckpts = sorted(glob.glob(os.path.join(root, "**", "ckpt_*"), recursive=True))
+    assert ckpts, f"training run under {root} produced no checkpoint"
+    return ckpts[-1]
+
+
+@pytest.fixture(scope="session")
+def sac_checkpoint(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("serve_sac"))
+    args = [
+        "exp=sac",
+        "env=dummy",
+        "env.id=continuous_dummy",
+        "env.wrapper.id=continuous_dummy",
+        "metric.log_level=0",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "algo.per_rank_batch_size=4",
+        "algo.learning_starts=4",
+        "algo.hidden_size=8",
+        "algo.run_test=False",
+        "algo.total_steps=16",
+        "buffer.memmap=False",
+        "buffer.size=64",
+        "buffer.checkpoint=False",
+        "checkpoint.every=0",
+        "checkpoint.save_last=True",
+        "fabric.accelerator=cpu",
+    ]
+    return _run_and_find_ckpt(args, root)
+
+
+@pytest.fixture(scope="session")
+def ppo_checkpoint(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("serve_ppo"))
+    args = [
+        "exp=ppo",
+        "env=dummy",
+        "metric.log_level=0",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "algo.total_steps=16",
+        "algo.rollout_steps=8",
+        "algo.per_rank_batch_size=4",
+        "algo.update_epochs=1",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.encoder.cnn_features_dim=16",
+        "algo.encoder.mlp_features_dim=8",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.cnn_keys.encoder=[rgb]",
+        "algo.run_test=False",
+        "buffer.memmap=False",
+        "checkpoint.every=0",
+        "checkpoint.save_last=True",
+        "fabric.accelerator=cpu",
+    ]
+    return _run_and_find_ckpt(args, root)
+
+
+@pytest.fixture(scope="session")
+def dv3_checkpoint(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("serve_dv3"))
+    args = [
+        "exp=dreamer_v3",
+        "env=dummy",
+        "metric.log_level=0",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "env.screen_size=64",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.per_rank_batch_size=2",
+        "algo.per_rank_sequence_length=1",
+        "algo.horizon=2",
+        "algo.world_model.encoder.cnn_channels_multiplier=2",
+        "algo.world_model.recurrent_model.recurrent_state_size=8",
+        "algo.world_model.representation_model.hidden_size=8",
+        "algo.world_model.transition_model.hidden_size=8",
+        "algo.world_model.stochastic_size=4",
+        "algo.world_model.discrete_size=4",
+        "algo.learning_starts=0",
+        "algo.run_test=False",
+        "algo.total_steps=8",
+        "buffer.memmap=False",
+        "buffer.checkpoint=False",
+        "checkpoint.every=0",
+        "checkpoint.save_last=True",
+        "fabric.accelerator=cpu",
+    ]
+    return _run_and_find_ckpt(args, root)
+
+
+def load_run_cfg(checkpoint_path):
+    import pathlib
+
+    import yaml
+
+    from sheeprl_tpu.utils.utils import dotdict
+
+    with open(pathlib.Path(checkpoint_path).parent.parent / "config.yaml") as fp:
+        return dotdict(yaml.safe_load(fp))

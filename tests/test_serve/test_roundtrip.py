@@ -1,0 +1,195 @@
+"""Serving correctness: export -> load -> engine actions versus the
+in-process evaluate paths.
+
+SAC and PPO greedy actions must be BIT-identical to the algorithms' own
+``test()`` computation (same params, same prepare_obs, same compiled graph
+shape — the engine's B == 1 bucket runs the exact evaluate graph). DreamerV3
+must reproduce the recurrent evaluate trajectory across an episode, latent
+state carried per session."""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.serve.artifact import export_artifact
+from sheeprl_tpu.serve.engine import InferenceEngine
+
+from tests.test_serve.conftest import load_run_cfg
+
+pytestmark = pytest.mark.serve
+
+
+def _obs_sequence(rng, n):
+    return [
+        {
+            "rgb": rng.integers(0, 255, (64, 64, 3), np.uint8),
+            "state": rng.standard_normal(10).astype(np.float32),
+        }
+        for _ in range(n)
+    ]
+
+
+@pytest.fixture
+def engine():
+    eng = InferenceEngine(max_batch=2, batch_window_s=0.0)
+    yield eng
+    eng.close()
+
+
+def test_sac_greedy_engine_matches_evaluate_bitwise(sac_checkpoint, engine, tmp_path):
+    import jax
+
+    from sheeprl_tpu.algos.sac.agent import build_agent
+    from sheeprl_tpu.algos.sac.utils import prepare_obs
+    from sheeprl_tpu.core.precision import resolve_precision
+    from sheeprl_tpu.serve.adapter import inference_runtime
+    from sheeprl_tpu.utils.checkpoint import load_checkpoint
+    from sheeprl_tpu.utils.env import make_env
+
+    cfg = load_run_cfg(sac_checkpoint)
+    cfg.env.capture_video = False
+    env = make_env(cfg, cfg.seed, 0)()
+    obs_space, action_space = env.observation_space, env.action_space
+    env.close()
+
+    # Reference: the evaluate computation (sac/utils.py test()) — jitted
+    # greedy get_actions over prepare_obs, params straight from the ckpt.
+    state = load_checkpoint(sac_checkpoint)
+    runtime = inference_runtime(resolve_precision(str(cfg.fabric.get("precision", "32-true"))))
+    agent, params = build_agent(runtime, cfg, obs_space, action_space, agent_state=state["agent"])
+    get_actions = jax.jit(lambda p, o: agent.get_actions(p, o, greedy=True))
+
+    path = export_artifact(sac_checkpoint, str(tmp_path / "sac.policy"))
+    engine.load("sac", path)
+
+    rng = np.random.default_rng(0)
+    for _ in range(4):
+        obs = {"state": rng.standard_normal(10).astype(np.float32)}
+        ref = np.asarray(get_actions(params["actor"], prepare_obs(obs, mlp_keys=cfg.algo.mlp_keys.encoder)))
+        served = np.asarray(engine.act("sac", obs))
+        assert served.dtype == ref.dtype
+        np.testing.assert_array_equal(served, ref[0])
+
+
+def test_ppo_greedy_engine_matches_evaluate_bitwise(ppo_checkpoint, engine, tmp_path):
+    import jax
+
+    from sheeprl_tpu.algos.ppo.agent import actions_metadata, build_agent
+    from sheeprl_tpu.algos.ppo.utils import prepare_obs
+    from sheeprl_tpu.core.precision import resolve_precision
+    from sheeprl_tpu.serve.adapter import inference_runtime
+    from sheeprl_tpu.utils.checkpoint import load_checkpoint
+    from sheeprl_tpu.utils.env import make_env
+
+    cfg = load_run_cfg(ppo_checkpoint)
+    cfg.env.capture_video = False
+    env = make_env(cfg, cfg.seed, 0)()
+    obs_space = env.observation_space
+    actions_dim, is_continuous = actions_metadata(env.action_space)
+    env.close()
+
+    state = load_checkpoint(ppo_checkpoint)
+    runtime = inference_runtime(resolve_precision(str(cfg.fabric.get("precision", "32-true"))))
+    agent, params = build_agent(
+        runtime, actions_dim, is_continuous, cfg, obs_space, agent_state=state["agent"]
+    )
+    get_actions = jax.jit(lambda p, o: agent.get_actions(p, o, greedy=True))
+
+    path = export_artifact(ppo_checkpoint, str(tmp_path / "ppo.policy"))
+    engine.load("ppo", path)
+
+    rng = np.random.default_rng(1)
+    for obs in _obs_sequence(rng, 4):
+        ref = np.asarray(get_actions(params, prepare_obs(obs, cnn_keys=cfg.algo.cnn_keys.encoder)))
+        served = np.asarray(engine.act("ppo", obs))
+        np.testing.assert_array_equal(served, ref[0])
+
+
+def test_dv3_session_reproduces_recurrent_evaluate_episode(dv3_checkpoint, engine, tmp_path):
+    import jax
+
+    from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
+    from sheeprl_tpu.algos.dreamer_v3.utils import normalize_player_obs, prepare_obs
+    from sheeprl_tpu.algos.ppo.agent import actions_metadata
+    from sheeprl_tpu.core.precision import resolve_precision
+    from sheeprl_tpu.serve.adapter import inference_runtime
+    from sheeprl_tpu.utils.checkpoint import load_checkpoint
+    from sheeprl_tpu.utils.env import make_env
+
+    cfg = load_run_cfg(dv3_checkpoint)
+    cfg.env.capture_video = False
+    env = make_env(cfg, cfg.seed, 0)()
+    obs_space = env.observation_space
+    actions_dim, is_continuous = actions_metadata(env.action_space)
+    env.close()
+
+    state = load_checkpoint(dv3_checkpoint)
+    runtime = inference_runtime(resolve_precision(str(cfg.fabric.get("precision", "32-true"))))
+    agent, built = build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        obs_space,
+        world_model_state=state["world_model"],
+        actor_state=state["actor"],
+    )
+    wm, actor = built["world_model"], built["actor"]
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+
+    # Reference: the recurrent evaluate loop (dreamer_v3/utils.py test()) —
+    # eager key split per step, latent state threaded through player_step.
+    seed = 123
+    player_step = jax.jit(
+        lambda s, o, k: agent.player_step(wm, actor, s, normalize_player_obs(o, cnn_keys), k, greedy=True)
+    )
+    player_state = jax.jit(agent.init_player_state, static_argnums=(1,))(wm, 1)
+    key = jax.random.PRNGKey(seed)
+    rng = np.random.default_rng(2)
+    episode = [{"state": rng.standard_normal(10).astype(np.float32)} for _ in range(5)]
+    ref_actions = []
+    for obs in episode:
+        key, sub = jax.random.split(key)
+        jnp_obs = prepare_obs(obs, cnn_keys=cnn_keys, num_envs=1)
+        _, real_actions, player_state = player_step(player_state, jnp_obs, sub)
+        ref_actions.append(np.asarray(real_actions)[0])
+
+    # Served: one session, seeded identically, same obs sequence. The
+    # session carries the latent state between requests.
+    path = export_artifact(dv3_checkpoint, str(tmp_path / "dv3.policy"))
+    engine.load("dv3", path)
+    sess = engine.new_session_id()
+    served = [np.asarray(engine.act("dv3", obs, session=sess, seed=seed)) for obs in episode]
+
+    for t, (ref, got) in enumerate(zip(ref_actions, served)):
+        np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6, err_msg=f"step {t}")
+
+
+def test_sample_mode_is_deterministic_per_seed(sac_checkpoint, engine, tmp_path):
+    path = export_artifact(sac_checkpoint, str(tmp_path / "sac.policy"))
+    engine.load("sac", path)
+    obs = {"state": np.linspace(-1, 1, 10).astype(np.float32)}
+    a = np.asarray(engine.act("sac", obs, mode="sample", seed=9))
+    b = np.asarray(engine.act("sac", obs, mode="sample", seed=9))
+    c = np.asarray(engine.act("sac", obs, mode="sample", seed=10))
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+
+
+def test_batched_requests_match_single_request_results(ppo_checkpoint, tmp_path):
+    # Two concurrent greedy requests ride one 2-bucket; each row's action
+    # must match the 1-bucket (evaluate-graph) answer for the same obs.
+    path = export_artifact(ppo_checkpoint, str(tmp_path / "ppo.policy"))
+    eng = InferenceEngine(max_batch=2, batch_window_s=0.0, autostart=False)
+    eng.load("ppo", path)
+    rng = np.random.default_rng(3)
+    o1, o2 = _obs_sequence(rng, 2)
+    f1 = eng.submit("ppo", o1)
+    f2 = eng.submit("ppo", o2)
+    eng.start()
+    batched = [np.asarray(f.result(timeout=60)) for f in (f1, f2)]
+    singles = [np.asarray(eng.act("ppo", o)) for o in (o1, o2)]
+    occupancies = eng.stats()["occupancy"]
+    eng.close()
+    np.testing.assert_array_equal(batched[0], singles[0])
+    np.testing.assert_array_equal(batched[1], singles[1])
+    assert "2" in occupancies  # the pair really did share one apply

@@ -1,0 +1,156 @@
+"""HTTP front-end tests: routes, JSON shapes, and the engine-exception ->
+status-code mapping, all against the synthetic echo adapter on an ephemeral
+port (no artifacts, no compiles)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.serve.engine import InferenceEngine
+from sheeprl_tpu.serve.server import PolicyServer, ServeClient
+
+from tests.test_serve.test_engine import EchoAdapter, SessionAdapter
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture
+def served():
+    eng = InferenceEngine(max_batch=4, batch_window_s=0.0)
+    eng.host("echo", EchoAdapter(), warmup=False)
+    eng.host("stateful", SessionAdapter(), warmup=False)
+    server = PolicyServer(eng, host="127.0.0.1", port=0).start()
+    yield server
+    server.close()
+
+
+def _post(server, path, payload):
+    req = urllib.request.Request(
+        server.address + path,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _get(server, path):
+    with urllib.request.urlopen(server.address + path, timeout=30) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_healthz_reports_models_and_queue(served):
+    status, body = _get(served, "/healthz")
+    assert status == 200
+    assert body["status"] == "ok"
+    assert sorted(body["models"]) == ["echo", "stateful"]
+
+
+def test_models_route_returns_cards_and_stats(served):
+    status, body = _get(served, "/v1/models")
+    assert status == 200
+    assert body["models"]["echo"]["algo"] == "echo"
+    assert "latency" in body["stats"]
+
+
+def test_act_roundtrip(served):
+    status, body = _post(served, "/v1/act", {"model": "echo", "obs": {"x": [1, 2, 3, 4]}, "seed": 5})
+    assert status == 200
+    assert np.asarray(body["action"]).item() == pytest.approx(15.0)
+
+
+def test_act_with_session(served):
+    for expected in (3.0, 4.0):
+        _, body = _post(
+            served,
+            "/v1/act",
+            {"model": "stateful", "obs": {"x": [0, 0, 0, 0]}, "session": "s1", "seed": 3},
+        )
+        assert np.asarray(body["action"]).item() == pytest.approx(expected)
+        assert body["session"] == "s1"
+
+
+def _post_error(server, path, payload):
+    try:
+        _post(server, path, payload)
+    except urllib.error.HTTPError as err:
+        return err.code, json.loads(err.read()), dict(err.headers)
+    raise AssertionError("expected an HTTP error")
+
+
+def test_unknown_model_is_404(served):
+    code, body, _ = _post_error(served, "/v1/act", {"model": "nope", "obs": {"x": [0, 0, 0, 0]}})
+    assert code == 404
+    assert "nope" in body["error"]
+
+
+def test_bad_obs_is_400(served):
+    code, body, _ = _post_error(served, "/v1/act", {"model": "echo", "obs": {"wrong": 1}})
+    assert code == 400
+
+
+def test_missing_fields_is_400(served):
+    code, body, _ = _post_error(served, "/v1/act", {"obs": {"x": [0, 0, 0, 0]}})
+    assert code == 400
+    assert "malformed" in body["error"]
+
+
+def test_session_required_for_stateful_is_400(served):
+    code, body, _ = _post_error(served, "/v1/act", {"model": "stateful", "obs": {"x": [0, 0, 0, 0]}})
+    assert code == 400
+    assert "session" in body["error"]
+
+
+def test_unknown_route_is_404(served):
+    code, _, _ = _post_error(served, "/v1/unknown", {})
+    assert code == 404
+    try:
+        _get(served, "/v1/unknown")
+    except urllib.error.HTTPError as err:
+        assert err.code == 404
+    else:
+        raise AssertionError("expected 404")
+
+
+def test_overload_maps_to_429_with_retry_after():
+    eng = InferenceEngine(max_batch=1, queue_capacity=1, batch_window_s=0.0, autostart=False)
+    eng.host("echo", EchoAdapter(), warmup=False)
+    server = PolicyServer(eng, host="127.0.0.1", port=0).start()
+    try:
+        # Dispatcher off: the first request parks in the queue, the second
+        # trips the capacity shed.
+        fut = eng.submit("echo", {"x": [0, 0, 0, 0]})
+        code, body, headers = _post_error(
+            server, "/v1/act", {"model": "echo", "obs": {"x": [0, 0, 0, 0]}}
+        )
+        assert code == 429
+        assert "Retry-After" in headers
+        eng.start()
+        fut.result(timeout=10)
+    finally:
+        server.close()
+
+
+def test_closed_engine_maps_to_503():
+    eng = InferenceEngine(batch_window_s=0.0)
+    eng.host("echo", EchoAdapter(), warmup=False)
+    server = PolicyServer(eng, host="127.0.0.1", port=0).start()
+    eng.close()
+    try:
+        code, body, _ = _post_error(server, "/v1/act", {"model": "echo", "obs": {"x": [0, 0, 0, 0]}})
+        assert code == 503
+    finally:
+        server._http.shutdown()
+        server._http.server_close()
+
+
+def test_in_process_client_mirrors_http(served):
+    client = ServeClient(served.engine)
+    action = client.act("echo", {"x": [2, 2, 2, 2]}, seed=1)
+    assert float(action) == pytest.approx(9.0)
+    assert sorted(client.models()) == ["echo", "stateful"]
+    assert client.stats()["counters"]["requests"] >= 1

@@ -1,0 +1,142 @@
+"""Histogram primitive unit tests: bucketing, quantile interpolation,
+overflow semantics, thread safety, and the StepTimer adoption."""
+
+import math
+import threading
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from sheeprl_tpu.telemetry import tracer as tracer_mod
+from sheeprl_tpu.telemetry.histogram import Histogram, geometric_bounds
+from sheeprl_tpu.telemetry.step_timer import StepTimer
+from sheeprl_tpu.telemetry.tracer import Tracer
+
+pytestmark = pytest.mark.telemetry
+
+
+def test_geometric_bounds_cover_range_and_grow():
+    bounds = geometric_bounds(1e-6, 128.0, math.sqrt(2.0))
+    assert bounds[0] == pytest.approx(1e-6)
+    assert bounds[-1] >= 128.0
+    ratios = [b / a for a, b in zip(bounds, bounds[1:])]
+    assert all(r == pytest.approx(math.sqrt(2.0)) for r in ratios)
+
+
+def test_geometric_bounds_rejects_bad_args():
+    for lo, hi, growth in [(0.0, 1.0, 2.0), (1.0, 1.0, 2.0), (1e-6, 1.0, 1.0)]:
+        with pytest.raises(ValueError):
+            geometric_bounds(lo, hi, growth)
+
+
+def test_bounds_must_increase():
+    with pytest.raises(ValueError):
+        Histogram(bounds=[1.0, 1.0, 2.0])
+    with pytest.raises(ValueError):
+        Histogram(bounds=[])
+
+
+def test_empty_histogram_summary_is_zeroes():
+    h = Histogram()
+    assert h.count == 0
+    assert h.mean == 0.0
+    assert h.percentile(99.0) == 0.0
+    assert h.summary() == {
+        "count": 0, "mean": 0.0, "min": 0.0, "max": 0.0, "p50": 0.0, "p95": 0.0, "p99": 0.0,
+    }
+
+
+def test_mean_min_max_are_exact():
+    h = Histogram()
+    for v in (0.001, 0.002, 0.003, 0.010):
+        h.record(v)
+    assert h.count == 4
+    assert h.mean == pytest.approx(0.004)
+    assert h.min == pytest.approx(0.001)
+    assert h.max == pytest.approx(0.010)
+
+
+def test_percentiles_have_bounded_relative_error():
+    # Geometric buckets at sqrt(2) growth: quantile estimates are within one
+    # bucket of truth, i.e. ~41% relative error worst case. Use a dense
+    # deterministic distribution and assert the documented error bound.
+    h = Histogram()
+    values = [1e-3 * (1.0 + i / 100.0) for i in range(1000)]  # 1ms..~11ms
+    for v in values:
+        h.record(v)
+    values.sort()
+    for q in (50.0, 95.0, 99.0):
+        truth = values[int(q / 100.0 * (len(values) - 1))]
+        est = h.percentile(q)
+        assert abs(est - truth) / truth < 0.45, (q, est, truth)
+
+
+def test_percentile_clamped_to_observed_range():
+    h = Histogram()
+    h.record(0.005)
+    # A single sample: every quantile must be that sample, not a bucket edge.
+    assert h.percentile(0.0) == pytest.approx(0.005)
+    assert h.percentile(50.0) == pytest.approx(0.005)
+    assert h.percentile(100.0) == pytest.approx(0.005)
+
+
+def test_overflow_bucket_reports_observed_max():
+    h = Histogram(bounds=[0.001, 0.01])
+    h.record(5.0)   # far past the last bound
+    h.record(7.5)
+    assert h.percentile(50.0) == pytest.approx(7.5)
+    assert h.percentile(99.0) == pytest.approx(7.5)
+    assert h.summary()["max"] == pytest.approx(7.5)
+
+
+def test_percentile_rejects_out_of_range_q():
+    h = Histogram()
+    with pytest.raises(ValueError):
+        h.percentile(-1.0)
+    with pytest.raises(ValueError):
+        h.percentile(101.0)
+
+
+def test_reset_clears_state():
+    h = Histogram()
+    h.record(1.0)
+    h.reset()
+    assert h.count == 0
+    assert h.summary()["p99"] == 0.0
+
+
+def test_concurrent_record_loses_nothing():
+    h = Histogram()
+    n, threads = 2000, 8
+
+    def worker(seed):
+        for i in range(n):
+            h.record(1e-4 * ((seed * n + i) % 97 + 1))
+
+    ts = [threading.Thread(target=worker, args=(s,)) for s in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert h.count == n * threads
+    assert sum(h._counts) == n * threads
+
+
+def test_step_timer_emits_dispatch_percentile_gauges():
+    live = Tracer()
+    prev = tracer_mod.set_current(live)
+    try:
+        f = jax.jit(lambda x: x + 1)
+        st = StepTimer(name="train")
+        x = jnp.zeros((4,))
+        for _ in range(3):
+            with st.step():
+                x = f(x)
+            st.pend(x, {})
+        st.flush()
+        assert st.dispatch_hist.count == 3
+        gauges = set(live.counters())
+        assert {"train/dispatch_p50_s", "train/dispatch_p95_s", "train/dispatch_p99_s"} <= gauges
+    finally:
+        tracer_mod.set_current(prev)
