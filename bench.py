@@ -29,13 +29,17 @@ world-model/actor/critic training step and the per-step policy latency.
 
 Workloads:
 `python bench.py [dreamer_v3|dreamer_v3_devbuf|dreamer_v3_pipe|dreamer_v3_S|
-dreamer_v3_S_b32|dreamer_v3_S_b64|dreamer_v2|dreamer_v1|ppo|a2c|sac|
-sac_devbuf|sac_pipe|sac_resilience|serve_sac]`. The `*_pipe` legs are the
+dreamer_v3_S_b32|dreamer_v3_S_b64|dreamer_v3_health|dreamer_v2|dreamer_v1|
+ppo|a2c|sac|sac_devbuf|sac_pipe|sac_resilience|sac_health|serve_sac]`. The `*_pipe` legs are the
 pipelined-interaction A/B (fabric.async_fetch, env.pipeline_slices —
 core/interact.py); every result embeds the interaction time split and
 overlap fraction from the long run. `sac_resilience` is the fault-tolerance
 A/B (resilience=on vs the plain `sac` row, <2% target) and also reports the
-atomic checkpoint save cost directly. `serve_sac` is the serving stack's
+atomic checkpoint save cost directly. `sac_health` and `dreamer_v3_health`
+are the training-health A/B legs (health=on vs the plain `sac` /
+`dreamer_v3` rows, <2% target): in-jit probes fused into the train step +
+host-side sentinels reading the already-coalesced per-interval metric
+fetch. `serve_sac` is the serving stack's
 closed-loop load test (sheeprl_tpu/serve): concurrent clients against the
 dynamic micro-batching engine, vs_baseline = batching speedup over one
 client.
@@ -331,6 +335,22 @@ def bench_sac_resilience():
     return result
 
 
+def bench_sac_health():
+    # A/B leg: in-jit health probes + host-side sentinels (telemetry/health.py)
+    # armed on the same SAC workload and baseline as the plain `sac` row.
+    # Acceptance target: within 2% of `sac` — the probe is a handful of pure
+    # reductions fused into the already-compiled train step, and its scalars
+    # ride the StepTimer's existing coalesced per-interval transfer (zero
+    # extra host syncs per step; graftlint-enforced).
+    result = _timeboxed(
+        "sac_health_env_steps_per_sec", "sac_benchmarks", 65536, 65536 / 320.21,
+        learning_starts=100, warmup_steps=1024, start_steps=4096,
+        extra=("fabric.player_sync=async", "health=on"),
+    )
+    result["health"] = {"probes": True, "sentinels": True}
+    return result
+
+
 def bench_serve_sac():
     """Closed-loop load test of the serving stack (sheeprl_tpu/serve): train
     a tiny SAC policy, export it to an artifact, host it in an
@@ -459,7 +479,11 @@ def _accel_precision() -> str:
 
 
 def _bench_dreamer(
-    version: str, baseline_seconds: float, device_buffer: bool = False, pipelined: bool = False
+    version: str,
+    baseline_seconds: float,
+    device_buffer: bool = False,
+    pipelined: bool = False,
+    health: bool = False,
 ):
     # Off-policy: async weight mirror (see bench_sac). Precision is passed
     # explicitly so the result JSON records the semantics the number was
@@ -477,6 +501,11 @@ def _bench_dreamer(
         # the win here is the fetch riding under the fused-train dispatch.
         extra += ["fabric.async_fetch=true"]
         suffix = "_pipe"
+    if health:
+        # A/B leg (see bench_sac_health): probes over the world-model/actor/
+        # critic grad trees + the KL aux, sentinels on the host. <2% target.
+        extra += ["health=on"]
+        suffix = "_health"
     result = _timeboxed(
         f"dreamer_v{version}{suffix}_env_steps_per_sec",
         f"dreamer_v{version}_benchmarks",
@@ -488,6 +517,8 @@ def _bench_dreamer(
     if device_buffer:
         result["buffer_device"] = True
         result["fused_train_steps"] = 8
+    if health:
+        result["health"] = {"probes": True, "sentinels": True}
     return result
 
 
@@ -556,7 +587,7 @@ def main() -> None:
     # outright so the accelerator plugin is never initialized for them.
     # Accelerator workloads probe the device first and fall back to CPU
     # (recorded in the output) rather than hang on a wedged plugin.
-    if which in ("ppo", "a2c", "sac", "serve_sac"):
+    if which in ("ppo", "a2c", "sac", "sac_health", "serve_sac"):
         platform = "cpu"
     elif os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
         platform = "cpu"  # already pinned: nothing to probe
@@ -580,6 +611,7 @@ def main() -> None:
         "dreamer_v3": bench_dreamer_v3,
         "dreamer_v3_devbuf": lambda: _bench_dreamer("3", 1589.30, device_buffer=True),
         "dreamer_v3_pipe": lambda: _bench_dreamer("3", 1589.30, pipelined=True),
+        "dreamer_v3_health": lambda: _bench_dreamer("3", 1589.30, health=True),
         "dreamer_v3_S": bench_dreamer_v3_S,
         "dreamer_v3_S_b32": lambda: bench_dreamer_v3_S(batch=32),
         "dreamer_v3_S_b64": lambda: bench_dreamer_v3_S(batch=64),
@@ -591,6 +623,7 @@ def main() -> None:
         "sac_devbuf": lambda: bench_sac(device_buffer=True),
         "sac_pipe": lambda: bench_sac(pipelined=True),
         "sac_resilience": bench_sac_resilience,
+        "sac_health": bench_sac_health,
         "serve_sac": bench_serve_sac,
     }[which]()
     result["backend"] = jax.default_backend()

@@ -43,6 +43,14 @@ python -m sheeprl_tpu.analysis --no-baseline sheeprl_tpu/core/interact.py || rc=
 echo "== graftlint (serve, no baseline) =="
 python -m sheeprl_tpu.analysis --no-baseline sheeprl_tpu/serve/ || rc=1
 
+# The health-sentinel probe and the metrics registry are the two files
+# whose whole contract is "zero extra host syncs / pure host-side
+# arithmetic": pin them by name so the bar survives even if the telemetry
+# package gate above is ever relaxed.
+echo "== graftlint (health + registry, no baseline) =="
+python -m sheeprl_tpu.analysis --no-baseline \
+    sheeprl_tpu/telemetry/health.py sheeprl_tpu/telemetry/registry.py || rc=1
+
 # The fault-tolerance surface must itself be fault-tolerant: the atomic
 # checkpoint writer and the resilience/chaos modules hold zero findings
 # (GL007 non-atomic persistence included), no baseline, forever.

@@ -49,6 +49,7 @@ from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialRepla
 from sheeprl_tpu.data.device_buffer import DeviceReplayRing
 from sheeprl_tpu.core.runtime import DispatchThrottle
 from sheeprl_tpu.registry import register_algorithm
+from sheeprl_tpu.telemetry.health import health_probe, probes_enabled
 from sheeprl_tpu.utils.checkpoint import load_checkpoint, restore_opt_state, save_checkpoint
 from sheeprl_tpu.utils.distribution import (
     BernoulliSafeMode,
@@ -362,6 +363,18 @@ def make_step_core(agent: DV3Agent, txs: Dict[str, optax.GradientTransformation]
             "Grads/actor": optax.global_norm(actor_grads),
             "Grads/critic": optax.global_norm(critic_grads),
         }
+        if probes_enabled(cfg):
+            # In-jit health probe: pure reductions over the already-live grad
+            # and update trees, riding the StepTimer's coalesced interval
+            # transfer (zero extra host syncs).
+            metrics.update(
+                health_probe(
+                    params=(state["world_model"], state["actor"], state["critic"]),
+                    grads=(wm_grads, actor_grads, critic_grads),
+                    updates=(wm_updates, actor_updates, critic_updates),
+                    aux={"kl": aux["kl"]},
+                )
+            )
         return state, opt_states, img_aux["moments"], metrics
 
     return step_core
@@ -459,6 +472,7 @@ def main(runtime, cfg: Dict[str, Any]):
     telemetry = runtime.telemetry.open(log_dir, rank_zero=runtime.is_global_zero, device=runtime.device)
     guard = runtime.resilience.guard(rank_zero=runtime.is_global_zero)
     watchdog = runtime.resilience.watchdog
+    health = runtime.health
 
     envs = make_vector_env(cfg, rank, log_dir, restart_on_exception=True)
     action_space = envs.single_action_space
@@ -695,7 +709,9 @@ def main(runtime, cfg: Dict[str, Any]):
     # round trip over a tunneled chip). Scalars only, so the pinned device
     # memory is negligible.
     train_timer = telemetry.step_timer("train", timer_key="Time/train_time")
-    keep_train_metrics = aggregator is not None and not aggregator.disabled and cfg.metric.log_level > 0
+    keep_train_metrics = (
+        aggregator is not None and not aggregator.disabled and cfg.metric.log_level > 0
+    ) or health.enabled
 
     # The iteration's gradient steps, factored out so the pipelined
     # interaction can dispatch them between the action-fetch submit and its
@@ -948,6 +964,9 @@ def main(runtime, cfg: Dict[str, Any]):
             # transfer of every queued loss tree (StepTimer.flush) — the
             # pattern GL002 asks for, now owned by telemetry.
             fetched_train_metrics = train_timer.flush()
+            # Health sentinels inspect the same coalesced fetch — no extra
+            # transfer; a nonfinite hit taints the run and escalates.
+            health.observe(policy_step, fetched_train_metrics, telemetry=telemetry)
             if aggregator and not aggregator.disabled:
                 for m in fetched_train_metrics:
                     for k, v in m.items():
@@ -985,8 +1004,9 @@ def main(runtime, cfg: Dict[str, Any]):
             last_train = train_step_count
 
         # ----------------------------------------------------- checkpoint
-        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
-            (iter_num == total_iters or guard.preempted) and cfg.checkpoint.save_last
+        if health.allow_save() and (
+            (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every)
+            or ((iter_num == total_iters or guard.preempted) and cfg.checkpoint.save_last)
         ):
             last_checkpoint = policy_step
             ckpt_state = {

@@ -37,6 +37,7 @@ from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.device_buffer import DeviceReplayRing
 from sheeprl_tpu.core.runtime import DispatchThrottle
 from sheeprl_tpu.registry import register_algorithm
+from sheeprl_tpu.telemetry.health import health_probe, probes_enabled
 from sheeprl_tpu.utils.checkpoint import load_checkpoint, restore_opt_state, save_checkpoint
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -72,14 +73,24 @@ def make_critic_step(agent: DROQAgent, txs: Dict[str, optax.GradientTransformati
         state["qfs"] = optax.apply_updates(state["qfs"], qf_updates)
         # EMA after every critic update (reference: droq.py:117)
         state["qfs_target"] = agent.target_ema(state["qfs"], state["qfs_target"])
-        return (state, qf_opt), qf_l
+        metrics = {"value_loss": qf_l}
+        if probes_enabled(cfg):
+            # In-jit health probe over the critic grads/updates; the mean
+            # over the scan axis keeps nonfinite counts > 0 (see
+            # telemetry/health.py), so nothing is lost to the reduction.
+            metrics.update(health_probe(params=state["qfs"], grads=qf_grads, updates=qf_updates))
+        return (state, qf_opt), metrics
 
     return critic_step
 
 
-def make_actor_alpha_update(agent: DROQAgent, txs: Dict[str, optax.GradientTransformation]):
+def make_actor_alpha_update(
+    agent: DROQAgent, txs: Dict[str, optax.GradientTransformation], cfg: Dict[str, Any]
+):
     """Build the pure actor+alpha update over one [B, ...] observation batch
-    (reference: droq.py:120-134)."""
+    (reference: droq.py:120-134). Returns a trailing health-aux dict (empty
+    unless cfg.health probes are on) so the actor-side probe rides the same
+    metrics tree as the critic scan's."""
 
     def actor_alpha_update(state, actor_opt_in, alpha_opt_in, observations, k_actor, k_actor_drop):
         alpha = jnp.exp(state["log_alpha"])
@@ -102,7 +113,18 @@ def make_actor_alpha_update(agent: DROQAgent, txs: Dict[str, optax.GradientTrans
         alpha_l, alpha_grads = jax.value_and_grad(alpha_loss_fn)(state["log_alpha"])
         alpha_updates, alpha_opt = txs["alpha"].update(alpha_grads, alpha_opt_in, state["log_alpha"])
         state["log_alpha"] = optax.apply_updates(state["log_alpha"], alpha_updates)
-        return state, actor_opt, alpha_opt, actor_l, alpha_l
+        health_aux = {}
+        if probes_enabled(cfg):
+            probe = health_probe(
+                params=(state["actor"], state["log_alpha"]),
+                grads=(actor_grads, alpha_grads),
+                updates=(actor_updates, alpha_updates),
+            )
+            # Prefix the actor-side probe so it doesn't collide with the
+            # critic scan's standard health/ keys.
+            health_aux = {k.replace("health/", "health/actor_"): v for k, v in probe.items()}
+            health_aux.update(health_probe(aux={"alpha": alpha, "entropy": -jnp.mean(logprobs)}))
+        return state, actor_opt, alpha_opt, actor_l, alpha_l, health_aux
 
     return actor_alpha_update
 
@@ -112,7 +134,7 @@ def make_train_step(agent: DROQAgent, txs: Dict[str, optax.GradientTransformatio
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     critic_step = make_critic_step(agent, txs, cfg)
-    actor_alpha_update = make_actor_alpha_update(agent, txs)
+    actor_alpha_update = make_actor_alpha_update(agent, txs, cfg)
     batch_sharding = NamedSharding(mesh, P(None, DATA_AXIS))
     flat_sharding = NamedSharding(mesh, P(DATA_AXIS))
 
@@ -130,21 +152,21 @@ def make_train_step(agent: DROQAgent, txs: Dict[str, optax.GradientTransformatio
         k_scan, k_actor, k_actor_drop = jax.random.split(key, 3)
         keys = jax.random.split(k_scan, critic_data["rewards"].shape[0])
         critic_data = dict(critic_data, _key=keys)
-        (state, qf_opt), qf_losses = jax.lax.scan(
+        (state, qf_opt), qf_metrics = jax.lax.scan(
             critic_step, (state, opt_states["qf"]), critic_data
         )
 
-        state, actor_opt, alpha_opt, actor_l, alpha_l = actor_alpha_update(
+        state, actor_opt, alpha_opt, actor_l, alpha_l, health_aux = actor_alpha_update(
             state, opt_states["actor"], opt_states["alpha"], actor_data["observations"],
             k_actor, k_actor_drop,
         )
 
         opt_states = {"qf": qf_opt, "actor": actor_opt, "alpha": alpha_opt}
-        return state, opt_states, {
-            "value_loss": qf_losses.mean(),
-            "policy_loss": actor_l,
-            "alpha_loss": alpha_l,
-        }, next_key
+        metrics = jax.tree_util.tree_map(lambda m: m.mean(0), qf_metrics)
+        metrics["policy_loss"] = actor_l
+        metrics["alpha_loss"] = alpha_l
+        metrics.update(health_aux)
+        return state, opt_states, metrics, next_key
 
     return train_step
 
@@ -164,7 +186,7 @@ def make_fused_train_step(
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     critic_step = make_critic_step(agent, txs, cfg)
-    actor_alpha_update = make_actor_alpha_update(agent, txs)
+    actor_alpha_update = make_actor_alpha_update(agent, txs, cfg)
     flat_sharding = NamedSharding(mesh, P(DATA_AXIS))
 
     def _shard(batch):
@@ -182,17 +204,18 @@ def make_fused_train_step(
             batch = dict(batch, _key=k_step)
             return critic_step(carry, batch)
 
-        (state, qf_opt), qf_losses = jax.lax.scan(body, (state, opt_states["qf"]), step_keys)
-        metrics = {"value_loss": qf_losses.mean()}
+        (state, qf_opt), qf_metrics = jax.lax.scan(body, (state, opt_states["qf"]), step_keys)
+        metrics = jax.tree_util.tree_map(lambda m: m.mean(0), qf_metrics)
         if with_actor:
             actor_batch = _shard(sample_fn(ring_state, k_actor_sample))
-            state, actor_opt, alpha_opt, actor_l, alpha_l = actor_alpha_update(
+            state, actor_opt, alpha_opt, actor_l, alpha_l, health_aux = actor_alpha_update(
                 state, opt_states["actor"], opt_states["alpha"], actor_batch["observations"],
                 k_actor, k_actor_drop,
             )
             opt_states = {"qf": qf_opt, "actor": actor_opt, "alpha": alpha_opt}
             metrics["policy_loss"] = actor_l
             metrics["alpha_loss"] = alpha_l
+            metrics.update(health_aux)
         else:
             opt_states = {"qf": qf_opt, "actor": opt_states["actor"], "alpha": opt_states["alpha"]}
         return state, opt_states, metrics, next_key
@@ -229,6 +252,7 @@ def main(runtime, cfg: Dict[str, Any]):
     telemetry = runtime.telemetry.open(log_dir, rank_zero=runtime.is_global_zero, device=runtime.device)
     guard = runtime.resilience.guard(rank_zero=runtime.is_global_zero)
     watchdog = runtime.resilience.watchdog
+    health = runtime.health
     runtime.print(f"Log dir: {log_dir}")
 
     envs = make_vector_env(cfg, rank, log_dir)
@@ -395,7 +419,7 @@ def main(runtime, cfg: Dict[str, Any]):
     # Coalesced loss fetch + interval bounding (telemetry/step_timer.py):
     # ONE block_until_ready + ONE device_get per log interval.
     train_timer = telemetry.step_timer("train", timer_key="Time/train_time")
-    keep_train_metrics = aggregator is not None and not aggregator.disabled
+    keep_train_metrics = (aggregator is not None and not aggregator.disabled) or health.enabled
 
     # The iteration's gradient steps, factored out so the pipelined
     # interaction can dispatch them between the action-fetch submit and its
@@ -552,6 +576,9 @@ def main(runtime, cfg: Dict[str, Any]):
             # ONE bounding block + ONE device->host transfer for the whole
             # interval (StepTimer.flush) — the coalesced GL002 pattern.
             fetched_train_metrics = train_timer.flush()
+            # Health sentinels inspect the same coalesced fetch — no extra
+            # transfer; a nonfinite hit taints the run and escalates.
+            health.observe(policy_step, fetched_train_metrics, telemetry=telemetry)
             if aggregator and not aggregator.disabled:
                 for tm in fetched_train_metrics:
                     aggregator.update("Loss/value_loss", tm["value_loss"])
@@ -588,8 +615,9 @@ def main(runtime, cfg: Dict[str, Any]):
             last_log = policy_step
             last_train = train_step_count
 
-        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
-            (iter_num == total_iters or guard.preempted) and cfg.checkpoint.save_last
+        if health.allow_save() and (
+            (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every)
+            or ((iter_num == total_iters or guard.preempted) and cfg.checkpoint.save_last)
         ):
             last_checkpoint = policy_step
             ckpt_state = {

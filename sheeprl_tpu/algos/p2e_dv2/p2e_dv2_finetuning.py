@@ -82,6 +82,7 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
     runtime.print(f"Log dir: {log_dir}")
     telemetry = runtime.telemetry.open(log_dir, rank_zero=runtime.is_global_zero, device=runtime.device)
     guard = runtime.resilience.guard(rank_zero=runtime.is_global_zero)
+    health = runtime.health
 
     envs = make_vector_env(cfg, rank, log_dir)
     action_space = envs.single_action_space
@@ -274,7 +275,9 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
     # Coalesced loss fetch + interval bounding (telemetry/step_timer.py):
     # ONE block_until_ready + ONE device_get per log interval.
     train_timer = telemetry.step_timer("train", timer_key="Time/train_time")
-    keep_train_metrics = aggregator is not None and not aggregator.disabled and cfg.metric.log_level > 0
+    keep_train_metrics = (
+        aggregator is not None and not aggregator.disabled and cfg.metric.log_level > 0
+    ) or health.enabled
     for iter_num in range(start_iter, total_iters + 1):
         policy_step += policy_steps_per_iter
         telemetry.advance(policy_step)
@@ -409,6 +412,9 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
             # transfer (StepTimer.flush) — the coalesced pattern GL002 asks
             # for, now owned by telemetry.
             fetched_train_metrics = train_timer.flush()
+            # Health sentinels inspect the same coalesced fetch — no extra
+            # transfer; a nonfinite hit taints the run and escalates.
+            health.observe(policy_step, fetched_train_metrics, telemetry=telemetry)
             if aggregator and not aggregator.disabled:
                 for m in fetched_train_metrics:
                     for k, v in m.items():
@@ -446,8 +452,9 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any] = None):
             last_train = train_step_count
 
         # ----------------------------------------------------- checkpoint
-        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
-            (iter_num == total_iters or guard.preempted) and cfg.checkpoint.save_last
+        if health.allow_save() and (
+            (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every)
+            or ((iter_num == total_iters or guard.preempted) and cfg.checkpoint.save_last)
         ):
             last_checkpoint = policy_step
             ckpt_state = {

@@ -42,6 +42,7 @@ from sheeprl_tpu.core.player import PlayerPlacement
 from sheeprl_tpu.core.rollout import fuse_gae_pool, ship_rollout
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.registry import register_algorithm
+from sheeprl_tpu.telemetry.health import health_probe, probes_enabled
 from sheeprl_tpu.utils.checkpoint import load_checkpoint, restore_opt_state, save_checkpoint
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -93,7 +94,10 @@ def make_train_step(
         v_loss = value_loss(new_values, batch["values"], batch["returns"], clip_coef, clip_vloss, reduction)
         ent_loss = entropy_loss(entropy, reduction)
         total = pg_loss + vf_coef * v_loss + ent_coef * ent_loss
-        return total, (pg_loss, v_loss, ent_loss)
+        # Mean entropy and the standard approx-KL estimator ride along for
+        # the health probe (free: both tensors are already live).
+        approx_kl = jnp.mean(batch["logprobs"] - new_logprobs)
+        return total, (pg_loss, v_loss, ent_loss, jnp.mean(entropy), approx_kl)
 
     batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
 
@@ -116,20 +120,32 @@ def make_train_step(
                 batch = jax.lax.with_sharding_constraint(
                     batch, {k: batch_sharding for k in batch}
                 )
-                (loss, (pg, vl, ent)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                    params, batch, clip_coef, ent_coef
-                )
+                (loss, (pg, vl, ent, ent_mean, approx_kl)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(params, batch, clip_coef, ent_coef)
                 updates, opt_state = tx.update(grads, opt_state, params)
                 params = optax.apply_updates(params, updates)
-                return (params, opt_state), jnp.stack([pg, vl, ent])
+                metrics = {"policy_loss": pg, "value_loss": vl, "entropy_loss": ent}
+                if probes_enabled(cfg):
+                    # In-jit health probe: pure reductions over the grads and
+                    # updates already in scope; the scalars ride the interval's
+                    # coalesced transfer (zero extra host syncs).
+                    metrics.update(
+                        health_probe(
+                            params=params,
+                            grads=grads,
+                            updates=updates,
+                            aux={"entropy": ent_mean, "approx_kl": approx_kl},
+                        )
+                    )
+                return (params, opt_state), metrics
 
             (params, opt_state), metrics = jax.lax.scan(mb_body, (params, opt_state), idx)
-            return (params, opt_state), metrics.mean(0)
+            return (params, opt_state), jax.tree_util.tree_map(lambda m: m.mean(0), metrics)
 
         keys = jax.random.split(key, update_epochs)
         (params, opt_state), metrics = jax.lax.scan(epoch_body, (params, opt_state), keys)
-        m = metrics.mean(0)
-        return params, opt_state, {"policy_loss": m[0], "value_loss": m[1], "entropy_loss": m[2]}, next_key
+        return params, opt_state, jax.tree_util.tree_map(lambda m: m.mean(0), metrics), next_key
 
     if not fused_gae:
 
@@ -170,6 +186,7 @@ def main(runtime, cfg: Dict[str, Any]):
     telemetry = runtime.telemetry.open(log_dir, rank_zero=runtime.is_global_zero, device=runtime.device)
     guard = runtime.resilience.guard(rank_zero=runtime.is_global_zero)
     watchdog = runtime.resilience.watchdog
+    health = runtime.health
 
     # ----------------------------------------------------------------- envs
     rank = runtime.global_rank
@@ -319,7 +336,7 @@ def main(runtime, cfg: Dict[str, Any]):
     # Coalesced loss fetch + interval bounding (telemetry/step_timer.py):
     # ONE block_until_ready + ONE device_get per log interval.
     train_timer = telemetry.step_timer("train", timer_key="Time/train_time")
-    keep_train_metrics = aggregator is not None and not aggregator.disabled
+    keep_train_metrics = (aggregator is not None and not aggregator.disabled) or health.enabled
     step_data = {}
     next_obs = pipeline.stash_obs(envs.reset(seed=cfg.seed)[0])
     for k in obs_keys:
@@ -440,6 +457,9 @@ def main(runtime, cfg: Dict[str, Any]):
             # transfer (StepTimer.flush) — the coalesced pattern GL002 asks
             # for, now owned by telemetry.
             fetched_train_metrics = train_timer.flush()
+            # Health sentinels inspect the same coalesced fetch — no extra
+            # transfer; a nonfinite hit taints the run and escalates.
+            health.observe(policy_step, fetched_train_metrics, telemetry=telemetry)
             if aggregator and not aggregator.disabled:
                 for tm in fetched_train_metrics:
                     aggregator.update("Loss/policy_loss", tm["policy_loss"])
@@ -489,8 +509,9 @@ def main(runtime, cfg: Dict[str, Any]):
             )
 
         # ---------------------------------------------------- checkpoint
-        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
-            (iter_num == total_iters or guard.preempted) and cfg.checkpoint.save_last
+        if health.allow_save() and (
+            (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every)
+            or ((iter_num == total_iters or guard.preempted) and cfg.checkpoint.save_last)
         ):
             last_checkpoint = policy_step
             ckpt_state = {

@@ -64,6 +64,7 @@ def main(runtime, cfg: Dict[str, Any]):
     runtime.print(f"Log dir: {log_dir}")
     telemetry = runtime.telemetry.open(log_dir, rank_zero=runtime.is_global_zero, device=runtime.device)
     guard = runtime.resilience.guard(rank_zero=runtime.is_global_zero)
+    health = runtime.health
 
     # ----------------------------------------------------------------- envs
     envs = make_vector_env(cfg, rank, log_dir)
@@ -219,7 +220,7 @@ def main(runtime, cfg: Dict[str, Any]):
     # Coalesced loss fetch + interval bounding (telemetry/step_timer.py):
     # ONE block_until_ready + ONE device_get per log interval.
     train_timer = telemetry.step_timer("train", timer_key="Time/train_time")
-    keep_train_metrics = aggregator is not None and not aggregator.disabled
+    keep_train_metrics = (aggregator is not None and not aggregator.disabled) or health.enabled
     step_data = {}
     next_obs = envs.reset(seed=cfg.seed)[0]
     for k in obs_keys:
@@ -340,6 +341,9 @@ def main(runtime, cfg: Dict[str, Any]):
             # ONE bounding block + ONE device->host transfer for the whole
             # interval (StepTimer.flush) — the coalesced GL002 pattern.
             fetched_train_metrics = train_timer.flush()
+            # Health sentinels inspect the same coalesced fetch — no extra
+            # transfer; a nonfinite hit taints the run and escalates.
+            health.observe(policy_step, fetched_train_metrics, telemetry=telemetry)
             if aggregator and not aggregator.disabled:
                 for tm in fetched_train_metrics:
                     aggregator.update("Loss/policy_loss", tm["policy_loss"])
@@ -389,8 +393,9 @@ def main(runtime, cfg: Dict[str, Any]):
             )
 
         # ---------------------------------------------------- checkpoint
-        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
-            (iter_num == total_iters or guard.preempted) and cfg.checkpoint.save_last
+        if health.allow_save() and (
+            (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every)
+            or ((iter_num == total_iters or guard.preempted) and cfg.checkpoint.save_last)
         ):
             last_checkpoint = policy_step
             ckpt_state = {

@@ -157,6 +157,7 @@ def main(runtime, cfg: Dict[str, Any]):
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
     telemetry = runtime.telemetry.open(log_dir, rank_zero=runtime.is_global_zero, device=runtime.device)
     guard = runtime.resilience.guard(rank_zero=runtime.is_global_zero)
+    health = runtime.health
     runtime.print(f"Log dir: {log_dir}")
 
     rank = runtime.global_rank
@@ -286,7 +287,7 @@ def main(runtime, cfg: Dict[str, Any]):
     # Coalesced loss fetch + interval bounding (telemetry/step_timer.py):
     # ONE block_until_ready + ONE device_get per log interval.
     train_timer = telemetry.step_timer("train", timer_key="Time/train_time")
-    keep_train_metrics = aggregator is not None and not aggregator.disabled
+    keep_train_metrics = (aggregator is not None and not aggregator.disabled) or health.enabled
     for iter_num in range(start_iter, total_iters + 1):
         telemetry.advance(policy_step)
         guard.advance(policy_step)
@@ -449,6 +450,9 @@ def main(runtime, cfg: Dict[str, Any]):
             # ONE bounding block + ONE device->host transfer for the whole
             # interval (StepTimer.flush) — the coalesced GL002 pattern.
             fetched_train_metrics = train_timer.flush()
+            # Health sentinels inspect the same coalesced fetch — no extra
+            # transfer; a nonfinite hit taints the run and escalates.
+            health.observe(policy_step, fetched_train_metrics, telemetry=telemetry)
             if aggregator and not aggregator.disabled:
                 for tm in fetched_train_metrics:
                     aggregator.update("Loss/policy_loss", tm["policy_loss"])
@@ -498,8 +502,9 @@ def main(runtime, cfg: Dict[str, Any]):
             )
 
         # ---------------------------------------------------- checkpoint
-        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
-            (iter_num == total_iters or guard.preempted) and cfg.checkpoint.save_last
+        if health.allow_save() and (
+            (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every)
+            or ((iter_num == total_iters or guard.preempted) and cfg.checkpoint.save_last)
         ):
             last_checkpoint = policy_step
             ckpt_state = {

@@ -40,6 +40,7 @@ from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.device_buffer import DeviceReplayRing
 from sheeprl_tpu.core.runtime import DispatchThrottle
 from sheeprl_tpu.registry import register_algorithm
+from sheeprl_tpu.telemetry.health import health_probe, probes_enabled
 from sheeprl_tpu.utils.checkpoint import load_checkpoint, restore_opt_state, save_checkpoint
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -101,7 +102,20 @@ def make_gradient_step(agent: SACAgent, txs: Dict[str, optax.GradientTransformat
         state["log_alpha"] = optax.apply_updates(state["log_alpha"], alpha_updates)
 
         opt_states = {"qf": qf_opt, "actor": actor_opt, "alpha": alpha_opt}
-        return (state, opt_states), jnp.stack([qf_l, actor_l, alpha_l])
+        metrics = {"value_loss": qf_l, "policy_loss": actor_l, "alpha_loss": alpha_l}
+        if probes_enabled(cfg):
+            # In-jit health probe: pure reductions over the already-live grad
+            # and update trees — the scalars ride the StepTimer's coalesced
+            # per-interval transfer, zero extra host syncs.
+            metrics.update(
+                health_probe(
+                    params=(state["qfs"], state["actor"], state["log_alpha"]),
+                    grads=(qf_grads, actor_grads, alpha_grads),
+                    updates=(qf_updates, actor_updates, alpha_updates),
+                    aux={"alpha": alpha, "entropy": -jnp.mean(logprobs)},
+                )
+            )
+        return (state, opt_states), metrics
 
     return gradient_step
 
@@ -125,8 +139,8 @@ def make_train_step(agent: SACAgent, txs: Dict[str, optax.GradientTransformation
         (state, opt_states), metrics = jax.lax.scan(
             lambda carry, batch: gradient_step(carry, batch, tau_eff), (state, opt_states), data
         )
-        m = metrics.mean(0)
-        return state, opt_states, {"value_loss": m[0], "policy_loss": m[1], "alpha_loss": m[2]}, next_key
+        metrics = jax.tree_util.tree_map(lambda m: m.mean(0), metrics)
+        return state, opt_states, metrics, next_key
 
     return train_step
 
@@ -162,8 +176,8 @@ def make_fused_train_step(
             return gradient_step(carry, batch, tau_eff)
 
         (state, opt_states), metrics = jax.lax.scan(body, (state, opt_states), (step_keys, taus))
-        m = metrics.mean(0)
-        return state, opt_states, {"value_loss": m[0], "policy_loss": m[1], "alpha_loss": m[2]}, next_key
+        metrics = jax.tree_util.tree_map(lambda m: m.mean(0), metrics)
+        return state, opt_states, metrics, next_key
 
     return fused_train_step
 
@@ -190,6 +204,7 @@ def main(runtime, cfg: Dict[str, Any]):
     telemetry = runtime.telemetry.open(log_dir, rank_zero=runtime.is_global_zero, device=runtime.device)
     guard = runtime.resilience.guard(rank_zero=runtime.is_global_zero)
     watchdog = runtime.resilience.watchdog
+    health = runtime.health
 
     envs = make_vector_env(cfg, rank, log_dir)
     action_space = envs.single_action_space
@@ -361,7 +376,9 @@ def main(runtime, cfg: Dict[str, Any]):
     # round trip over a tunneled chip). Scalars only, so the pinned device
     # memory is negligible.
     train_timer = telemetry.step_timer("train", timer_key="Time/train_time")
-    keep_train_metrics = aggregator is not None and not aggregator.disabled and cfg.metric.log_level > 0
+    keep_train_metrics = (
+        aggregator is not None and not aggregator.disabled and cfg.metric.log_level > 0
+    ) or health.enabled
 
     # The iteration's gradient steps, factored out so the pipelined
     # interaction can dispatch them between the action-fetch submit and its
@@ -516,6 +533,10 @@ def main(runtime, cfg: Dict[str, Any]):
             # transfer of every queued loss tree (StepTimer.flush) — the
             # pattern GL002 asks for, now owned by telemetry.
             fetched_train_metrics = train_timer.flush()
+            # Health sentinels inspect the same coalesced fetch — no extra
+            # transfer. A nonfinite hit taints the run (vetoing further
+            # checkpoint saves) and escalates per cfg.health.policy.
+            health.observe(policy_step, fetched_train_metrics, telemetry=telemetry)
             if aggregator and not aggregator.disabled:
                 for tm in fetched_train_metrics:
                     aggregator.update("Loss/value_loss", tm["value_loss"])
@@ -549,8 +570,9 @@ def main(runtime, cfg: Dict[str, Any]):
             last_log = policy_step
             last_train = train_step_count
 
-        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
-            (iter_num == total_iters or guard.preempted) and cfg.checkpoint.save_last
+        if health.allow_save() and (
+            (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every)
+            or ((iter_num == total_iters or guard.preempted) and cfg.checkpoint.save_last)
         ):
             last_checkpoint = policy_step
             ckpt_state = {

@@ -198,6 +198,7 @@ def main(runtime, cfg: Dict[str, Any]):
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
     telemetry = runtime.telemetry.open(log_dir, rank_zero=runtime.is_global_zero, device=runtime.device)
     guard = runtime.resilience.guard(rank_zero=runtime.is_global_zero)
+    health = runtime.health
     runtime.print(f"Log dir: {log_dir}")
 
     envs = make_vector_env(cfg, rank, log_dir)
@@ -367,7 +368,7 @@ def main(runtime, cfg: Dict[str, Any]):
     # Coalesced loss fetch + interval bounding (telemetry/step_timer.py):
     # ONE block_until_ready + ONE device_get per log interval.
     train_timer = telemetry.step_timer("train", timer_key="Time/train_time")
-    keep_train_metrics = aggregator is not None and not aggregator.disabled
+    keep_train_metrics = (aggregator is not None and not aggregator.disabled) or health.enabled
     for iter_num in range(start_iter, total_iters + 1):
         policy_step += policy_steps_per_iter
         telemetry.advance(policy_step)
@@ -488,6 +489,9 @@ def main(runtime, cfg: Dict[str, Any]):
             # losses whose update actually ran are fed to the aggregator —
             # the skipped branches report placeholder zeros.
             fetched_train_metrics = train_timer.flush()
+            # Health sentinels inspect the same coalesced fetch — no extra
+            # transfer; a nonfinite hit taints the run and escalates.
+            health.observe(policy_step, fetched_train_metrics, telemetry=telemetry)
             if aggregator and not aggregator.disabled:
                 for m, did_actor, did_decoder in fetched_train_metrics:
                     aggregator.update("Loss/value_loss", m["value_loss"])
@@ -524,8 +528,9 @@ def main(runtime, cfg: Dict[str, Any]):
             last_log = policy_step
             last_train = train_step_count
 
-        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
-            (iter_num == total_iters or guard.preempted) and cfg.checkpoint.save_last
+        if health.allow_save() and (
+            (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every)
+            or ((iter_num == total_iters or guard.preempted) and cfg.checkpoint.save_last)
         ):
             last_checkpoint = policy_step
             ckpt_state = {

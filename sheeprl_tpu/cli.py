@@ -120,12 +120,30 @@ def check_configs(cfg: dotdict) -> None:
                 raise ValueError("resilience.watchdog.enabled=True requires timeout_s > 0")
         ch = res.get("chaos")
         if ch is not None and bool(ch.get("enabled", False)):
-            known = ("env_step_raise", "sigterm", "sigint", "fail_point", "delayed_fetch")
+            known = ("env_step_raise", "nan_reward", "sigterm", "sigint", "fail_point", "delayed_fetch")
             for inj in ch.get("injectors") or []:
                 if str(inj.get("kind", "")) not in known:
                     raise ValueError(
                         f"Unknown resilience.chaos injector kind {inj.get('kind')!r}. Valid: {known}"
                     )
+    health = cfg.get("health")
+    if health is not None:
+        for knob in ("policy", "anomaly_policy"):
+            value = str(health.get(knob, "warn") or "warn").lower()
+            if value not in ("warn", "preempt", "abort"):
+                raise ValueError(f"Unknown health.{knob} '{value}'. Valid: warn | preempt | abort")
+        ewma = health.get("ewma")
+        if ewma is not None:
+            alpha = float(ewma.get("alpha", 0.1) or 0.0)
+            if not 0.0 < alpha <= 1.0:
+                raise ValueError(f"health.ewma.alpha must be in (0, 1], got {alpha}")
+            if float(ewma.get("k", 6.0) or 0.0) <= 0.0:
+                raise ValueError("health.ewma.k must be > 0")
+        if bool(health.get("enabled", False)) and int(cfg.metric.get("log_level", 1)) <= 0:
+            warnings.warn(
+                "health.enabled=True but metric.log_level=0: sentinels observe at the metric "
+                "log cadence, so nothing will be watched. Set metric.log_level >= 1.",
+            )
     entry = algorithm_registry[cfg.algo.name]
     if (
         entry.decoupled
@@ -266,6 +284,11 @@ def run_algorithm(cfg: dotdict) -> None:
     from sheeprl_tpu.core.resilience import Resilience
 
     runtime.resilience = Resilience.from_config(cfg)
+    # The run's training-health sentinels: in-jit probes + host anomaly
+    # detection with warn|preempt|abort escalation (howto/observability.md).
+    from sheeprl_tpu.telemetry.health import HealthMonitor
+
+    runtime.health = HealthMonitor.from_config(cfg)
     import jax
 
     # Eager ops and un-sharded jits must land on the chosen accelerator (the

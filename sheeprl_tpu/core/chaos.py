@@ -21,6 +21,11 @@ Two layers:
    - ``{"kind": "env_step_raise", "env_rank": 0, "at_step": 7}`` — env
      worker ``env_rank`` raises on its ``at_step``-th ``step()`` call
      (installed as a gym wrapper by ``utils/env.make_vector_env``).
+   - ``{"kind": "nan_reward", "env_rank": 0, "at_step": 7}`` — env worker
+     ``env_rank`` returns a NaN reward on its ``at_step``-th ``step()``
+     call (once). The NaN flows replay buffer → sampled batch → loss →
+     grads, which is exactly what the training-health sentinels
+     (``telemetry/health.py``) must catch.
    - ``{"kind": "sigterm"|"sigint", "at_step": N}`` — deliver the signal to
      this process once ``policy_step >= N`` (fired from
      ``PreemptionGuard.advance`` so delivery lands at an iteration
@@ -187,29 +192,79 @@ class EnvStepChaos:
         return self.env.unwrapped
 
 
+class EnvRewardChaos:
+    """Gym wrapper replacing this env's N-th step() reward with NaN (once).
+
+    The poison propagates the realistic way — replay buffer, sampled batch,
+    loss, gradients — so the health sentinels are exercised end to end
+    instead of on a hand-planted scalar. Same dependency-free delegation
+    shape as :class:`EnvStepChaos`.
+    """
+
+    def __init__(self, env: Any, injector_id: str, at_step: int) -> None:
+        self.env = env
+        self._injector_id = injector_id
+        self._at_step = int(at_step)
+        self._n = 0
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self.env, name)
+
+    def reset(self, **kwargs: Any) -> Any:
+        return self.env.reset(**kwargs)
+
+    def step(self, action: Any) -> Any:
+        obs, reward, terminated, truncated, info = self.env.step(action)
+        self._n += 1
+        if self._n >= self._at_step and fire_once(self._injector_id, "nan_reward"):
+            reward = float("nan")
+        return obs, reward, terminated, truncated, info
+
+    def close(self) -> None:
+        self.env.close()
+
+    @property
+    def unwrapped(self) -> Any:
+        return self.env.unwrapped
+
+
+_ENV_INJECTOR_WRAPPERS = {
+    "env_step_raise": EnvStepChaos,
+    "nan_reward": EnvRewardChaos,
+}
+
+
 def wrap_env_thunks(
     thunks: List[Callable[[], Any]], injectors: List[Dict[str, Any]], base: int
 ) -> List[Callable[[], Any]]:
-    """Wrap env thunks with EnvStepChaos for `env_step_raise` injectors.
+    """Wrap env thunks with the env-side injector wrappers (`env_step_raise`,
+    `nan_reward`).
 
     `base` is the rank's global env offset; injector `env_rank` addresses the
     global env index (matching per-env seed derivation).
     """
-    specs: Dict[int, Dict[str, Any]] = {}
+    specs: Dict[int, List[Dict[str, Any]]] = {}
     for idx, inj in enumerate(injectors or []):
-        if str(inj.get("kind")) != "env_step_raise":
+        kind = str(inj.get("kind"))
+        if kind not in _ENV_INJECTOR_WRAPPERS:
             continue
         env_rank = int(inj.get("env_rank", 0))
-        specs[env_rank] = {
-            "id": f"env_step_raise[{idx}]@{env_rank}",
-            "at_step": int(inj.get("at_step", 1)),
-        }
+        specs.setdefault(env_rank, []).append(
+            {
+                "kind": kind,
+                "id": f"{kind}[{idx}]@{env_rank}",
+                "at_step": int(inj.get("at_step", 1)),
+            }
+        )
     if not specs:
         return thunks
 
-    def wrap(thunk: Callable[[], Any], spec: Dict[str, Any]) -> Callable[[], Any]:
+    def wrap(thunk: Callable[[], Any], env_specs: List[Dict[str, Any]]) -> Callable[[], Any]:
         def make() -> Any:
-            return EnvStepChaos(thunk(), spec["id"], spec["at_step"])
+            env = thunk()
+            for spec in env_specs:
+                env = _ENV_INJECTOR_WRAPPERS[spec["kind"]](env, spec["id"], spec["at_step"])
+            return env
 
         return make
 
@@ -233,7 +288,7 @@ class ChaosMonkey:
         self._injectors: List[Dict[str, Any]] = []
         for idx, inj in enumerate(injectors or []):
             kind = str(inj.get("kind", ""))
-            if kind == "env_step_raise":
+            if kind in _ENV_INJECTOR_WRAPPERS:
                 continue  # env-side; see wrap_env_thunks
             if kind not in ("sigterm", "sigint", "fail_point", "delayed_fetch"):
                 warnings.warn(f"Unknown chaos injector kind {kind!r}: ignored")

@@ -66,6 +66,7 @@ __all__ = [
     "EnvSupervisor",
     "PreemptionGuard",
     "Resilience",
+    "apply_trip_policy",
     "resolve_auto_resume",
     "watch",
 ]
@@ -461,6 +462,44 @@ class EnvSupervisor(EnvSliceGroup):
         return [slot.restarts for slot in self._slots]
 
 
+# --------------------------------------------------------- trip escalation
+def apply_trip_policy(
+    policy: str,
+    message: str,
+    *,
+    counter: str,
+    span_name: str,
+    category: str,
+    args: Optional[Dict[str, Any]] = None,
+    dump_stacks: bool = True,
+) -> None:
+    """The shared warn|preempt|abort escalation used by every host-side
+    sentinel (the dispatch watchdog, the training-health monitor): count the
+    trip, record a zero-duration span, write the message to stderr, and then
+    act — ``warn`` only reports, ``preempt`` delivers SIGTERM so the
+    PreemptionGuard drain→atomic-save→autoresume path runs, ``abort``
+    hard-exits with code 124. ``dump_stacks`` adds the full all-thread
+    faulthandler dump (the forensics a *hung* dispatch needs; numeric
+    sentinels pass False — the stack is not the story for a NaN)."""
+    tracer = tracer_mod.current()
+    tracer.count(counter)
+    tracer.add_span(
+        span_name, category, time.perf_counter(), 0.0,
+        dict(args or {}, policy=policy),
+    )
+    sys.stderr.write(f"\n{message}\n")
+    sys.stderr.flush()
+    if dump_stacks:
+        try:
+            faulthandler.dump_traceback(all_threads=True)
+        except Exception:  # noqa: BLE001 - forensics must not kill the caller
+            pass
+    if policy == "preempt":
+        os.kill(os.getpid(), signal.SIGTERM)
+    elif policy == "abort":
+        os._exit(124)
+
+
 # ---------------------------------------------------------- DispatchWatchdog
 class DispatchWatchdog:
     """Monotonic-deadline watchdog for device work the host can't observe.
@@ -541,25 +580,15 @@ class DispatchWatchdog:
 
     def _trip(self, label: str) -> None:
         self.trips += 1
-        tracer = tracer_mod.current()
-        tracer.count("watchdog_trips")
-        tracer.add_span(
-            "resilience/watchdog_trip", "watchdog", time.perf_counter(), 0.0,
-            {"label": label, "timeout_s": self.timeout_s, "on_trip": self.on_trip},
+        apply_trip_policy(
+            self.on_trip,
+            f"[sheeprl-tpu watchdog] '{label}' exceeded {self.timeout_s:.1f}s — "
+            f"dumping all thread stacks (on_trip={self.on_trip})",
+            counter="watchdog_trips",
+            span_name="resilience/watchdog_trip",
+            category="watchdog",
+            args={"label": label, "timeout_s": self.timeout_s, "on_trip": self.on_trip},
         )
-        sys.stderr.write(
-            f"\n[sheeprl-tpu watchdog] '{label}' exceeded {self.timeout_s:.1f}s — "
-            f"dumping all thread stacks (on_trip={self.on_trip})\n"
-        )
-        sys.stderr.flush()
-        try:
-            faulthandler.dump_traceback(all_threads=True)
-        except Exception:  # noqa: BLE001 - forensics must not kill the monitor
-            pass
-        if self.on_trip == "preempt":
-            os.kill(os.getpid(), signal.SIGTERM)
-        elif self.on_trip == "abort":
-            os._exit(124)
 
     def close(self) -> None:
         with self._cond:

@@ -268,6 +268,12 @@ class Runtime:
         from sheeprl_tpu.core.resilience import Resilience
 
         self.resilience: Resilience = Resilience.noop()
+        # The run's training-health sentinels (sheeprl_tpu/telemetry/health):
+        # the CLI installs HealthMonitor.from_config; the no-op default keeps
+        # bare Runtime construction untouched.
+        from sheeprl_tpu.telemetry.health import HealthMonitor
+
+        self.health: HealthMonitor = HealthMonitor.noop()
 
     # ------------------------------------------------------------ lifecycle
     def launch(self) -> "Runtime":
@@ -456,4 +462,5 @@ def get_single_device_runtime(runtime: Runtime) -> Runtime:
     view.root_key = runtime.root_key
     view.telemetry = runtime.telemetry
     view.resilience = runtime.resilience
+    view.health = runtime.health
     return view

@@ -21,10 +21,14 @@ policy applies:
 - multiple artifacts are hosted concurrently with LRU eviction past
   ``max_models``.
 
-Telemetry: request latency lands in a :class:`~sheeprl_tpu.telemetry.Histogram`
-(p50/p95/p99 via ``stats()``), queue depth and batch occupancy are gauges,
-sheds/timeouts/errors are counters — all mirrored into the process tracer
-when one is installed.
+Telemetry: every engine metric lives in a
+:class:`~sheeprl_tpu.telemetry.MetricsRegistry` (one per engine, or an
+injected shared one): request latency is a registry histogram (p50/p95/p99
+via ``stats()``), queue depth and batch occupancy are registry gauges, and
+sheds/timeouts/errors/evictions are registry counters. ``stats()``, the
+server's ``GET /metrics`` Prometheus rendering, and the tracer mirrors in
+``telemetry.jsonl`` all read the same objects, so the three surfaces can
+never disagree.
 """
 
 from __future__ import annotations
@@ -41,9 +45,12 @@ import numpy as np
 
 from sheeprl_tpu.serve.artifact import PolicyArtifact, load_artifact, make_policy
 from sheeprl_tpu.telemetry import tracer as tracer_mod
-from sheeprl_tpu.telemetry.histogram import Histogram
+from sheeprl_tpu.telemetry.registry import MetricsRegistry
 
 MODES = ("greedy", "sample")
+
+#: Engine counter short names; registered as ``serve/<name>`` in the registry.
+COUNTER_KEYS = ("requests", "batches", "sheds", "timeouts", "errors", "evictions")
 
 
 class EngineClosed(RuntimeError):
@@ -99,6 +106,7 @@ class InferenceEngine:
         max_models: int = 4,
         max_sessions: int = 256,
         autostart: bool = True,
+        registry: Optional[MetricsRegistry] = None,
     ) -> None:
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -117,20 +125,27 @@ class InferenceEngine:
         self._drain_on_close = True
         self._thread: Optional[threading.Thread] = None
 
-        self.latency = Histogram()
-        self.counters: Dict[str, int] = {
-            "requests": 0,
-            "batches": 0,
-            "sheds": 0,
-            "timeouts": 0,
-            "errors": 0,
-            "evictions": 0,
-        }
+        # Registry-backed metrics: ``stats()`` and the server's ``/metrics``
+        # rendering read these same objects. A private registry per engine by
+        # default so concurrent engines (tests, multi-tenant) don't mix.
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.latency = self.registry.histogram("serve/latency_s")
+        self._counters = {key: self.registry.counter(f"serve/{key}") for key in COUNTER_KEYS}
+        self._queue_depth_gauge = self.registry.gauge("serve/queue_depth")
+        self._occupancy_gauge = self.registry.gauge("serve/batch_occupancy")
         # bucket -> [requests_served, batches] for mean-occupancy reporting.
         self._occupancy: Dict[int, List[int]] = {}
         self._ewma_service_s: Optional[float] = None
         if autostart:
             self.start()
+
+    @property
+    def counters(self) -> Dict[str, int]:
+        """Point-in-time integer view of the registry-backed engine counters."""
+        return {key: int(counter.value) for key, counter in self._counters.items()}
+
+    def _count(self, key: str, amount: int = 1) -> None:
+        self._counters[key].inc(amount)
 
     # ------------------------------------------------------------- lifecycle
     def start(self) -> None:
@@ -192,7 +207,7 @@ class InferenceEngine:
             while len(self._models) > self.max_models:
                 victim, _ = self._models.popitem(last=False)
                 evicted.append(victim)
-                self.counters["evictions"] += 1
+                self._count("evictions")
         trc = tracer_mod.current()
         trc.count("serve_models_loaded", 1)
         for victim in evicted:
@@ -264,7 +279,7 @@ class InferenceEngine:
         row = hosted.adapter.normalize_row(obs)
 
         if deadline_s is not None and self.estimated_wait_s() > float(deadline_s):
-            self.counters["sheds"] += 1
+            self._count("sheds")
             tracer_mod.current().count("serve_sheds", 1)
             raise EngineOverloaded(
                 f"estimated wait {self.estimated_wait_s():.3f}s exceeds the request "
@@ -286,14 +301,15 @@ class InferenceEngine:
             if self._stop:
                 raise EngineClosed("engine is shutting down")
             if len(self._queue) >= self.queue_capacity:
-                self.counters["sheds"] += 1
+                self._count("sheds")
                 tracer_mod.current().count("serve_sheds", 1)
                 raise EngineOverloaded(
                     f"request queue is full ({self.queue_capacity})",
                     retry_after_s=max(self.estimated_wait_s(), 0.05),
                 )
             self._queue.append(req)
-            self.counters["requests"] += 1
+            self._count("requests")
+            self._queue_depth_gauge.set(float(len(self._queue)))
             self._cv.notify_all()
         return fut
 
@@ -387,7 +403,7 @@ class InferenceEngine:
         live: List[_Request] = []
         for req in batch:
             if req.deadline_t is not None and now > req.deadline_t:
-                self.counters["timeouts"] += 1
+                self._count("timeouts")
                 tracer_mod.current().count("serve_timeouts", 1)
                 req.future.set_exception(
                     RequestExpired("deadline passed while the request waited in the queue")
@@ -424,7 +440,7 @@ class InferenceEngine:
             # states stay on device (sliced lazily below).
             host_actions = np.asarray(jax.device_get(actions))
         except Exception as err:  # noqa: BLE001 - any apply failure fails the batch
-            self.counters["errors"] += 1
+            self._count("errors")
             tracer_mod.current().count("serve_errors", 1)
             for req in live:
                 req.future.set_exception(err)
@@ -437,7 +453,7 @@ class InferenceEngine:
         per_request = elapsed / len(live)
         prev = self._ewma_service_s
         self._ewma_service_s = per_request if prev is None else 0.2 * per_request + 0.8 * prev
-        self.counters["batches"] += 1
+        self._count("batches")
         occ = self._occupancy.setdefault(bucket, [0, 0])
         occ[0] += len(live)
         occ[1] += 1
@@ -452,8 +468,12 @@ class InferenceEngine:
         )
         trc.count("serve_batches", 1)
         trc.count("serve_requests_served", len(live))
-        trc.set_gauge("serve/queue_depth", float(len(self._queue)))
-        trc.set_gauge("serve/batch_occupancy", float(len(live)) / float(bucket))
+        queue_depth = float(len(self._queue))
+        occupancy_frac = float(len(live)) / float(bucket)
+        self._queue_depth_gauge.set(queue_depth)
+        self._occupancy_gauge.set(occupancy_frac)
+        trc.set_gauge("serve/queue_depth", queue_depth)
+        trc.set_gauge("serve/batch_occupancy", occupancy_frac)
 
         done = time.perf_counter()
         for i, req in enumerate(live):
@@ -467,8 +487,8 @@ class InferenceEngine:
         with self._cv:
             self.latency.reset()
             self._occupancy.clear()
-            for key in self.counters:
-                self.counters[key] = 0
+            for counter in self._counters.values():
+                counter.reset()
 
     def stats(self) -> Dict[str, Any]:
         occupancy = {

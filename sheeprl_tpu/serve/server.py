@@ -7,7 +7,11 @@ the same zero-new-dependencies rule as the rest of the repo) exposing:
   "deadline_s"?}``; responds ``{"action": [...], "session": ...}``;
 - ``GET /v1/models`` — model cards for every hosted artifact plus engine
   stats (latency percentiles, occupancy, counters);
-- ``GET /healthz`` — liveness + queue depth (load balancers poll this).
+- ``GET /healthz`` — liveness + queue depth (load balancers poll this);
+- ``GET /metrics`` — Prometheus text exposition (0.0.4) of the engine's
+  :class:`~sheeprl_tpu.telemetry.MetricsRegistry` merged with the process
+  default registry, so a scraper sees serving and training/telemetry
+  metrics from one endpoint.
 
 Engine exceptions map onto transport semantics: unknown model → 404, bad
 request rows → 400, :class:`EngineOverloaded` → 429 with ``Retry-After``
@@ -37,6 +41,11 @@ from sheeprl_tpu.serve.engine import (
     InferenceEngine,
     RequestExpired,
 )
+from sheeprl_tpu.telemetry.registry import (
+    PROMETHEUS_CONTENT_TYPE,
+    default_registry,
+    merged_prometheus_text,
+)
 
 
 def _json_bytes(payload: Dict[str, Any]) -> bytes:
@@ -54,15 +63,23 @@ class _Handler(BaseHTTPRequestHandler):
         pass  # per-request access logs would drown the tracer's signal
 
     # ------------------------------------------------------------- plumbing
-    def _reply(self, status: int, payload: Dict[str, Any], headers: Optional[Dict[str, str]] = None) -> None:
-        body = _json_bytes(payload)
+    def _reply_raw(
+        self,
+        status: int,
+        body: bytes,
+        content_type: str,
+        headers: Optional[Dict[str, str]] = None,
+    ) -> None:
         self.send_response(status)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
         for key, value in (headers or {}).items():
             self.send_header(key, value)
         self.end_headers()
         self.wfile.write(body)
+
+    def _reply(self, status: int, payload: Dict[str, Any], headers: Optional[Dict[str, str]] = None) -> None:
+        self._reply_raw(status, _json_bytes(payload), "application/json", headers)
 
     def _error(self, status: int, message: str, headers: Optional[Dict[str, str]] = None) -> None:
         self._reply(status, {"error": message}, headers)
@@ -74,6 +91,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(200, {"status": "ok", "queue_depth": stats["queue_depth"], "models": stats["models"]})
         elif self.path == "/v1/models":
             self._reply(200, {"models": self.engine.models(), "stats": self.engine.stats()})
+        elif self.path.split("?")[0] == "/metrics":
+            body = merged_prometheus_text([self.engine.registry, default_registry()])
+            self._reply_raw(200, body.encode("utf-8"), PROMETHEUS_CONTENT_TYPE)
         else:
             self._error(404, f"no route for GET {self.path}")
 

@@ -13,24 +13,44 @@ Parts (see each module's docstring for the design):
   counters via jax.monitoring, HBM gauges, recompile-after-warmup watchdog;
 - :mod:`~sheeprl_tpu.telemetry.profiling` — config-driven jax.profiler
   step-window traces and live profiler server;
+- :mod:`~sheeprl_tpu.telemetry.registry` — the unified counters/gauges/
+  histograms :class:`MetricsRegistry` with Prometheus text exposition and
+  the ``GET /metrics`` exporter;
+- :mod:`~sheeprl_tpu.telemetry.health` — in-jit :func:`health_probe`
+  reducers and the host-side :class:`HealthMonitor` sentinels
+  (warn|preempt|abort, wired into the resilience trip path);
 - :mod:`~sheeprl_tpu.telemetry.telemetry` — the :class:`Telemetry` facade
   the Runtime carries and the algorithms thread through their loops.
+
+``python -m sheeprl_tpu.telemetry tail <logdir>`` renders a live run's
+current health and throughput from its ``telemetry.jsonl``.
 """
 
 from sheeprl_tpu.telemetry import tracer
+from sheeprl_tpu.telemetry.health import HealthEvent, HealthMonitor, health_probe, probes_enabled
 from sheeprl_tpu.telemetry.histogram import Histogram, geometric_bounds
 from sheeprl_tpu.telemetry.jax_events import JaxEventMonitor
 from sheeprl_tpu.telemetry.profiling import ProfilerWindow
+from sheeprl_tpu.telemetry.registry import Counter, Gauge, MetricsExporter, MetricsRegistry, default_registry
 from sheeprl_tpu.telemetry.step_timer import StepTimer
 from sheeprl_tpu.telemetry.telemetry import CHROME_TRACE_FILENAME, JSONL_FILENAME, Telemetry
 from sheeprl_tpu.telemetry.tracer import Span, Tracer
 
 __all__ = [
     "CHROME_TRACE_FILENAME",
+    "Counter",
+    "Gauge",
+    "HealthEvent",
+    "HealthMonitor",
     "Histogram",
     "JSONL_FILENAME",
     "JaxEventMonitor",
+    "MetricsExporter",
+    "MetricsRegistry",
+    "default_registry",
     "geometric_bounds",
+    "health_probe",
+    "probes_enabled",
     "ProfilerWindow",
     "Span",
     "StepTimer",

@@ -1,0 +1,151 @@
+"""``python -m sheeprl_tpu.telemetry tail <logdir>`` — live run inspection.
+
+Renders the current health and throughput of a (possibly still running)
+run straight from its ``telemetry.jsonl``: the meta line, the most recent
+counters interval (with the host-computed ``*_per_s`` rates when present),
+every ``health/*`` gauge, and the trailing health events. Pure stdlib and
+read-only — it tails the JSONL the run is appending to, so it works over
+ssh against a live job with no port, no server, and no imports of jax.
+
+``--follow`` re-renders every ``--interval`` seconds until interrupted.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from sheeprl_tpu.telemetry.telemetry import JSONL_FILENAME
+
+
+def find_jsonl(path: str) -> Optional[str]:
+    """Resolve a telemetry.jsonl from a file path, a run dir, or any
+    ancestor dir (newest match wins — 'point me at logs/runs and show me
+    the latest run' is the common case)."""
+    if os.path.isfile(path):
+        return path
+    direct = os.path.join(path, JSONL_FILENAME)
+    if os.path.isfile(direct):
+        return direct
+    newest: Optional[str] = None
+    newest_mtime = -1.0
+    for root, _dirs, files in os.walk(path):
+        if JSONL_FILENAME in files:
+            candidate = os.path.join(root, JSONL_FILENAME)
+            mtime = os.path.getmtime(candidate)
+            if mtime > newest_mtime:
+                newest, newest_mtime = candidate, mtime
+    return newest
+
+
+def load_records(path: str) -> List[Dict[str, Any]]:
+    records: List[Dict[str, Any]] = []
+    with open(path) as fp:
+        for line in fp:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # a concurrent writer may leave a torn last line
+            if isinstance(rec, dict):
+                records.append(rec)
+    return records
+
+
+def _fmt_value(value: Any) -> str:
+    try:
+        f = float(value)
+    except (TypeError, ValueError):
+        return str(value)
+    if f.is_integer() and abs(f) < 1e12:
+        return str(int(f))
+    return f"{f:.6g}"
+
+
+def render(records: List[Dict[str, Any]], max_events: int = 8) -> str:
+    meta = next((r for r in records if r.get("type") == "meta"), None)
+    intervals = [r for r in records if r.get("type") == "counters" and r.get("step", -1) >= 0]
+    final = next((r for r in records if r.get("type") == "counters" and r.get("step") == -1), None)
+    events = [r for r in records if r.get("type") == "health_event"]
+    latest = intervals[-1] if intervals else final
+
+    lines: List[str] = []
+    if meta is not None:
+        lines.append(
+            f"run: backend={meta.get('backend', '?')} process={meta.get('process_index', '?')} "
+            f"started={time.strftime('%Y-%m-%d %H:%M:%S', time.localtime(meta.get('time', 0)))}"
+        )
+    if latest is None:
+        lines.append("no counters intervals yet")
+        return "\n".join(lines) + "\n"
+    step = latest.get("step", -1)
+    lines.append(f"step: {step}" + ("  (final)" if latest is final and step == -1 else ""))
+    values: Dict[str, Any] = latest.get("values") or {}
+    rates: Dict[str, Any] = latest.get("rates") or {}
+    health = {k: v for k, v in values.items() if k.startswith("health/")}
+    plain = {k: v for k, v in values.items() if not k.startswith("health/")}
+    if plain:
+        lines.append("counters:")
+        for name in sorted(plain):
+            suffix = f"  ({_fmt_value(rates[name])}/s)" if name in rates else ""
+            lines.append(f"  {name:<32} {_fmt_value(plain[name])}{suffix}")
+    if health:
+        lines.append("health:")
+        for name in sorted(health):
+            lines.append(f"  {name:<32} {_fmt_value(health[name])}")
+    if events:
+        lines.append(f"health events ({len(events)} total, last {min(max_events, len(events))}):")
+        for event in events[-max_events:]:
+            lines.append(
+                f"  [step {event.get('step', '?')}] {event.get('metric', '?')} "
+                f"{event.get('kind', '?')} value={_fmt_value(event.get('value'))} "
+                f"policy={event.get('policy', '?')} {event.get('message', '')}".rstrip()
+            )
+    else:
+        lines.append("health events: none")
+    return "\n".join(lines) + "\n"
+
+
+def tail(path: str, follow: bool = False, interval: float = 2.0, out: Any = None) -> int:
+    out = out if out is not None else sys.stdout
+    jsonl = find_jsonl(path)
+    if jsonl is None:
+        print(f"no {JSONL_FILENAME} found under {path!r} (is telemetry enabled?)", file=sys.stderr)
+        return 1
+    while True:
+        out.write(f"== {jsonl} ==\n")
+        out.write(render(load_records(jsonl)))
+        out.flush()
+        if not follow:
+            return 0
+        try:
+            time.sleep(interval)
+        except KeyboardInterrupt:  # pragma: no cover - interactive exit
+            return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m sheeprl_tpu.telemetry",
+        description="Inspect a run's telemetry.jsonl (health, counters, rates).",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+    p_tail = sub.add_parser("tail", help="render current health/throughput from a run's telemetry.jsonl")
+    p_tail.add_argument("logdir", help="telemetry.jsonl path, a run dir, or any ancestor (newest run wins)")
+    p_tail.add_argument("--follow", "-f", action="store_true", help="re-render until interrupted")
+    p_tail.add_argument("--interval", type=float, default=2.0, help="seconds between renders with --follow")
+    args = parser.parse_args(argv)
+    if args.command == "tail":
+        return tail(args.logdir, follow=args.follow, interval=args.interval)
+    parser.error(f"unknown command {args.command!r}")  # pragma: no cover
+    return 2
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

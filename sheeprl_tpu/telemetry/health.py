@@ -1,0 +1,399 @@
+"""Training-health probes and sentinels.
+
+Two halves, split by where they run:
+
+- :func:`health_probe` is the **on-device** half: a pure pytree reducer the
+  train-step builders call *inside* their donated jits. It folds grads,
+  params, and optimizer updates into a handful of f32 scalars — global grad
+  norm, NaN/Inf leaf counts, weight norm, param-update ratio — plus any
+  per-algo aux scalars (PPO entropy/approx-KL, SAC alpha, DreamerV3 KL).
+  The scalars are merged into the step's existing metrics dict, so they
+  ride the StepTimer's already-coalesced ONE-``device_get``-per-interval
+  transfer: zero additional host syncs, which is why this file sits under
+  the telemetry package's no-baseline graftlint gate.
+
+- :class:`HealthMonitor` is the **host** half: sentinels over the fetched
+  interval scalars. Every observed value gets an unconditional finiteness
+  check (so pass-through loops with no in-jit probes still catch a NaN'd
+  loss), probe counters get a nonzero check, configured thresholds get a
+  limit check, and an EWMA detector flags statistical anomalies (grad-norm
+  explosions, entropy collapse) after a warmup. Detections become
+  structured :class:`HealthEvent` records — logged to ``telemetry.jsonl``,
+  counted, gauged — and escalate through the same ``warn|preempt|abort``
+  trip policy as the dispatch watchdog
+  (:func:`sheeprl_tpu.core.resilience.apply_trip_policy`): a ``preempt``
+  sentinel delivers SIGTERM so the PreemptionGuard drain→atomic-save→
+  autoresume path runs. Once a run is *tainted* (a non-finite value was
+  observed) the monitor vetoes further checkpoint saves
+  (:meth:`HealthMonitor.allow_save`), so the newest checkpoint on disk is
+  always from before the blow-up and ``checkpoint.resume_from=auto``
+  restarts from healthy state.
+
+Sentinels observe at the metric log cadence (they ride the interval fetch),
+so a live run needs ``metric.log_level > 0``; the ``configs/health`` group
+documents this.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "HealthEvent",
+    "HealthMonitor",
+    "health_probe",
+    "probes_enabled",
+]
+
+PROBE_PREFIX = "health/"
+_POLICIES = ("warn", "preempt", "abort")
+
+
+# ------------------------------------------------------------ in-jit probes
+def probes_enabled(cfg: Any) -> bool:
+    """Whether the train-step builders should compute in-jit health probes
+    for this run (the ``health`` Hydra group, read at trace time — off means
+    the step functions are byte-identical to a probe-less build)."""
+    health = cfg.get("health") if hasattr(cfg, "get") else None
+    if not health:
+        return False
+    return bool(health.get("enabled", False)) and bool(health.get("probes", True))
+
+
+def _tree_global_norm(tree: Any):
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.float32(0.0)
+    total = sum(jnp.sum(jnp.square(leaf.astype(jnp.float32))) for leaf in leaves)
+    return jnp.sqrt(total)
+
+
+def _tree_nonfinite_leaves(tree: Any):
+    """Number of leaves containing at least one NaN/Inf element. Per-leaf
+    ``any`` (not a per-element count): one reduced scalar per leaf keeps the
+    probe O(params) reads but O(leaves) accumulation, and the mean over a
+    fused scan axis stays > 0 whenever any step saw a bad leaf."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.float32(0.0)
+    return sum(jnp.any(~jnp.isfinite(leaf)).astype(jnp.float32) for leaf in leaves)
+
+
+def health_probe(
+    params: Any = None,
+    grads: Any = None,
+    updates: Any = None,
+    aux: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Pure on-device health reduction — call inside the train jit and merge
+    the result into the step's metrics dict. Any argument may be a single
+    pytree or a tuple of pytrees (an algo with several optimizers passes all
+    its grad trees at once)."""
+    import jax.numpy as jnp
+
+    out: Dict[str, Any] = {}
+    if grads is not None:
+        out[PROBE_PREFIX + "grad_norm"] = _tree_global_norm(grads)
+        out[PROBE_PREFIX + "grad_nonfinite"] = _tree_nonfinite_leaves(grads)
+    if params is not None:
+        param_norm = _tree_global_norm(params)
+        out[PROBE_PREFIX + "param_norm"] = param_norm
+        out[PROBE_PREFIX + "param_nonfinite"] = _tree_nonfinite_leaves(params)
+        if updates is not None:
+            out[PROBE_PREFIX + "update_ratio"] = _tree_global_norm(updates) / (param_norm + 1e-12)
+    if aux:
+        for key, value in aux.items():
+            # Reduce to 0-d: aux values are per-algo scalars, but some arrive
+            # shaped (1,) (e.g. SAC's log_alpha) and the host-side scalar
+            # extraction only accepts 0-d.
+            out[PROBE_PREFIX + key] = jnp.mean(jnp.asarray(value, dtype=jnp.float32))
+    return out
+
+
+# ------------------------------------------------------------------ events
+@dataclass
+class HealthEvent:
+    """One sentinel detection, as logged to ``telemetry.jsonl``."""
+
+    step: int
+    metric: str
+    kind: str  # nonfinite | threshold | anomaly
+    value: float
+    policy: str
+    limit: Optional[float] = None
+    message: str = ""
+    time: float = field(default_factory=time.time)
+
+    def as_record(self) -> Dict[str, Any]:
+        return {
+            "type": "health_event",
+            "step": self.step,
+            "metric": self.metric,
+            "kind": self.kind,
+            "value": self.value,
+            "limit": self.limit,
+            "policy": self.policy,
+            "message": self.message,
+            "time": self.time,
+        }
+
+
+class _Ewma:
+    """Exponentially-weighted mean/variance anomaly detector for one scalar
+    stream: after ``warmup`` finite observations, a value more than
+    ``k`` EW standard deviations from the EW mean is anomalous. The stats
+    update on every finite observation (including anomalous ones), so a
+    genuine regime change re-converges instead of alarming forever."""
+
+    __slots__ = ("alpha", "warmup", "k", "mean", "var", "n")
+
+    def __init__(self, alpha: float, warmup: int, k: float) -> None:
+        self.alpha = float(alpha)
+        self.warmup = int(warmup)
+        self.k = float(k)
+        self.mean = 0.0
+        self.var = 0.0
+        self.n = 0
+
+    def observe(self, x: float) -> Optional[Tuple[float, float]]:
+        anomaly: Optional[Tuple[float, float]] = None
+        if self.n >= self.warmup:
+            std = math.sqrt(self.var)
+            if std > 0.0 and abs(x - self.mean) > self.k * std:
+                anomaly = (self.mean, self.k * std)
+        if self.n == 0:
+            self.mean = x
+        else:
+            delta = x - self.mean
+            self.mean += self.alpha * delta
+            self.var = (1.0 - self.alpha) * (self.var + self.alpha * delta * delta)
+        self.n += 1
+        return anomaly
+
+
+# ----------------------------------------------------------------- monitor
+class HealthMonitor:
+    """Host-side sentinels over the per-interval fetched train metrics.
+
+    Built by the CLI from the ``health`` Hydra group and installed on
+    ``runtime.health``; every train loop calls
+    ``health.observe(policy_step, fetched_train_metrics, telemetry=...)``
+    right after its StepTimer flush and gates checkpoint writes on
+    ``health.allow_save()``."""
+
+    def __init__(
+        self,
+        enabled: bool = False,
+        probes: bool = True,
+        policy: str = "preempt",
+        anomaly_policy: str = "warn",
+        ewma_alpha: float = 0.1,
+        ewma_warmup: int = 8,
+        ewma_k: float = 6.0,
+        thresholds: Optional[Dict[str, float]] = None,
+        max_events: int = 256,
+    ) -> None:
+        if policy not in _POLICIES or anomaly_policy not in _POLICIES:
+            raise ValueError(
+                f"health policies must be one of {_POLICIES}, got policy={policy!r} "
+                f"anomaly_policy={anomaly_policy!r}"
+            )
+        self.enabled = bool(enabled)
+        self.probes = bool(probes)
+        self.policy = policy
+        self.anomaly_policy = anomaly_policy
+        self.ewma_alpha = float(ewma_alpha)
+        self.ewma_warmup = int(ewma_warmup)
+        self.ewma_k = float(ewma_k)
+        self.thresholds = {str(k): float(v) for k, v in (thresholds or {}).items()}
+        self.max_events = int(max_events)
+        self.tainted = False
+        self.events: List[HealthEvent] = []
+        self._ewma: Dict[str, _Ewma] = {}
+
+    # ------------------------------------------------------------- config
+    @classmethod
+    def noop(cls) -> "HealthMonitor":
+        return cls(enabled=False)
+
+    @classmethod
+    def from_config(cls, cfg: Any) -> "HealthMonitor":
+        health = cfg.get("health") if hasattr(cfg, "get") else None
+        if not health:
+            return cls.noop()
+        ewma = health.get("ewma") or {}
+        return cls(
+            enabled=bool(health.get("enabled", False)),
+            probes=bool(health.get("probes", True)),
+            policy=str(health.get("policy", "preempt")),
+            anomaly_policy=str(health.get("anomaly_policy", "warn")),
+            ewma_alpha=float(ewma.get("alpha", 0.1)),
+            ewma_warmup=int(ewma.get("warmup", 8)),
+            ewma_k=float(ewma.get("k", 6.0)),
+            thresholds=dict(health.get("thresholds") or {}),
+            max_events=int(health.get("max_events", 256)),
+        )
+
+    # ------------------------------------------------------------ queries
+    @property
+    def probes_enabled(self) -> bool:
+        return self.enabled and self.probes
+
+    def allow_save(self) -> bool:
+        """False once a non-finite value was observed: the in-memory state
+        is suspect, and skipping the save is what leaves the newest on-disk
+        checkpoint pre-blow-up for ``resume_from=auto``."""
+        return not self.tainted
+
+    # ------------------------------------------------------------ observe
+    def observe(
+        self,
+        step: int,
+        fetched_metrics: Any,
+        telemetry: Any = None,
+    ) -> List[HealthEvent]:
+        """Run the sentinels over one interval's fetched metrics (a dict of
+        host scalars, or the list of dicts a StepTimer flush returns).
+        Returns the events raised this call (already logged/escalated)."""
+        if not self.enabled:
+            return []
+        if isinstance(fetched_metrics, dict):
+            fetched_metrics = [fetched_metrics]
+        new_events: List[HealthEvent] = []
+        last_seen: Dict[str, float] = {}
+        for metrics in fetched_metrics or []:
+            if not isinstance(metrics, dict):
+                continue
+            for name, raw in metrics.items():
+                value = _as_scalar(raw)
+                if value is None:
+                    continue
+                last_seen[name] = value
+                new_events.extend(self._check(step, name, value))
+        self._publish(step, last_seen, new_events, telemetry)
+        return new_events
+
+    def _check(self, step: int, name: str, value: float) -> List[HealthEvent]:
+        events: List[HealthEvent] = []
+        if not math.isfinite(value):
+            events.append(
+                HealthEvent(
+                    step=step, metric=name, kind="nonfinite", value=value, policy=self.policy,
+                    message=f"non-finite value {value!r}",
+                )
+            )
+            return events  # a NaN is not also a threshold/anomaly datum
+        if name.endswith("_nonfinite") and value > 0.0:
+            events.append(
+                HealthEvent(
+                    step=step, metric=name, kind="nonfinite", value=value, policy=self.policy,
+                    message=f"{value:g} pytree leaves with NaN/Inf elements",
+                )
+            )
+            return events
+        limit = self.thresholds.get(name)
+        if limit is None and name.startswith(PROBE_PREFIX):
+            limit = self.thresholds.get(name[len(PROBE_PREFIX):])
+        if limit is not None and value > limit:
+            events.append(
+                HealthEvent(
+                    step=step, metric=name, kind="threshold", value=value, policy=self.policy,
+                    limit=limit, message=f"{value:g} exceeds configured limit {limit:g}",
+                )
+            )
+        detector = self._ewma.get(name)
+        if detector is None:
+            detector = self._ewma[name] = _Ewma(self.ewma_alpha, self.ewma_warmup, self.ewma_k)
+        anomaly = detector.observe(value)
+        if anomaly is not None:
+            mean, band = anomaly
+            events.append(
+                HealthEvent(
+                    step=step, metric=name, kind="anomaly", value=value, policy=self.anomaly_policy,
+                    limit=mean + band if value > mean else mean - band,
+                    message=f"{value:g} departs EWMA {mean:g} by more than {band:g}",
+                )
+            )
+        return events
+
+    def _publish(
+        self,
+        step: int,
+        last_seen: Dict[str, float],
+        events: List[HealthEvent],
+        telemetry: Any,
+    ) -> None:
+        from sheeprl_tpu.telemetry import tracer as tracer_mod
+        from sheeprl_tpu.telemetry.registry import default_registry
+
+        tracer = tracer_mod.current()
+        registry = default_registry()
+        probe_gauges = {k: v for k, v in last_seen.items() if k.startswith(PROBE_PREFIX)}
+        for name, value in probe_gauges.items():
+            tracer.set_gauge(name, value)
+        if probe_gauges:
+            registry.set_gauges(probe_gauges)
+        if not events:
+            return
+        if self.tainted:
+            # One escalation per blow-up: the loop is already draining, and
+            # the interval after a NaN re-detects the same poisoned params.
+            self._record(events, telemetry)
+            return
+        worst = max(events, key=lambda e: _POLICIES.index(e.policy))
+        if any(e.kind == "nonfinite" for e in events) or worst.policy in ("preempt", "abort"):
+            self.tainted = True
+        self._record(events, telemetry)
+        from sheeprl_tpu.core.resilience import apply_trip_policy
+
+        apply_trip_policy(
+            worst.policy,
+            f"[sheeprl-tpu health] {len(events)} sentinel event(s) at policy step {step}; worst: "
+            f"{worst.metric} {worst.kind} ({worst.message}) — policy={worst.policy}",
+            counter="health_trips",
+            span_name="health/sentinel_trip",
+            category="health",
+            args={"step": step, "metric": worst.metric, "kind": worst.kind, "value": worst.value},
+            dump_stacks=False,
+        )
+
+    def _record(self, events: Iterable[HealthEvent], telemetry: Any) -> None:
+        from sheeprl_tpu.telemetry import tracer as tracer_mod
+
+        tracer = tracer_mod.current()
+        for event in events:
+            # Counted on the tracer only: the telemetry facade mirrors its
+            # interval counter snapshot into the default registry, so adding
+            # a registry counter here would double-book the same name.
+            tracer.count("health_events")
+            tracer.count(f"health_events/{event.kind}")
+            if len(self.events) < self.max_events:
+                self.events.append(event)
+            if telemetry is not None and hasattr(telemetry, "record_event"):
+                telemetry.record_event(event.as_record())
+
+
+def _as_scalar(value: Any) -> Optional[float]:
+    """Best-effort host-scalar extraction: metrics arriving here were already
+    fetched by the StepTimer (numpy scalars/0-d arrays); anything non-numeric
+    or non-scalar is skipped rather than raised on."""
+    if isinstance(value, (bool, str, bytes)):
+        return None
+    try:
+        arr = np.asarray(value)
+    except Exception:  # noqa: BLE001 - heterogeneous metric dicts
+        return None
+    if arr.shape != () or not np.issubdtype(arr.dtype, np.number):
+        return None
+    return float(arr)

@@ -103,6 +103,23 @@ class Histogram:
             seen += c
         return hi_obs
 
+    def buckets(self):
+        """Cumulative-bucket snapshot for Prometheus exposition: a list of
+        ``(upper_bound, cumulative_count)`` pairs (the overflow bucket is the
+        exporter's ``+Inf`` series), plus the running sum and count — all
+        captured under one lock so a concurrent scraper sees a consistent
+        view."""
+        with self._lock:
+            counts = list(self._counts)
+            total = self.total
+            count = self.count
+        cumulative = []
+        seen = 0
+        for upper, c in zip(self._bounds, counts):
+            seen += c
+            cumulative.append((upper, seen))
+        return cumulative, total, count
+
     def summary(self) -> Dict[str, float]:
         """One-shot snapshot: count/mean/min/max plus the dashboard trio."""
         if self.count == 0:

@@ -27,6 +27,7 @@ from __future__ import annotations
 import json
 import os
 import time
+import warnings
 from typing import Any, Dict, Optional
 
 from sheeprl_tpu.telemetry import tracer as tracer_mod
@@ -52,10 +53,12 @@ class Telemetry:
         profiler_stop_step: int = -1,
         profiler_trace_dir: Optional[str] = None,
         profiler_port: Optional[int] = None,
+        metrics_port: Optional[int] = None,
     ) -> None:
         self.enabled = bool(enabled)
         self.chrome_trace = bool(chrome_trace)
         self.jsonl = bool(jsonl)
+        self.metrics_port = int(metrics_port) if metrics_port is not None else None
         self._tracer = Tracer(capacity=buffer_capacity, enabled=self.enabled)
         self._monitor = JaxEventMonitor(
             warmup_iters=warmup_iters, warn_on_recompile=warn_on_recompile
@@ -72,6 +75,10 @@ class Telemetry:
         self._device: Any = None
         self._opened = False
         self._previous_tracer: Optional[Tracer] = None
+        self._exporter: Any = None
+        # Per-interval rate state (log_counters): previous snapshot + time.
+        self._prev_counters: Optional[Dict[str, float]] = None
+        self._prev_counters_t = 0.0
 
     # ------------------------------------------------------------- config
     @classmethod
@@ -93,6 +100,7 @@ class Telemetry:
             profiler_stop_step=int(prof.get("stop_step", -1)),
             profiler_trace_dir=prof.get("trace_dir"),
             profiler_port=prof.get("port"),
+            metrics_port=tele.get("metrics_port"),
         )
 
     @classmethod
@@ -115,6 +123,13 @@ class Telemetry:
         if self._profiler.trace_dir is None and log_dir is not None:
             self._profiler.trace_dir = os.path.join(log_dir, "xla_trace")
         self._profiler.start_server()
+        if self.metrics_port is not None and self._rank_zero:
+            from sheeprl_tpu.telemetry.registry import MetricsExporter, default_registry
+
+            try:
+                self._exporter = MetricsExporter(self.metrics_port, [default_registry()])
+            except OSError as err:
+                warnings.warn(f"telemetry.metrics_port={self.metrics_port} unavailable ({err}); exporter disabled")
         if self._jsonl_path() is not None:
             import jax
 
@@ -137,6 +152,9 @@ class Telemetry:
             st.flush()
         if not self._opened:
             return
+        if self._exporter is not None:
+            self._exporter.close()
+            self._exporter = None
         self._profiler.close()
         self._monitor.detach()
         self._export()
@@ -192,19 +210,68 @@ class Telemetry:
     def log_counters(self, logger: Any, step: int) -> Dict[str, float]:
         """Per-log-interval export: every counter through the experiment
         logger (TensorBoard/MLflow `log` surface) and one counters line in
-        telemetry.jsonl."""
+        telemetry.jsonl — plus host-computed per-interval ``*_per_s`` rates
+        for the monotonic counters, so throughput is readable live (the
+        ``tail`` inspector, dashboards) without differencing the JSONL
+        after the fact."""
         if not self.enabled:
             return {}
         counters = self.counters()
+        now = time.perf_counter()
+        rates = self._interval_rates(counters, now)
         if logger is not None:
             for name in sorted(counters):
                 logger.log(f"Telemetry/{name}", counters[name], step)
+            for name in sorted(rates):
+                logger.log(f"Telemetry/{name}", rates[name], step)
             st = self._step_timers.get("train")
             if st is not None and st.steps:
                 logger.log("Telemetry/train_step_ms", st.seconds_per_step * 1e3, step)
         if self._jsonl_path() is not None:
-            self._append_jsonl({"type": "counters", "step": step, "values": counters})
+            record: Dict[str, Any] = {"type": "counters", "step": step, "time": time.time(), "values": counters}
+            if rates:
+                record["rates"] = rates
+            self._append_jsonl(record)
+        # Mirror the interval snapshot into the process metrics registry so a
+        # /metrics scrape (serve server or the metrics_port exporter) reports
+        # the same values the logger and the JSONL do.
+        from sheeprl_tpu.telemetry.registry import default_registry
+
+        registry = default_registry()
+        registry.set_gauges(counters)
+        registry.set_gauges(rates)
         return counters
+
+    def _interval_rates(self, counters: Dict[str, float], now: float) -> Dict[str, float]:
+        """``(cur - prev) / dt`` for every monotonic counter (gauges — HBM
+        levels, health probes, queue depths — are excluded by name via the
+        tracer's gauge registry; monitor memory gauges by their prefix)."""
+        rates: Dict[str, float] = {}
+        prev, prev_t = self._prev_counters, self._prev_counters_t
+        self._prev_counters = dict(counters)
+        self._prev_counters_t = now
+        if prev is None:
+            return rates
+        dt = now - prev_t
+        if dt <= 0.0:
+            return rates
+        gauges = self._tracer.gauge_names()
+        for name, cur in counters.items():
+            if name in gauges or name.startswith("hbm_"):
+                continue
+            last = prev.get(name)
+            if last is None:
+                continue
+            delta = float(cur) - float(last)
+            if delta < 0.0:
+                continue
+            rates[name + "_per_s"] = delta / dt
+        return rates
+
+    def record_event(self, record: Dict[str, Any]) -> None:
+        """Append a structured event record (e.g. a health sentinel event)
+        to telemetry.jsonl. No-op when disabled or not rank zero."""
+        self._append_jsonl(dict(record))
 
     # ------------------------------------------------------------- export
     def _jsonl_path(self) -> Optional[str]:

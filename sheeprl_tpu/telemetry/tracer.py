@@ -104,6 +104,7 @@ class Tracer:
         self._lock = threading.Lock()
         self._spans: deque = deque(maxlen=self.capacity)
         self._counters: Dict[str, float] = {}
+        self._gauge_names: set = set()
         self.dropped = 0
         # perf_counter epoch: trace timestamps are relative to tracer birth
         # (perf_counter's absolute origin is unspecified).
@@ -141,11 +142,15 @@ class Tracer:
             self._counters[name] = self._counters.get(name, 0.0) + float(value)
 
     def set_gauge(self, name: str, value: float) -> None:
-        """Set a named gauge (last-value-wins; e.g. HBM bytes in use)."""
+        """Set a named gauge (last-value-wins; e.g. HBM bytes in use).
+        Gauge names are remembered so interval consumers (the per-second
+        rate computation in ``Telemetry.log_counters``) can tell gauges
+        apart from monotonic counters in the shared table."""
         if not self.enabled:
             return
         with self._lock:
             self._counters[name] = float(value)
+            self._gauge_names.add(name)
 
     # ------------------------------------------------------------ snapshots
     def spans(self) -> List[Span]:
@@ -156,10 +161,15 @@ class Tracer:
         with self._lock:
             return dict(self._counters)
 
+    def gauge_names(self) -> set:
+        with self._lock:
+            return set(self._gauge_names)
+
     def clear(self) -> None:
         with self._lock:
             self._spans.clear()
             self._counters.clear()
+            self._gauge_names.clear()
             self.dropped = 0
 
     # ------------------------------------------------------------ exporters

@@ -1,0 +1,144 @@
+"""Health-sentinel chaos scenario driven through the CLI: a NaN reward is
+injected into the env stream, the in-jit probes surface the blow-up at the
+next metric interval, the preempt sentinel SIGTERMs the run, the guard
+drains — and the tainted run's checkpoint save is VETOED, so the newest
+on-disk checkpoint is from before the NaN and ``checkpoint.resume_from=auto``
+restarts from healthy state and finishes."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from sheeprl_tpu.cli import run
+from sheeprl_tpu.core import chaos
+from sheeprl_tpu.utils.checkpoint import load_checkpoint, parse_ckpt_name
+
+pytestmark = pytest.mark.chaos
+
+TOTAL_STEPS = 128
+INJECT_ENV_STEP = 9  # env 0's 9th step() -> policy step ~18, after the save at 16
+
+
+@pytest.fixture(autouse=True)
+def _isolated(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def _find_ckpts(root):
+    found = []
+    for r, dirs, _ in os.walk(root):
+        for d in dirs:
+            if d.startswith("ckpt_") and d.endswith(".ckpt"):
+                found.append(os.path.realpath(os.path.join(r, d)))
+    return sorted(found, key=lambda p: parse_ckpt_name(p)[0])
+
+
+def _find_jsonls(root):
+    return sorted(
+        os.path.join(r, f)
+        for r, _, files in os.walk(root)
+        for f in files
+        if f == "telemetry.jsonl"
+    )
+
+
+def _health_events(root):
+    events = []
+    for path in _find_jsonls(root):
+        with open(path) as fp:
+            for line in fp:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("type") == "health_event":
+                    events.append(rec)
+    return events
+
+
+def _assert_finite(tree, *, skip=("rb",)):
+    if isinstance(tree, dict):
+        tree = {k: v for k, v in tree.items() if k not in skip}
+    for leaf in jax.tree_util.tree_leaves(tree):
+        arr = np.asarray(leaf)
+        if np.issubdtype(arr.dtype, np.floating):
+            assert np.isfinite(arr).all(), "checkpoint contains non-finite values"
+
+
+def sac_args(total_steps=TOTAL_STEPS, **extra):
+    args = [
+        "exp=sac",
+        "env=dummy",
+        "env.id=continuous_dummy",
+        "env.wrapper.id=continuous_dummy",
+        "metric.log_level=1",
+        "metric.log_every=4",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "algo.per_rank_batch_size=4",
+        "algo.learning_starts=4",
+        "algo.hidden_size=8",
+        "algo.run_test=False",
+        f"algo.total_steps={total_steps}",
+        "buffer.memmap=False",
+        "buffer.size=64",
+        "buffer.checkpoint=True",
+        "checkpoint.every=8",
+        "checkpoint.save_last=True",
+        "fabric.accelerator=cpu",
+        "health=on",
+        "telemetry.enabled=True",
+    ]
+    for k, v in extra.items():
+        args.append(f"{k}={v}")
+    return args
+
+
+def test_nan_reward_preempts_vetoes_save_and_auto_resumes(tmp_path):
+    # Leg 1: NaN reward injected into env 0 mid-run. The poisoned batch NaNs
+    # the losses/grads, the probe scalars carry that to the next interval
+    # fetch, and the preempt sentinel SIGTERMs the run. run() returns
+    # normally: the PreemptionGuard drains at the iteration boundary.
+    run(
+        sac_args(
+            **{
+                "resilience.chaos.enabled": True,
+                "resilience.chaos.injectors": (
+                    f"[{{kind: nan_reward, env_rank: 0, at_step: {INJECT_ENV_STEP}}}]"
+                ),
+            }
+        )
+    )
+
+    # The sentinel fired and was recorded as a structured event.
+    events = _health_events(tmp_path)
+    assert events, "no health_event records in telemetry.jsonl"
+    assert any(e["kind"] == "nonfinite" for e in events)
+    assert all(e["policy"] == "preempt" for e in events if e["kind"] == "nonfinite")
+
+    # The run was cut short, and the taint veto held: every checkpoint on
+    # disk is pre-blow-up — the newest one validates and holds only finite
+    # parameters. (The drain save after the trip was skipped, which also
+    # means no autoresume pointer: resume_from=auto falls back to the newest
+    # valid checkpoint.)
+    ckpts = _find_ckpts(tmp_path)
+    assert ckpts, "no checkpoint survived the NaN run"
+    last_good_step = parse_ckpt_name(ckpts[-1])[0]
+    assert last_good_step < TOTAL_STEPS
+    state = load_checkpoint(ckpts[-1])
+    _assert_finite(state)
+
+    # Leg 2: auto-resume restarts from the pre-NaN checkpoint and, with the
+    # injector gone, trains through to completion.
+    chaos.reset()
+    run(sac_args(**{"checkpoint.resume_from": "auto:logs/runs"}))
+    resumed = _find_ckpts(tmp_path)[-1]
+    assert parse_ckpt_name(resumed)[0] == TOTAL_STEPS
+    _assert_finite(load_checkpoint(resumed))

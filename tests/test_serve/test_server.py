@@ -148,6 +148,48 @@ def test_closed_engine_maps_to_503():
         server._http.server_close()
 
 
+def test_metrics_endpoint_serves_prometheus_text(served):
+    # Drive one request so the engine counters/latency have data.
+    _post(served, "/v1/act", {"model": "echo", "obs": {"x": [1, 2, 3, 4]}, "seed": 5})
+    with urllib.request.urlopen(served.address + "/metrics", timeout=30) as resp:
+        assert resp.status == 200
+        content_type = resp.headers["Content-Type"]
+        body = resp.read().decode()
+    assert content_type.startswith("text/plain") and "version=0.0.4" in content_type
+    # Valid exposition format: every sample line is "name[{labels}] value".
+    for line in body.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, value = line.rsplit(" ", 1)
+        assert name
+        float(value)
+    # The rendering reads the SAME registry objects stats() reads: values agree.
+    stats = served.engine.stats()
+    lines = body.splitlines()
+    req_line = next(line for line in lines if line.startswith("serve_requests_total "))
+    assert float(req_line.split()[-1]) == stats["counters"]["requests"]
+    batch_line = next(line for line in lines if line.startswith("serve_batches_total "))
+    assert float(batch_line.split()[-1]) == stats["counters"]["batches"]
+    count_line = next(line for line in lines if line.startswith("serve_latency_s_count "))
+    assert float(count_line.split()[-1]) == stats["latency"]["count"]
+    assert any(line.startswith('serve_latency_s_bucket{le="') for line in lines)
+    assert any(line.startswith("serve_queue_depth ") for line in lines)
+    assert any(line.startswith("serve_batch_occupancy ") for line in lines)
+
+
+def test_metrics_endpoint_includes_the_process_default_registry(served):
+    from sheeprl_tpu.telemetry.registry import reset_default_registry
+
+    registry = reset_default_registry()
+    try:
+        registry.gauge("health/grad_norm").set(1.5)
+        with urllib.request.urlopen(served.address + "/metrics", timeout=30) as resp:
+            body = resp.read().decode()
+        assert "health_grad_norm 1.5" in body
+    finally:
+        reset_default_registry()
+
+
 def test_in_process_client_mirrors_http(served):
     client = ServeClient(served.engine)
     action = client.act("echo", {"x": [2, 2, 2, 2]}, seed=1)

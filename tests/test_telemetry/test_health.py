@@ -1,0 +1,275 @@
+"""Health probe + sentinel unit tests: the in-jit probe's reductions (under
+jit, with and without NaNs), the HealthMonitor's nonfinite/threshold/EWMA
+detectors and trip escalation, checkpoint-save taint, config construction,
+and the `python -m sheeprl_tpu.telemetry tail` inspector."""
+
+import json
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.telemetry import tracer as tracer_mod
+from sheeprl_tpu.telemetry.health import (
+    HealthMonitor,
+    health_probe,
+    probes_enabled,
+)
+from sheeprl_tpu.telemetry.tracer import Tracer
+
+pytestmark = pytest.mark.telemetry
+
+
+@pytest.fixture
+def live_tracer():
+    t = Tracer()
+    prev = tracer_mod.set_current(t)
+    yield t
+    tracer_mod.set_current(prev)
+
+
+@pytest.fixture
+def no_escalation(monkeypatch):
+    """Capture apply_trip_policy calls instead of delivering real signals."""
+    calls = []
+
+    def fake(policy, message, **kwargs):
+        calls.append({"policy": policy, "message": message, **kwargs})
+
+    import sheeprl_tpu.core.resilience as resilience
+
+    monkeypatch.setattr(resilience, "apply_trip_policy", fake)
+    return calls
+
+
+# ------------------------------------------------------------------ probes
+def _tree():
+    return {"w": jnp.ones((4, 4), jnp.float32), "b": jnp.zeros((4,), jnp.float32)}
+
+
+def test_probe_under_jit_reports_finite_state():
+    @jax.jit
+    def step(params, grads, updates):
+        return health_probe(params=params, grads=grads, updates=updates, aux={"entropy": jnp.float32(0.5)})
+
+    out = step(_tree(), _tree(), _tree())
+    assert set(out) == {
+        "health/grad_norm",
+        "health/grad_nonfinite",
+        "health/param_norm",
+        "health/param_nonfinite",
+        "health/update_ratio",
+        "health/entropy",
+    }
+    assert float(out["health/grad_nonfinite"]) == 0.0
+    assert float(out["health/param_nonfinite"]) == 0.0
+    assert float(out["health/grad_norm"]) == pytest.approx(4.0)  # sqrt(16 ones)
+    assert float(out["health/update_ratio"]) == pytest.approx(1.0, rel=1e-5)
+    assert float(out["health/entropy"]) == pytest.approx(0.5)
+    for v in out.values():
+        assert np.asarray(v).shape == ()  # 0-d: ready for _as_scalar
+
+
+def test_probe_counts_nonfinite_leaves_under_jit():
+    grads = _tree()
+    grads["w"] = grads["w"].at[0, 0].set(jnp.nan)
+
+    @jax.jit
+    def step(g):
+        return health_probe(grads=g)
+
+    out = step(grads)
+    assert float(out["health/grad_nonfinite"]) == 1.0  # one bad leaf, per-leaf any()
+    assert not math.isfinite(float(out["health/grad_norm"]))
+
+
+def test_probe_accepts_tuples_of_trees_and_1d_aux():
+    out = health_probe(
+        params=(_tree(), _tree()),
+        grads=(_tree(), _tree()),
+        aux={"alpha": jnp.ones((1,), jnp.float32) * 3.0},
+    )
+    assert float(out["health/param_norm"]) == pytest.approx(math.sqrt(32.0))
+    assert np.asarray(out["health/alpha"]).shape == ()  # (1,) reduced to 0-d
+    assert float(out["health/alpha"]) == pytest.approx(3.0)
+
+
+def test_probe_mean_over_scan_axis_keeps_nonfinite_positive():
+    # The fused loops reduce stacked per-step metrics with mean(0): a single
+    # bad step in the scan must stay visible after the reduction.
+    stacked = jnp.asarray([1.0, 0.0, 0.0, 0.0], jnp.float32)  # 1 bad step of 4
+    assert float(stacked.mean(0)) > 0.0
+
+
+def test_probes_enabled_reads_the_health_group():
+    assert not probes_enabled({})
+    assert not probes_enabled({"health": {"enabled": False}})
+    assert probes_enabled({"health": {"enabled": True}})
+    assert not probes_enabled({"health": {"enabled": True, "probes": False}})
+
+
+# ---------------------------------------------------------------- monitor
+def test_noop_monitor_observes_nothing():
+    mon = HealthMonitor.noop()
+    assert mon.observe(0, {"loss": float("nan")}) == []
+    assert mon.allow_save()
+    assert not mon.tainted
+
+
+def test_nonfinite_value_taints_and_vetoes_saves(live_tracer, no_escalation):
+    mon = HealthMonitor(enabled=True, policy="warn")
+    events = mon.observe(10, {"value_loss": float("nan")})
+    assert [e.kind for e in events] == ["nonfinite"]
+    assert mon.tainted and not mon.allow_save()
+    assert len(no_escalation) == 1 and no_escalation[0]["policy"] == "warn"
+    # Tainted runs keep recording but never re-escalate (one trip per blow-up).
+    mon.observe(11, {"value_loss": float("nan")})
+    assert len(no_escalation) == 1
+    assert live_tracer.counters()["health_events"] >= 2
+
+
+def test_probe_nonfinite_counter_is_a_certain_failure(live_tracer, no_escalation):
+    mon = HealthMonitor(enabled=True, policy="preempt")
+    events = mon.observe(5, {"health/grad_nonfinite": 2.0})
+    assert events[0].kind == "nonfinite"
+    assert mon.tainted
+    assert no_escalation[0]["policy"] == "preempt"
+
+
+def test_threshold_detection_matches_with_and_without_prefix(live_tracer, no_escalation):
+    mon = HealthMonitor(enabled=True, policy="warn", thresholds={"grad_norm": 10.0})
+    assert mon.observe(1, {"health/grad_norm": 5.0}) == []
+    events = mon.observe(2, {"health/grad_norm": 50.0})
+    assert [e.kind for e in events] == ["threshold"]
+    assert events[0].limit == 10.0
+    assert not mon.tainted  # thresholds at warn don't poison the run
+    assert mon.allow_save()
+
+
+def test_ewma_flags_a_spike_after_warmup(live_tracer, no_escalation):
+    mon = HealthMonitor(
+        enabled=True, policy="warn", anomaly_policy="warn",
+        ewma_alpha=0.2, ewma_warmup=4, ewma_k=4.0,
+    )
+    for step, v in enumerate([1.0, 1.1, 0.9, 1.0, 1.05, 0.95]):
+        assert mon.observe(step, {"health/grad_norm": v}) == []
+    events = mon.observe(99, {"health/grad_norm": 100.0})
+    assert [e.kind for e in events] == ["anomaly"]
+    assert events[0].policy == "warn"
+
+
+def test_probe_gauges_are_published(live_tracer, no_escalation):
+    from sheeprl_tpu.telemetry.registry import reset_default_registry
+
+    registry = reset_default_registry()
+    mon = HealthMonitor(enabled=True, policy="warn")
+    mon.observe(3, [{"health/grad_norm": 2.5, "value_loss": 0.1}])
+    assert live_tracer.gauge_names() >= {"health/grad_norm"}
+    assert registry.snapshot()["gauges"]["health/grad_norm"] == 2.5
+
+
+def test_event_ring_is_bounded(live_tracer, no_escalation):
+    mon = HealthMonitor(enabled=True, policy="warn", max_events=3)
+    for step in range(10):
+        mon.observe(step, {"loss": float("nan")})
+    assert len(mon.events) == 3
+
+
+def test_events_are_recorded_to_telemetry(live_tracer, no_escalation):
+    class Sink:
+        def __init__(self):
+            self.records = []
+
+        def record_event(self, record):
+            self.records.append(record)
+
+    sink = Sink()
+    mon = HealthMonitor(enabled=True, policy="warn")
+    mon.observe(7, {"loss": float("inf")}, telemetry=sink)
+    (rec,) = sink.records
+    assert rec["type"] == "health_event"
+    assert rec["step"] == 7 and rec["kind"] == "nonfinite" and rec["metric"] == "loss"
+
+
+def test_from_config_maps_the_hydra_group():
+    mon = HealthMonitor.from_config(
+        {
+            "health": {
+                "enabled": True,
+                "probes": False,
+                "policy": "abort",
+                "anomaly_policy": "preempt",
+                "ewma": {"alpha": 0.5, "warmup": 2, "k": 3.0},
+                "thresholds": {"grad_norm": 7.0},
+                "max_events": 9,
+            }
+        }
+    )
+    assert mon.enabled and not mon.probes_enabled
+    assert mon.policy == "abort" and mon.anomaly_policy == "preempt"
+    assert mon.ewma_alpha == 0.5 and mon.ewma_warmup == 2 and mon.ewma_k == 3.0
+    assert mon.thresholds == {"grad_norm": 7.0}
+    assert mon.max_events == 9
+    assert HealthMonitor.from_config({}).enabled is False
+
+
+def test_bad_policy_is_rejected():
+    with pytest.raises(ValueError, match="warn"):
+        HealthMonitor(enabled=True, policy="explode")
+
+
+def test_non_scalar_metrics_are_skipped(live_tracer, no_escalation):
+    mon = HealthMonitor(enabled=True, policy="warn")
+    assert mon.observe(0, {"vector": np.ones(3), "name": "sac", "ok": 1.0}) == []
+    assert not mon.tainted
+
+
+# ----------------------------------------------------------- tail inspector
+def _write_jsonl(path, records):
+    with open(path, "w") as fp:
+        for rec in records:
+            fp.write(json.dumps(rec) + "\n")
+
+
+def test_tail_inspector_renders_counters_rates_and_events(tmp_path, capsys):
+    from sheeprl_tpu.telemetry.__main__ import main
+    from sheeprl_tpu.telemetry.telemetry import JSONL_FILENAME
+
+    run_dir = tmp_path / "runs" / "sac" / "version_0"
+    run_dir.mkdir(parents=True)
+    _write_jsonl(
+        run_dir / JSONL_FILENAME,
+        [
+            {"type": "meta", "backend": "cpu", "process_index": 0, "time": 0.0},
+            {
+                "type": "counters",
+                "step": 64,
+                "values": {"train_steps": 64, "health/grad_norm": 1.25},
+                "rates": {"train_steps": 8.0},
+            },
+            {
+                "type": "health_event",
+                "step": 64,
+                "metric": "health/grad_norm",
+                "kind": "anomaly",
+                "value": 9.0,
+                "policy": "warn",
+                "message": "spike",
+            },
+        ],
+    )
+    assert main(["tail", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "step: 64" in out
+    assert "train_steps" in out and "(8/s)" in out
+    assert "health/grad_norm" in out
+    assert "anomaly" in out and "policy=warn" in out
+
+
+def test_tail_inspector_without_jsonl_fails_cleanly(tmp_path, capsys):
+    from sheeprl_tpu.telemetry.__main__ import main
+
+    assert main(["tail", str(tmp_path)]) == 1
+    assert "telemetry" in capsys.readouterr().err

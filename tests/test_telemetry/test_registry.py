@@ -1,0 +1,190 @@
+"""MetricsRegistry unit tests: metric semantics, kind-conflict detection,
+Prometheus text rendering, thread-safety under concurrent recorders + a
+scraper, and the stdlib /metrics exporter."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from sheeprl_tpu.telemetry.registry import (
+    PROMETHEUS_CONTENT_TYPE,
+    Counter,
+    Gauge,
+    MetricsExporter,
+    MetricsRegistry,
+    default_registry,
+    merged_prometheus_text,
+    prometheus_name,
+    reset_default_registry,
+)
+
+pytestmark = pytest.mark.telemetry
+
+
+# ------------------------------------------------------------- metric kinds
+def test_counter_is_monotonic():
+    c = Counter("requests")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == pytest.approx(3.5)
+    with pytest.raises(ValueError):
+        c.inc(-1.0)
+    c.reset()
+    assert c.value == 0.0
+
+
+def test_gauge_set_inc_reset():
+    g = Gauge("queue_depth")
+    g.set(4.0)
+    g.inc(2.0)
+    assert g.value == pytest.approx(6.0)
+    g.inc(-3.0)  # gauges may go down
+    assert g.value == pytest.approx(3.0)
+    g.reset()
+    assert g.value == 0.0
+
+
+def test_get_or_create_returns_the_live_object():
+    reg = MetricsRegistry()
+    assert reg.counter("a") is reg.counter("a")
+    assert reg.gauge("b") is reg.gauge("b")
+    assert reg.histogram("c") is reg.histogram("c")
+
+
+def test_kind_conflict_is_an_error():
+    reg = MetricsRegistry()
+    reg.counter("x")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("x")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.histogram("x")
+
+
+def test_snapshot_is_plain_data():
+    reg = MetricsRegistry()
+    reg.counter("hits").inc(3)
+    reg.gauge("depth").set(1.5)
+    reg.histogram("lat").record(0.25)
+    snap = reg.snapshot()
+    assert snap["counters"] == {"hits": 3.0}
+    assert snap["gauges"] == {"depth": 1.5}
+    assert snap["histograms"]["lat"]["count"] == 1
+    json.dumps(snap)  # fully serializable
+
+
+def test_set_gauges_bulk_update_skips_non_numeric():
+    reg = MetricsRegistry()
+    reg.set_gauges({"a": 1.0, "b": "not-a-number", "c": 2})
+    snap = reg.snapshot()["gauges"]
+    assert snap["a"] == 1.0 and snap["c"] == 2.0
+    assert "b" not in snap
+
+
+# ------------------------------------------------------------- prometheus
+def test_prometheus_name_sanitization():
+    assert prometheus_name("serve/queue_depth") == "serve_queue_depth"
+    assert prometheus_name("health/grad norm") == "health_grad_norm"
+    assert prometheus_name("9lives") == "_9lives"
+    assert prometheus_name("") == "_"
+
+
+def test_prometheus_text_format():
+    reg = MetricsRegistry()
+    reg.counter("serve/requests").inc(7)
+    reg.gauge("serve/queue_depth").set(2.0)
+    reg.histogram("serve/latency_s").record(0.01)
+    text = reg.prometheus_text()
+    assert text.endswith("\n")
+    lines = text.splitlines()
+    assert "# TYPE serve_requests_total counter" in lines
+    assert "serve_requests_total 7" in lines
+    assert "# TYPE serve_queue_depth gauge" in lines
+    assert "serve_queue_depth 2" in lines
+    assert "# TYPE serve_latency_s histogram" in lines
+    assert any(line.startswith('serve_latency_s_bucket{le="') for line in lines)
+    assert 'serve_latency_s_bucket{le="+Inf"} 1' in lines
+    assert "serve_latency_s_count 1" in lines
+    # Every sample line is "name[{labels}] value" with a float-parseable value.
+    for line in lines:
+        if line.startswith("#"):
+            continue
+        name, value = line.rsplit(" ", 1)
+        assert name
+        float(value)
+
+
+def test_merged_text_dedupes_registries():
+    reg = MetricsRegistry()
+    reg.counter("only_once").inc()
+    text = merged_prometheus_text([reg, reg, None])
+    assert text.count("only_once_total 1") == 1
+
+
+def test_default_registry_is_a_resettable_singleton():
+    first = default_registry()
+    assert default_registry() is first
+    first.counter("stale").inc()
+    fresh = reset_default_registry()
+    assert fresh is default_registry()
+    assert fresh is not first
+    assert "stale" not in fresh.snapshot()["counters"]
+
+
+# ------------------------------------------------------------ thread-safety
+def test_concurrent_recorders_vs_scraper_exact_totals():
+    reg = MetricsRegistry()
+    n_threads, n_incs = 8, 500
+    stop = threading.Event()
+    scrapes = []
+
+    def recorder(i):
+        c = reg.counter("shared")
+        g = reg.gauge(f"worker_{i}")
+        h = reg.histogram("lat")
+        for k in range(n_incs):
+            c.inc()
+            g.set(float(k))
+            h.record(0.001 * (k % 7))
+
+    def scraper():
+        while not stop.is_set():
+            text = reg.prometheus_text()
+            snap = reg.snapshot()
+            assert "shared_total" in text
+            scrapes.append(snap["counters"]["shared"])
+
+    threads = [threading.Thread(target=recorder, args=(i,)) for i in range(n_threads)]
+    scrape_thread = threading.Thread(target=scraper)
+    scrape_thread.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    scrape_thread.join()
+    assert reg.counter("shared").value == n_threads * n_incs
+    assert reg.histogram("lat").summary()["count"] == n_threads * n_incs
+    # Concurrent scrapes observed monotonically non-decreasing counter values.
+    assert scrapes == sorted(scrapes)
+
+
+# --------------------------------------------------------------- exporter
+def test_metrics_exporter_serves_prometheus_text():
+    reg = MetricsRegistry()
+    reg.counter("train/steps").inc(42)
+    exporter = MetricsExporter(0, [reg], host="127.0.0.1")
+    try:
+        url = f"http://127.0.0.1:{exporter.port}/metrics"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"] == PROMETHEUS_CONTENT_TYPE
+            body = resp.read().decode()
+        assert "train_steps_total 42" in body
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(f"http://127.0.0.1:{exporter.port}/nope", timeout=10)
+        assert err.value.code == 404
+    finally:
+        exporter.close()
