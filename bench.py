@@ -30,7 +30,8 @@ world-model/actor/critic training step and the per-step policy latency.
 Workloads:
 `python bench.py [dreamer_v3|dreamer_v3_devbuf|dreamer_v3_pipe|dreamer_v3_S|
 dreamer_v3_S_b32|dreamer_v3_S_b64|dreamer_v3_health|dreamer_v2|dreamer_v1|
-ppo|a2c|sac|sac_devbuf|sac_pipe|sac_resilience|sac_health|serve_sac]`. The `*_pipe` legs are the
+ppo|a2c|sac|sac_devbuf|sac_pipe|sac_resilience|sac_health|sac_flight|
+serve_sac|serve_sac_traced]`. The `*_pipe` legs are the
 pipelined-interaction A/B (fabric.async_fetch, env.pipeline_slices —
 core/interact.py); every result embeds the interaction time split and
 overlap fraction from the long run. `sac_resilience` is the fault-tolerance
@@ -39,10 +40,15 @@ atomic checkpoint save cost directly. `sac_health` and `dreamer_v3_health`
 are the training-health A/B legs (health=on vs the plain `sac` /
 `dreamer_v3` rows, <2% target): in-jit probes fused into the train step +
 host-side sentinels reading the already-coalesced per-interval metric
-fetch. `serve_sac` is the serving stack's
+fetch. `sac_flight` is the distributed-tracing A/B leg (telemetry.enabled=True:
+live span ring + per-iteration trace contexts + env-carrier propagation on
+top of the always-on flight recorder, vs the plain `sac` row, <2% target).
+`serve_sac` is the serving stack's
 closed-loop load test (sheeprl_tpu/serve): concurrent clients against the
 dynamic micro-batching engine, vs_baseline = batching speedup over one
-client.
+client. `serve_sac_traced` repeats it with a per-request trace context and
+a live tracer installed so request/batch span emission and linking is on
+the measured path (<2% of the `serve_sac` peak).
 Reference baselines from BASELINE.md (README.md:83-180); `dreamer_v3_S` is
 the north-star-scale workload (S model at the Atari-100K recipe shape) vs
 the RTX 3080's ~1.98 env-steps/s.
@@ -351,7 +357,24 @@ def bench_sac_health():
     return result
 
 
-def bench_serve_sac():
+def bench_sac_flight():
+    # A/B leg: full tracing armed (telemetry.enabled=True -> live span ring,
+    # per-iteration trace contexts, env-var carrier) on top of the always-on
+    # flight recorder, on the same SAC workload and baseline as the plain
+    # `sac` row. Acceptance target: within 2% of `sac` — a trace-context
+    # child is two string formats, a span append one locked deque push, the
+    # flight sink one GIL-atomic ring append, and worker spills rewrite one
+    # small file every few seconds off the step path.
+    result = _timeboxed(
+        "sac_flight_env_steps_per_sec", "sac_benchmarks", 65536, 65536 / 320.21,
+        learning_starts=100, warmup_steps=1024, start_steps=4096,
+        extra=("fabric.player_sync=async", "telemetry.enabled=True"),
+    )
+    result["flight"] = {"tracing": True, "recorder": True}
+    return result
+
+
+def bench_serve_sac(traced: bool = False):
     """Closed-loop load test of the serving stack (sheeprl_tpu/serve): train
     a tiny SAC policy, export it to an artifact, host it in an
     InferenceEngine, then sweep concurrent in-process clients 1..max_batch.
@@ -362,7 +385,13 @@ def bench_serve_sac():
     requests/s across the sweep; vs_baseline is peak over the single-client
     rate (the batching speedup itself). Each sweep row embeds p50/p99
     latency, per-bucket mean occupancy, and shed counts from the engine's
-    own histogram/telemetry."""
+    own histogram/telemetry.
+
+    With ``traced=True`` (the ``serve_sac_traced`` leg) every client request
+    carries its own trace context and the live span ring the HTTP server
+    installs is active, so the engine's per-request/batch span emission and
+    request->batch linking sit on the measured path. Acceptance target:
+    peak within 2% of the plain ``serve_sac`` row."""
     import glob
     import tempfile
     import threading
@@ -373,6 +402,9 @@ def bench_serve_sac():
     from sheeprl_tpu.config.loader import compose
     from sheeprl_tpu.serve.artifact import export_artifact
     from sheeprl_tpu.serve.engine import InferenceEngine
+    from sheeprl_tpu.telemetry import flight as flight_mod
+    from sheeprl_tpu.telemetry import trace_context
+    from sheeprl_tpu.telemetry import tracer as tracer_mod
 
     tmp = tempfile.mkdtemp(prefix="bench_serve_")
     overrides = [
@@ -406,6 +438,12 @@ def bench_serve_sac():
     engine = InferenceEngine(max_batch=max_batch, queue_capacity=512, batch_window_s=0.002)
     card = engine.load("sac", artifact_path)
 
+    restore_tracer = None
+    recorder = None
+    if traced:
+        restore_tracer = tracer_mod.set_current(tracer_mod.Tracer(capacity=65536, enabled=True))
+        recorder = flight_mod.install(flight_mod.FlightRecorder(run_info={"role": "serve_bench"}))
+
     rng = np.random.default_rng(0)
     client_obs = [
         {k: rng.standard_normal(shape).astype(np.float32) for k, shape in card["obs_keys"].items()}
@@ -426,7 +464,11 @@ def bench_serve_sac():
         def client(i):
             obs = client_obs[i % max_batch]
             while time.perf_counter() < stop_t:
-                engine.act("sac", obs, mode="sample", seed=i, timeout=60)
+                if traced:
+                    with trace_context.use(trace_context.mint()):
+                        engine.act("sac", obs, mode="sample", seed=i, timeout=60)
+                else:
+                    engine.act("sac", obs, mode="sample", seed=i, timeout=60)
                 counts[i] += 1
 
         threads = [threading.Thread(target=client, args=(i,)) for i in range(n_clients)]
@@ -452,11 +494,15 @@ def bench_serve_sac():
             }
         )
     engine.close()
+    if traced:
+        flight_mod.uninstall(recorder)
+        tracer_mod.set_current(restore_tracer)
 
     single = sweep[0]["requests_per_sec"]
     peak = max(row["requests_per_sec"] for row in sweep)
     return {
-        "metric": "serve_sac_peak_requests_per_sec",
+        "metric": "serve_sac_traced_peak_requests_per_sec" if traced else "serve_sac_peak_requests_per_sec",
+        "traced": traced,
         "value": peak,
         "unit": "requests/sec",
         # The batching speedup: peak closed-loop throughput over the
@@ -587,7 +633,7 @@ def main() -> None:
     # outright so the accelerator plugin is never initialized for them.
     # Accelerator workloads probe the device first and fall back to CPU
     # (recorded in the output) rather than hang on a wedged plugin.
-    if which in ("ppo", "a2c", "sac", "sac_health", "serve_sac"):
+    if which in ("ppo", "a2c", "sac", "sac_health", "sac_flight", "serve_sac", "serve_sac_traced"):
         platform = "cpu"
     elif os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
         platform = "cpu"  # already pinned: nothing to probe
@@ -624,7 +670,9 @@ def main() -> None:
         "sac_pipe": lambda: bench_sac(pipelined=True),
         "sac_resilience": bench_sac_resilience,
         "sac_health": bench_sac_health,
+        "sac_flight": bench_sac_flight,
         "serve_sac": bench_serve_sac,
+        "serve_sac_traced": lambda: bench_serve_sac(traced=True),
     }[which]()
     result["backend"] = jax.default_backend()
     print(json.dumps(result))

@@ -51,6 +51,14 @@ echo "== graftlint (health + registry, no baseline) =="
 python -m sheeprl_tpu.analysis --no-baseline \
     sheeprl_tpu/telemetry/health.py sheeprl_tpu/telemetry/registry.py || rc=1
 
+# The tracing spine (trace contexts) and the crash ring (flight recorder)
+# run inside every loop and every failure handler: pin them by name to the
+# zero-findings bar (GL008 span safety included) so the bar survives even
+# if the telemetry package gate above is ever relaxed.
+echo "== graftlint (trace_context + flight, no baseline) =="
+python -m sheeprl_tpu.analysis --no-baseline \
+    sheeprl_tpu/telemetry/trace_context.py sheeprl_tpu/telemetry/flight.py || rc=1
+
 # The fault-tolerance surface must itself be fault-tolerant: the atomic
 # checkpoint writer and the resilience/chaos modules hold zero findings
 # (GL007 non-atomic persistence included), no baseline, forever.

@@ -63,6 +63,7 @@ def main(runtime, cfg: Dict[str, Any]):
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
     runtime.print(f"Log dir: {log_dir}")
     telemetry = runtime.telemetry.open(log_dir, rank_zero=runtime.is_global_zero, device=runtime.device)
+    telemetry.set_run_info(algo="ppo_decoupled", rank=rank)
     guard = runtime.resilience.guard(rank_zero=runtime.is_global_zero)
     health = runtime.health
 

@@ -73,6 +73,7 @@ def main(runtime, cfg: Dict[str, Any]):
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
     telemetry = runtime.telemetry.open(log_dir, rank_zero=runtime.is_global_zero, device=runtime.device)
+    telemetry.set_run_info(algo="sac_decoupled", rank=rank)
     guard = runtime.resilience.guard(rank_zero=runtime.is_global_zero)
     health = runtime.health
     runtime.print(f"Log dir: {log_dir}")

@@ -8,4 +8,5 @@ from sheeprl_tpu.analysis.rules import (  # noqa: F401
     gl005_donation,
     gl006_blocking_fetch,
     gl007_atomic_persistence,
+    gl008_span_leak,
 )

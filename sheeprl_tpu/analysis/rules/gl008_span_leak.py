@@ -1,0 +1,180 @@
+"""GL008: tracer spans opened without a guaranteed close on exception paths.
+
+A tracer span only *records* when its context manager exits — ``__exit__``
+computes the duration, stamps the trace context, and appends to the ring.
+Since PR 11 spans also carry causality (``__enter__`` installs a child
+:class:`~sheeprl_tpu.telemetry.trace_context.TraceContext` as current and
+``__exit__`` restores the parent), so a span that is entered but not exited
+on an exception path does double damage: the span vanishes from the trace
+(exactly the iteration a post-mortem needs) AND every later span in the
+thread parents to a dead context, corrupting the causal tree the flight
+recorder merges.
+
+Three anti-patterns give this away syntactically:
+
+- **discarded span**: ``tracer.span("x")`` as a bare expression — the
+  context manager is never entered, nothing records; almost always a
+  missing ``with``.
+- **manual enter, unguarded exit**: ``cm = tracer.span(...)``;
+  ``cm.__enter__()``; ... ``cm.__exit__(...)`` with the exit NOT inside a
+  ``finally`` block — an exception between the two leaks the span.
+- **assigned and dropped**: the span is bound to a name that is never used
+  as a ``with`` context expression nor entered at all.
+
+Sanctioned shapes: ``with tracer.span(...):`` (the tracer restores the
+parent context even when the body raises), returning the span from a
+passthrough helper (``Telemetry.span``), handing it to an ExitStack's
+``enter_context``/``push``, or manual enter with the matching ``__exit__``
+inside a ``finally``.
+
+The receiver must look tracer-ish (``tracer``/``telemetry``/``trc``/...)
+so arbitrary domain objects with a ``span`` method stay out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from sheeprl_tpu.analysis.context import LintContext
+from sheeprl_tpu.analysis.registry import Rule, register_rule
+
+_RECEIVER_HINT_RE = re.compile(r"(tracer|telemetry|\btele\b|\btrc\b|tracing)", re.IGNORECASE)
+_SAFE_SINK_ATTRS = {"enter_context", "push", "callback"}
+
+
+def _scope_bodies(tree: ast.Module) -> Iterator[ast.AST]:
+    """The module plus every function definition — each its own span scope."""
+    yield tree
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _scope_walk(scope: ast.AST) -> Iterator[ast.AST]:
+    """Nodes in this scope, not descending into nested function defs (a
+    nested closure entering a span is its own exception-safety problem)."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _is_span_call(node: ast.AST) -> bool:
+    if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+        return False
+    if node.func.attr != "span":
+        return False
+    try:
+        receiver = ast.unparse(node.func.value)
+    except Exception:  # noqa: BLE001 - unparse is best-effort forensics
+        return False
+    return bool(_RECEIVER_HINT_RE.search(receiver))
+
+
+def _dunder_receiver(node: ast.AST, attr: str) -> Optional[str]:
+    """The receiver name of ``<name>.__enter__()`` / ``<name>.__exit__()``."""
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == attr
+        and isinstance(node.func.value, ast.Name)
+    ):
+        return node.func.value.id
+    return None
+
+
+@register_rule
+class SpanLeakOnException(Rule):
+    id = "GL008"
+    name = "span-leak-on-exception"
+    rationale = (
+        "A span records only at __exit__ and restores the parent trace "
+        "context there; a span entered without a finally-guarded exit leaks "
+        "on exceptions, losing the span and corrupting causality for every "
+        "later span in the thread. Use `with tracer.span(...)`."
+    )
+
+    def check(self, ctx: LintContext) -> None:
+        for scope in _scope_bodies(ctx.tree):
+            self._check_scope(ctx, scope)
+
+    def _check_scope(self, ctx: LintContext, scope: ast.AST) -> None:
+        span_calls: List[ast.Call] = [n for n in _scope_walk(scope) if _is_span_call(n)]
+        if not span_calls:
+            return
+
+        safe: Set[int] = set()  # id()s of span calls in a sanctioned position
+        assigned: Dict[str, List[ast.Call]] = {}  # name -> span calls bound to it
+        with_names: Set[str] = set()
+        entered_names: Set[str] = set()
+        finally_exit_names: Set[str] = set()
+
+        for node in _scope_walk(scope):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    expr = item.context_expr
+                    if isinstance(expr, ast.Call):
+                        safe.add(id(expr))
+                    elif isinstance(expr, ast.Name):
+                        with_names.add(expr.id)
+            elif isinstance(node, (ast.Return, ast.Yield)) and node.value is not None:
+                safe.add(id(node.value))
+            elif isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Attribute) and node.func.attr in _SAFE_SINK_ATTRS:
+                    for arg in node.args:
+                        safe.add(id(arg))
+                name = _dunder_receiver(node, "__enter__")
+                if name is not None:
+                    entered_names.add(name)
+            elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                if _is_span_call(node.value):
+                    for target in node.targets:
+                        if isinstance(target, ast.Name):
+                            assigned.setdefault(target.id, []).append(node.value)
+            elif isinstance(node, ast.Try):
+                for stmt in node.finalbody:
+                    for sub in ast.walk(stmt):
+                        name = _dunder_receiver(sub, "__exit__")
+                        if name is not None:
+                            finally_exit_names.add(name)
+
+        assigned_ids = {id(call) for calls in assigned.values() for call in calls}
+        for call in span_calls:
+            if id(call) in safe:
+                continue
+            if id(call) in assigned_ids:
+                continue  # judged below by what happens to the name
+            ctx.report(
+                self.id,
+                call,
+                "span context manager is discarded — nothing records (a span "
+                "only reaches the ring at __exit__); wrap the region in "
+                "`with tracer.span(...):`",
+            )
+        for name, calls in assigned.items():
+            if name in with_names:
+                continue  # later used as `with name:` — the with guarantees exit
+            if name in entered_names and name in finally_exit_names:
+                continue  # manual protocol with a finally-guarded close
+            for call in calls:
+                if id(call) in safe:
+                    continue
+                if name in entered_names:
+                    message = (
+                        f"span `{name}` is entered via __enter__() but its __exit__ is "
+                        "not in a `finally` block — an exception between the two loses "
+                        "the span and leaves a stale trace context installed; use "
+                        "`with tracer.span(...):` or close in `finally`"
+                    )
+                else:
+                    message = (
+                        f"span `{name}` is created but never entered as a context "
+                        "manager in this scope — nothing records; use "
+                        "`with tracer.span(...):`"
+                    )
+                ctx.report(self.id, call, message)

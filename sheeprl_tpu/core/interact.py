@@ -49,6 +49,7 @@ from typing import Any, Callable, Dict, List, NamedTuple, Optional, Sequence, Tu
 import gymnasium as gym
 import numpy as np
 
+from sheeprl_tpu.telemetry import trace_context
 from sheeprl_tpu.telemetry import tracer as tracer_mod
 
 _MISSING = object()
@@ -325,7 +326,7 @@ class PendingFetch:
     ``jax.device_get`` and books the time split: submit→harvest is *ride*
     (hidden under host work), the ``device_get`` duration is *blocked*."""
 
-    __slots__ = ("_pipeline", "_tree", "_label", "_async", "_submit_t", "_result", "_done")
+    __slots__ = ("_pipeline", "_tree", "_label", "_async", "_submit_t", "_result", "_done", "_ctx")
 
     def __init__(self, pipeline: "InteractionPipeline", tree: Any, label: str) -> None:
         self._pipeline = pipeline
@@ -334,6 +335,11 @@ class PendingFetch:
         self._async = pipeline.async_fetch
         self._result: Any = None
         self._done = False
+        # Captured at dispatch: the harvest may happen an iteration later
+        # (or after other work), but the fetch span belongs causally to the
+        # iteration that dispatched it.
+        parent = trace_context.current()
+        self._ctx = parent.child() if parent is not None else None
         if self._async:
             import jax
 
@@ -360,12 +366,15 @@ class PendingFetch:
         from sheeprl_tpu.core import chaos
 
         t0 = time.perf_counter()
-        chaos.maybe_delay("fetch.harvest")
         watchdog = self._pipeline.watchdog
         if watchdog is not None:
             with watchdog.guard(f"fetch/{self._label}"):
+                # Inside the armed window: a delayed_fetch drill must look
+                # exactly like a hung device fetch to the watchdog.
+                chaos.maybe_delay("fetch.harvest")
                 out = jax.device_get(self._tree)
         else:
+            chaos.maybe_delay("fetch.harvest")
             out = jax.device_get(self._tree)
         t1 = time.perf_counter()
         stats = self._pipeline.stats
@@ -384,6 +393,7 @@ class PendingFetch:
                 t0,
                 t1 - t0,
                 {"bytes": nbytes, "async": self._async},
+                ctx=self._ctx,
             )
             tracer.count("device_get_calls", 1)
             tracer.count("device_get_bytes", nbytes)

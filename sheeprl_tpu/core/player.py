@@ -47,6 +47,8 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
+from sheeprl_tpu.telemetry import tracer as tracer_mod
+
 AUTO_LATENCY_THRESHOLD_S = 2e-3
 # Above this the host copy of the player parameters costs more than the
 # dispatch latency it saves (and compiles slowly on CPU): stay on the mesh.
@@ -260,25 +262,31 @@ class ParamMirror:
         if self.device is None:  # player on the training device: share arrays
             self._current = params
             return
-        if self._pack_fn is None:
-            self._build_codec(params)
-        packed = self._pack_fn(params)
-        if self.sync == "fresh" or self._transfer is None:
-            # FIFO worker: in fresh mode every push transfers and get() waits
-            # for the newest; replacing the Future reference keeps exactly it.
+        # The trainer->player weight hop is the decoupled seam a distributed
+        # trace needs visible: the span parents to the iteration that
+        # produced these weights.
+        with tracer_mod.current().span("player/mirror_push", "transfer", sync=self.sync):
+            if self._pack_fn is None:
+                self._build_codec(params)
+            packed = self._pack_fn(params)
+            if self.sync == "fresh" or self._transfer is None:
+                # FIFO worker: in fresh mode every push transfers and get()
+                # waits for the newest; replacing the Future reference keeps
+                # exactly it.
+                self._transfer = self._submit(packed)
+                self._next_packed = None
+                return
+            if not self._transfer.done():
+                # Backpressure: keep the in-flight transfer, park THIS
+                # (newest) snapshot in the waiting slot — older waiting
+                # snapshots are the ones dropped, so the newest always lands
+                # eventually.
+                if self._next_packed is not None:
+                    self.skipped += 1
+                self._next_packed = packed
+                return
+            self._promote()
             self._transfer = self._submit(packed)
-            self._next_packed = None
-            return
-        if not self._transfer.done():
-            # Backpressure: keep the in-flight transfer, park THIS (newest)
-            # snapshot in the waiting slot — older waiting snapshots are the
-            # ones dropped, so the newest always lands eventually.
-            if self._next_packed is not None:
-                self.skipped += 1
-            self._next_packed = packed
-            return
-        self._promote()
-        self._transfer = self._submit(packed)
 
     def get(self) -> Any:
         if self.device is not None:
@@ -292,8 +300,9 @@ class ParamMirror:
         are reported for the trained weights, not a stale mirror.
         """
         if self.device is not None:
-            while self._transfer is not None or self._next_packed is not None:
-                self._promote(wait=True)
+            with tracer_mod.current().span("player/mirror_flush", "transfer"):
+                while self._transfer is not None or self._next_packed is not None:
+                    self._promote(wait=True)
         return self._current
 
     def close(self) -> None:

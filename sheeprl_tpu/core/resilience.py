@@ -206,6 +206,15 @@ class PreemptionGuard:
             time.perf_counter() - start,
             {"step": self._policy_step, "signal": int(self._signum or 0)},
         )
+        # The drain checkpoint is the last quiet moment before exit: capture
+        # the flight record of the run's final seconds alongside it.
+        from sheeprl_tpu.telemetry import flight as flight_mod
+
+        flight_mod.dump_on_trip(
+            "resilience/preemption",
+            message=f"preemption drain at step {self._policy_step}",
+            args={"step": self._policy_step, "ckpt_path": ckpt_path},
+        )
 
     def _write_pointer_file(self, ckpt_path: str) -> None:
         pointer = os.path.join(os.path.dirname(os.path.abspath(ckpt_path)), AUTORESUME_NAME)
@@ -494,6 +503,12 @@ def apply_trip_policy(
             faulthandler.dump_traceback(all_threads=True)
         except Exception:  # noqa: BLE001 - forensics must not kill the caller
             pass
+    # Flight dump BEFORE the policy acts: preempt/abort may end the process,
+    # and the merged dump (this process + every spilled worker) is the
+    # post-mortem record of what tripped.
+    from sheeprl_tpu.telemetry import flight as flight_mod
+
+    flight_mod.dump_on_trip(span_name, message=message, args=dict(args or {}, policy=policy))
     if policy == "preempt":
         os.kill(os.getpid(), signal.SIGTERM)
     elif policy == "abort":

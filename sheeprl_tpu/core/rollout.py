@@ -47,9 +47,15 @@ def ship_rollout(
     """
     import jax
 
+    from sheeprl_tpu.telemetry import trace_context
     from sheeprl_tpu.telemetry.tracer import current as _current_tracer
 
-    with _current_tracer().span("rollout/ship", "transfer"):
+    # The ship site is a cross-process seam (decoupled player -> trainer):
+    # stamp the wire-format traceparent into the span args so the receiving
+    # side of a future infeed transport can adopt the same trace.
+    ctx = trace_context.current()
+    args = {"traceparent": ctx.to_traceparent()} if ctx is not None else {}
+    with _current_tracer().span("rollout/ship", "transfer", **args):
         return _ship_rollout(runtime, local_data, flat_keys, next_obs_np, share_data, jax)
 
 

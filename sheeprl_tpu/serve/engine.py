@@ -44,6 +44,8 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from sheeprl_tpu.serve.artifact import PolicyArtifact, load_artifact, make_policy
+from sheeprl_tpu.telemetry import flight as flight_mod
+from sheeprl_tpu.telemetry import trace_context
 from sheeprl_tpu.telemetry import tracer as tracer_mod
 from sheeprl_tpu.telemetry.registry import MetricsRegistry
 
@@ -84,6 +86,11 @@ class _Request:
     deadline_t: Optional[float]  # absolute monotonic deadline, None = no deadline
     future: Future
     t_submit: float
+    # Causality: the trace context active on the SUBMITTING thread (contextvars
+    # do not cross into the dispatcher thread, so it rides on the request) plus
+    # the caller-facing request id for the access log.
+    ctx: Optional[trace_context.TraceContext] = None
+    request_id: Optional[str] = None
 
 
 @dataclass
@@ -136,6 +143,15 @@ class InferenceEngine:
         # bucket -> [requests_served, batches] for mean-occupancy reporting.
         self._occupancy: Dict[int, List[int]] = {}
         self._ewma_service_s: Optional[float] = None
+        # Serve processes have no JaxEventMonitor; the module listeners still
+        # mirror compile/retrace/cache traffic into the default registry so
+        # ``/metrics`` shows the jax/* counters (warm-up compiles included).
+        try:
+            from sheeprl_tpu.telemetry import jax_events
+
+            jax_events.install_listeners()
+        except Exception:  # noqa: BLE001 - metrics bridge must not block serving
+            pass
         if autostart:
             self.start()
 
@@ -258,6 +274,7 @@ class InferenceEngine:
         seed: int = 0,
         session: Optional[str] = None,
         deadline_s: Optional[float] = None,
+        request_id: Optional[str] = None,
     ) -> Future:
         """Enqueue one observation; returns a Future resolving to the action
         row (numpy). Raises KeyError (unknown model), ValueError (bad mode /
@@ -281,6 +298,15 @@ class InferenceEngine:
         if deadline_s is not None and self.estimated_wait_s() > float(deadline_s):
             self._count("sheds")
             tracer_mod.current().count("serve_sheds", 1)
+            flight_mod.dump_on_trip(
+                "engine_overload",
+                message=f"deadline shed: estimated wait {self.estimated_wait_s():.3f}s",
+                args={
+                    "queue_depth": len(self._queue),
+                    "capacity": self.queue_capacity,
+                    "request_id": request_id,
+                },
+            )
             raise EngineOverloaded(
                 f"estimated wait {self.estimated_wait_s():.3f}s exceeds the request "
                 f"deadline {float(deadline_s):.3f}s",
@@ -296,21 +322,38 @@ class InferenceEngine:
             deadline_t=(time.monotonic() + float(deadline_s)) if deadline_s is not None else None,
             future=fut,
             t_submit=time.perf_counter(),
+            ctx=trace_context.current(),
+            request_id=request_id,
         )
+        overloaded: Optional[EngineOverloaded] = None
         with self._cv:
             if self._stop:
                 raise EngineClosed("engine is shutting down")
             if len(self._queue) >= self.queue_capacity:
                 self._count("sheds")
                 tracer_mod.current().count("serve_sheds", 1)
-                raise EngineOverloaded(
+                overloaded = EngineOverloaded(
                     f"request queue is full ({self.queue_capacity})",
                     retry_after_s=max(self.estimated_wait_s(), 0.05),
                 )
-            self._queue.append(req)
-            self._count("requests")
-            self._queue_depth_gauge.set(float(len(self._queue)))
-            self._cv.notify_all()
+            else:
+                self._queue.append(req)
+                self._count("requests")
+                self._queue_depth_gauge.set(float(len(self._queue)))
+                self._cv.notify_all()
+        if overloaded is not None:
+            # Flight dump OUTSIDE the lock: the recorder merges spill files on
+            # a trip, which must not stall the dispatcher or other submitters.
+            flight_mod.dump_on_trip(
+                "engine_overload",
+                message=f"queue-full shed ({self.queue_capacity} queued)",
+                args={
+                    "queue_depth": self.queue_capacity,
+                    "capacity": self.queue_capacity,
+                    "request_id": request_id,
+                },
+            )
+            raise overloaded
         return fut
 
     def act(
@@ -328,6 +371,34 @@ class InferenceEngine:
         return self.submit(
             model, obs, mode=mode, seed=seed, session=session, deadline_s=deadline_s
         ).result(timeout=timeout)
+
+    def act_with_info(
+        self,
+        model: str,
+        obs: Any,
+        *,
+        mode: str = "greedy",
+        seed: int = 0,
+        session: Optional[str] = None,
+        deadline_s: Optional[float] = None,
+        timeout: Optional[float] = 30.0,
+        request_id: Optional[str] = None,
+    ) -> "tuple[np.ndarray, Dict[str, Any]]":
+        """``act`` plus the per-request dispatch info (bucket, queue-wait,
+        trace ids) the server's access log wants. The info dict is stamped on
+        the future by the dispatcher before the result is set."""
+        fut = self.submit(
+            model,
+            obs,
+            mode=mode,
+            seed=seed,
+            session=session,
+            deadline_s=deadline_s,
+            request_id=request_id,
+        )
+        action = fut.result(timeout=timeout)
+        info = dict(getattr(fut, "request_info", None) or {})
+        return action, info
 
     def new_session_id(self) -> str:
         return uuid.uuid4().hex
@@ -399,6 +470,7 @@ class InferenceEngine:
     def _dispatch_batch(self, batch: List[_Request]) -> None:
         import jax
 
+        t_dispatch = time.perf_counter()  # queue-wait ends here for every row
         now = time.monotonic()
         live: List[_Request] = []
         for req in batch:
@@ -436,6 +508,7 @@ class InferenceEngine:
         start = time.perf_counter()
         try:
             actions, new_state = model.applies[mode](model.adapter.params, obs, seeds, state)
+            t_apply = time.perf_counter()
             # ONE coalesced host transfer per batch: the action rows. Session
             # states stay on device (sliced lazily below).
             host_actions = np.asarray(jax.device_get(actions))
@@ -446,6 +519,8 @@ class InferenceEngine:
                 req.future.set_exception(err)
             return
         elapsed = time.perf_counter() - start
+        device_s = t_apply - start  # dispatch + (sync backends) execute
+        harvest_s = elapsed - device_s  # device_get: where async backends block
         if model.adapter.stateful:
             for i, req in enumerate(live):
                 model.sessions[req.session] = jax.tree_util.tree_map(lambda x: x[i], new_state)
@@ -458,13 +533,38 @@ class InferenceEngine:
         occ[0] += len(live)
         occ[1] += 1
 
+        # Causality: every request span is a child of ITS caller's trace (the
+        # context captured at submit — contextvars don't reach this thread),
+        # and the batch span carries ``links`` naming each request it padded
+        # in, so a request id resolves to the exact batch that served it.
+        req_ctxs: List[Optional[trace_context.TraceContext]] = [
+            req.ctx.child() if req.ctx is not None else None for req in live
+        ]
+        batch_parent = next((c for c in req_ctxs if c is not None), None)
+        batch_ctx = trace_context.mint(batch_parent)
+        links = [
+            {
+                "request_id": req.request_id,
+                "trace_id": rctx.trace_id if rctx is not None else None,
+                "span_id": rctx.span_id if rctx is not None else None,
+            }
+            for req, rctx in zip(live, req_ctxs)
+        ]
+
         trc = tracer_mod.current()
         trc.add_span(
             "serve/batch",
             "serve",
             start,
             elapsed,
-            {"model": model.name, "mode": mode, "bucket": bucket, "occupancy": len(live)},
+            {
+                "model": model.name,
+                "mode": mode,
+                "bucket": bucket,
+                "occupancy": len(live),
+                "links": links,
+            },
+            ctx=batch_ctx,
         )
         trc.count("serve_batches", 1)
         trc.count("serve_requests_served", len(live))
@@ -478,6 +578,26 @@ class InferenceEngine:
         done = time.perf_counter()
         for i, req in enumerate(live):
             self.latency.record(done - req.t_submit)
+            queue_wait_s = max(t_dispatch - req.t_submit, 0.0)
+            info = {
+                "request_id": req.request_id,
+                "bucket": bucket,
+                "queue_wait_s": queue_wait_s,
+                "device_s": device_s,
+                "harvest_s": harvest_s,
+                "batch_span": batch_ctx.span_id,
+                "batch_trace": batch_ctx.trace_id,
+            }
+            trc.add_span(
+                "serve/request",
+                "serve",
+                req.t_submit,
+                done - req.t_submit,
+                dict(info),
+                ctx=req_ctxs[i],
+            )
+            # Stamped BEFORE set_result so act_with_info sees it on wake.
+            req.future.request_info = info  # type: ignore[attr-defined]
             req.future.set_result(host_actions[i])
 
     # ----------------------------------------------------------------- stats

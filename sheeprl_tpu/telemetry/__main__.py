@@ -1,13 +1,20 @@
-"""``python -m sheeprl_tpu.telemetry tail <logdir>`` — live run inspection.
+"""``python -m sheeprl_tpu.telemetry`` — run inspection CLIs.
 
-Renders the current health and throughput of a (possibly still running)
-run straight from its ``telemetry.jsonl``: the meta line, the most recent
-counters interval (with the host-computed ``*_per_s`` rates when present),
-every ``health/*`` gauge, and the trailing health events. Pure stdlib and
-read-only — it tails the JSONL the run is appending to, so it works over
-ssh against a live job with no port, no server, and no imports of jax.
+``tail <logdir>`` renders the current health and throughput of a (possibly
+still running) run straight from its ``telemetry.jsonl``: the meta line,
+the most recent counters interval (with the host-computed ``*_per_s``
+rates when present), every ``health/*`` gauge, and the trailing health
+events. Pure stdlib and read-only — it tails the JSONL the run is
+appending to, so it works over ssh against a live job with no port, no
+server, and no imports of jax. ``--follow`` re-renders every
+``--interval`` seconds until interrupted.
 
-``--follow`` re-renders every ``--interval`` seconds until interrupted.
+``flight <logdir>`` is the post-mortem side: it lists every flight dump
+under the log dir (trip reason, processes, span counts, trace IDs), shows
+one dump in detail, and with ``--merge OUT`` writes the cross-process
+aggregated trace (every ``trace.json``, flight dump, and spill file under
+the dir, rebased onto one wall-clock timeline; ``--trace`` filters to one
+trace ID). The merged file loads in Perfetto like a single-process trace.
 """
 
 from __future__ import annotations
@@ -130,6 +137,107 @@ def tail(path: str, follow: bool = False, interval: float = 2.0, out: Any = None
             return 0
 
 
+def find_flight_dumps(path: str) -> List[str]:
+    """Every ``flight_*.json`` under ``path``, newest last."""
+    dumps: List[str] = []
+    if os.path.isfile(path):
+        return [path]
+    for root, _dirs, files in os.walk(path):
+        for name in files:
+            if name.startswith("flight_") and name.endswith(".json"):
+                dumps.append(os.path.join(root, name))
+    return sorted(dumps, key=os.path.getmtime)
+
+
+def _load_dump(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(path) as fp:
+            doc = json.load(fp)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
+def render_flight_summary(path: str, doc: Dict[str, Any]) -> str:
+    processes: Dict[str, Any] = doc.get("processes") or {}
+    spans = sum(int(p.get("spans", 0)) for p in processes.values())
+    events = sum(int(p.get("events", 0)) for p in processes.values())
+    when = time.strftime("%Y-%m-%d %H:%M:%S", time.localtime(doc.get("wall_s", 0)))
+    return (
+        f"{path}\n  reason={doc.get('reason', '?')} at {when} (pid {doc.get('pid', '?')})"
+        f"  processes={len(processes)} spans={spans} events={events}"
+        f" trace_ids={len(doc.get('trace_ids') or {})}"
+    )
+
+
+def render_flight_detail(doc: Dict[str, Any], max_traces: int = 8) -> str:
+    lines: List[str] = []
+    lines.append(f"reason:  {doc.get('reason', '?')}")
+    if doc.get("message"):
+        lines.append(f"message: {doc['message']}")
+    lines.append(f"tripped by pid {doc.get('pid', '?')}")
+    processes: Dict[str, Any] = doc.get("processes") or {}
+    lines.append(f"processes ({len(processes)}):")
+    for pid in sorted(processes, key=lambda p: int(p) if str(p).isdigit() else 0):
+        proc = processes[pid]
+        info = proc.get("run_info") or {}
+        label = " ".join(f"{k}={v}" for k, v in sorted(info.items())) or "-"
+        lines.append(
+            f"  pid {pid:<8} {label:<32} spans={proc.get('spans', 0)} events={proc.get('events', 0)}"
+        )
+        metrics = proc.get("metrics") or {}
+        counters = metrics.get("counters") or {}
+        for name in sorted(counters)[:6]:
+            lines.append(f"    {name:<34} {_fmt_value(counters[name])}")
+    trace_ids: Dict[str, int] = doc.get("trace_ids") or {}
+    if trace_ids:
+        ranked = sorted(trace_ids.items(), key=lambda kv: -kv[1])
+        lines.append(f"trace ids ({len(trace_ids)} distinct, top {min(max_traces, len(ranked))}):")
+        for tid, count in ranked[:max_traces]:
+            lines.append(f"  {tid}  spans/events: {count}")
+    return "\n".join(lines) + "\n"
+
+
+def flight(
+    path: str,
+    merge: Optional[str] = None,
+    trace_id: Optional[str] = None,
+    show: Optional[str] = None,
+    out: Any = None,
+) -> int:
+    out = out if out is not None else sys.stdout
+    if merge is not None:
+        # The only subcommand path that imports beyond the stdlib — and even
+        # this stays jax-free (flight.aggregate_traces is pure file merging).
+        from sheeprl_tpu.telemetry.flight import aggregate_traces
+
+        doc = aggregate_traces(path, trace_id=trace_id)
+        with open(merge, "w") as fp:
+            json.dump(doc, fp)
+        meta = doc.get("metadata") or {}
+        out.write(
+            f"merged {len(doc.get('traceEvents') or [])} events from "
+            f"{len(meta.get('sources') or [])} sources into {merge}\n"
+        )
+        if meta.get("trace_ids"):
+            out.write(f"trace ids seen: {len(meta['trace_ids'])}\n")
+        return 0
+    dumps = find_flight_dumps(path)
+    if not dumps:
+        print(f"no flight_*.json found under {path!r} (nothing tripped yet?)", file=sys.stderr)
+        return 1
+    target = show or dumps[-1]
+    for dump_path in dumps:
+        doc = _load_dump(dump_path)
+        if doc is not None:
+            out.write(render_flight_summary(dump_path, doc) + "\n")
+    doc = _load_dump(target)
+    if doc is not None:
+        out.write(f"\n== {target} ==\n")
+        out.write(render_flight_detail(doc))
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m sheeprl_tpu.telemetry",
@@ -140,9 +248,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_tail.add_argument("logdir", help="telemetry.jsonl path, a run dir, or any ancestor (newest run wins)")
     p_tail.add_argument("--follow", "-f", action="store_true", help="re-render until interrupted")
     p_tail.add_argument("--interval", type=float, default=2.0, help="seconds between renders with --follow")
+    p_flight = sub.add_parser("flight", help="list/inspect flight dumps; --merge writes the cross-process trace")
+    p_flight.add_argument("logdir", help="a run dir (or any ancestor) holding flight_*.json dumps")
+    p_flight.add_argument("--show", help="specific dump to detail (default: the newest)")
+    p_flight.add_argument("--merge", metavar="OUT", help="write the merged cross-process trace JSON here")
+    p_flight.add_argument("--trace", dest="trace_id", help="with --merge: keep only this trace id")
     args = parser.parse_args(argv)
     if args.command == "tail":
         return tail(args.logdir, follow=args.follow, interval=args.interval)
+    if args.command == "flight":
+        return flight(args.logdir, merge=args.merge, trace_id=args.trace_id, show=args.show)
     parser.error(f"unknown command {args.command!r}")  # pragma: no cover
     return 2
 

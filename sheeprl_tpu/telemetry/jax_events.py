@@ -45,19 +45,42 @@ _ACTIVE: List["JaxEventMonitor"] = []
 _LISTENERS_INSTALLED = False
 
 
+def _registry_count(name: str, amount: float = 1.0) -> None:
+    """Mirror a compiler event into the process default MetricsRegistry.
+
+    The ``jax/`` prefix keeps these distinct from the *gauge* mirrors that
+    ``Telemetry.log_counters`` derives from monitor counters (``compiles``
+    etc.) — a registry name can hold one kind only. This is the bridge that
+    puts compile/retrace/cache traffic on ``/metrics`` and the telemetry
+    tail for EVERY process with the listeners installed (serve included),
+    monitor attached or not.
+    """
+    try:
+        from sheeprl_tpu.telemetry.registry import default_registry
+
+        default_registry().counter(name).inc(amount)
+    except Exception:  # noqa: BLE001 - metrics must never break a compile
+        pass
+
+
 def _on_event(event: str, **kwargs: Any) -> None:
     key = _CACHE_COUNT_EVENTS.get(event)
     if key is None:
         return
+    _registry_count(f"jax/{key}")
     for monitor in list(_ACTIVE):
         monitor.counters[key] = monitor.counters.get(key, 0.0) + 1.0
 
 
 def _on_event_duration(event: str, duration_secs: float, **kwargs: Any) -> None:
     if event == _BACKEND_COMPILE_EVENT:
+        _registry_count("jax/compiles")
+        _registry_count("jax/compile_secs", float(duration_secs))
         for monitor in list(_ACTIVE):
             monitor._record_compile(duration_secs)
     elif event == _TRACE_EVENT:
+        _registry_count("jax/traces")
+        _registry_count("jax/trace_secs", float(duration_secs))
         for monitor in list(_ACTIVE):
             monitor.counters["traces"] = monitor.counters.get("traces", 0.0) + 1.0
             monitor.counters["trace_secs"] = monitor.counters.get("trace_secs", 0.0) + float(
@@ -74,6 +97,13 @@ def _ensure_listeners() -> None:
     monitoring.register_event_listener(_on_event)
     monitoring.register_event_duration_secs_listener(_on_event_duration)
     _LISTENERS_INSTALLED = True
+
+
+def install_listeners() -> None:
+    """Public, idempotent listener install for processes that never build a
+    :class:`JaxEventMonitor` — the serve engine calls this so inference
+    processes still expose ``jax/*`` compile counters on ``/metrics``."""
+    _ensure_listeners()
 
 
 class JaxEventMonitor:

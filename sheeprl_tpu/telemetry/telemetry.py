@@ -30,6 +30,8 @@ import time
 import warnings
 from typing import Any, Dict, Optional
 
+from sheeprl_tpu.telemetry import flight as flight_mod
+from sheeprl_tpu.telemetry import trace_context
 from sheeprl_tpu.telemetry import tracer as tracer_mod
 from sheeprl_tpu.telemetry.jax_events import JaxEventMonitor
 from sheeprl_tpu.telemetry.profiling import ProfilerWindow
@@ -38,6 +40,7 @@ from sheeprl_tpu.telemetry.tracer import Tracer
 
 CHROME_TRACE_FILENAME = "trace.json"
 JSONL_FILENAME = "telemetry.jsonl"
+FLIGHT_DIRNAME = "flight"
 
 
 class Telemetry:
@@ -54,11 +57,21 @@ class Telemetry:
         profiler_trace_dir: Optional[str] = None,
         profiler_port: Optional[int] = None,
         metrics_port: Optional[int] = None,
+        flight_enabled: bool = True,
+        flight_capacity: int = 4096,
+        flight_spill_interval_s: float = 5.0,
+        flight_min_dump_interval_s: float = 30.0,
     ) -> None:
         self.enabled = bool(enabled)
         self.chrome_trace = bool(chrome_trace)
         self.jsonl = bool(jsonl)
         self.metrics_port = int(metrics_port) if metrics_port is not None else None
+        # Flight recorder knobs: deliberately independent of `enabled` — the
+        # crash ring is always-on unless explicitly switched off.
+        self.flight_enabled = bool(flight_enabled)
+        self.flight_capacity = int(flight_capacity)
+        self.flight_spill_interval_s = float(flight_spill_interval_s)
+        self.flight_min_dump_interval_s = float(flight_min_dump_interval_s)
         self._tracer = Tracer(capacity=buffer_capacity, enabled=self.enabled)
         self._monitor = JaxEventMonitor(
             warmup_iters=warmup_iters, warn_on_recompile=warn_on_recompile
@@ -79,6 +92,13 @@ class Telemetry:
         # Per-interval rate state (log_counters): previous snapshot + time.
         self._prev_counters: Optional[Dict[str, float]] = None
         self._prev_counters_t = 0.0
+        # Trace + flight state (always-on layer, managed by open/close).
+        self._tracing_open = False
+        self._trace_root: Optional[trace_context.TraceContext] = None
+        self._trace_token: Any = None
+        self._carrier_prev: Optional[tuple] = None
+        self._flight: Optional[flight_mod.FlightRecorder] = None
+        self._flight_tracer: Optional[Tracer] = None
 
     # ------------------------------------------------------------- config
     @classmethod
@@ -89,7 +109,12 @@ class Telemetry:
         if not tele:
             return cls(enabled=False)
         prof = tele.get("profiler") or {}
+        fl = tele.get("flight") or {}
         return cls(
+            flight_enabled=bool(fl.get("enabled", True)),
+            flight_capacity=int(fl.get("capacity", 4096)),
+            flight_spill_interval_s=float(fl.get("spill_interval_s", 5.0)),
+            flight_min_dump_interval_s=float(fl.get("min_dump_interval_s", 30.0)),
             enabled=bool(tele.get("enabled", False)),
             buffer_capacity=int(tele.get("buffer_capacity", 65536)),
             warmup_iters=int(tele.get("warmup_iters", 3)),
@@ -115,6 +140,7 @@ class Telemetry:
         self._log_dir = log_dir
         self._rank_zero = bool(rank_zero)
         self._device = device
+        self._open_tracing(log_dir)
         if not self.enabled or self._opened:
             return self
         self._opened = True
@@ -140,27 +166,93 @@ class Telemetry:
                     "backend": jax.default_backend(),
                     "process_index": jax.process_index(),
                     "profiler_window": [self._profiler.start_step, self._profiler.stop_step],
+                    "trace_id": self._trace_root.trace_id if self._trace_root else None,
+                    "pid": os.getpid(),
                 },
                 mode="w",
             )
         return self
+
+    def _open_tracing(self, log_dir: Optional[str]) -> None:
+        """The always-on layer: mint (or adopt) the run's root trace context,
+        publish the env-var carrier BEFORE env worker processes fork, and
+        install the flight recorder. Runs whether or not telemetry is
+        enabled — crash forensics must not depend on someone having turned
+        the profiler on."""
+        if self._tracing_open:
+            return
+        self._tracing_open = True
+        # A valid carrier in the environment means this process is itself a
+        # child of a traced run (a restarted trainer, a spawned peer): join
+        # that trace instead of starting a new one.
+        self._trace_root = trace_context.mint(trace_context.extract_env_carrier())
+        self._trace_token = trace_context.set_current(self._trace_root)
+        trace_dir = os.path.join(log_dir, FLIGHT_DIRNAME) if log_dir else None
+        self._carrier_prev = (
+            os.environ.get(trace_context.TRACEPARENT_ENV),
+            os.environ.get(trace_context.TRACE_DIR_ENV),
+        )
+        trace_context.inject_env_carrier(self._trace_root, trace_dir)
+        if self.flight_enabled:
+            self._flight = flight_mod.FlightRecorder(
+                capacity=self.flight_capacity,
+                trace_dir=trace_dir,
+                spill_interval_s=self.flight_spill_interval_s,
+                min_dump_interval_s=self.flight_min_dump_interval_s,
+                run_info={"role": "trainer"},
+            )
+            flight_mod.install(self._flight)
+            if not self.enabled:
+                # Telemetry off still means a populated crash ring: give the
+                # process a live tracer feeding the flight sink.
+                self._flight_tracer = flight_mod.ensure_live_tracer(
+                    capacity=min(self.flight_capacity, 8192)
+                )
+
+    def _close_tracing(self) -> None:
+        if not self._tracing_open:
+            return
+        self._tracing_open = False
+        if self._flight is not None:
+            flight_mod.uninstall(self._flight)
+            self._flight = None
+        if self._flight_tracer is not None:
+            if tracer_mod.current() is self._flight_tracer:
+                tracer_mod.set_current(None)
+            self._flight_tracer = None
+        if self._carrier_prev is not None:
+            for key, prev in zip(
+                (trace_context.TRACEPARENT_ENV, trace_context.TRACE_DIR_ENV), self._carrier_prev
+            ):
+                if prev is None:
+                    os.environ.pop(key, None)
+                else:
+                    os.environ[key] = prev
+            self._carrier_prev = None
+        if self._trace_token is not None:
+            try:
+                trace_context.reset(self._trace_token)
+            except ValueError:  # closed from a different thread than open
+                trace_context.set_current(None)
+            self._trace_token = None
+        self._trace_root = None
 
     def close(self) -> None:
         """Stop profiling, detach counters, export trace.json/telemetry.jsonl
         (rank zero), and restore the previously-installed tracer."""
         for st in self._step_timers.values():
             st.flush()
-        if not self._opened:
-            return
-        if self._exporter is not None:
-            self._exporter.close()
-            self._exporter = None
-        self._profiler.close()
-        self._monitor.detach()
-        self._export()
-        tracer_mod.set_current(self._previous_tracer)
-        self._previous_tracer = None
-        self._opened = False
+        if self._opened:
+            if self._exporter is not None:
+                self._exporter.close()
+                self._exporter = None
+            self._profiler.close()
+            self._monitor.detach()
+            self._export()
+            tracer_mod.set_current(self._previous_tracer)
+            self._previous_tracer = None
+            self._opened = False
+        self._close_tracing()
 
     # ------------------------------------------------------------ hot path
     def span(self, name: str, category: str = "host", **args: Any):
@@ -191,7 +283,16 @@ class Telemetry:
 
     def advance(self, step: int) -> None:
         """Once per train iteration: drives the profiler window and the
-        recompile-after-warmup watchdog."""
+        recompile-after-warmup watchdog, and rolls the active trace context
+        to a fresh per-iteration child of the run root (so every span this
+        iteration emits — dispatch, fetch, ship, env restarts — parents to
+        one iteration marker)."""
+        if self._trace_root is not None:
+            ctx = self._trace_root.child()
+            trace_context.set_current(ctx)
+            tracer_mod.current().add_span(
+                "loop/iteration", "loop", time.perf_counter(), 0.0, {"step": int(step)}, ctx=ctx
+            )
         if not self.enabled:
             return
         self._profiler.advance(step)
@@ -270,8 +371,25 @@ class Telemetry:
 
     def record_event(self, record: Dict[str, Any]) -> None:
         """Append a structured event record (e.g. a health sentinel event)
-        to telemetry.jsonl. No-op when disabled or not rank zero."""
+        to telemetry.jsonl (no-op when disabled or not rank zero) and to the
+        flight ring (always, so trips see recent health events)."""
+        flight_mod.record_event(dict(record))
         self._append_jsonl(dict(record))
+
+    # ------------------------------------------------------------- tracing
+    @property
+    def trace_root(self) -> Optional[trace_context.TraceContext]:
+        """The run's root trace context (None before open)."""
+        return self._trace_root
+
+    @property
+    def flight(self) -> Optional[flight_mod.FlightRecorder]:
+        return self._flight
+
+    def set_run_info(self, **info: Any) -> None:
+        """Annotate this process in flight dumps (algo name, rank, role)."""
+        if self._flight is not None:
+            self._flight.run_info.update(info)
 
     # ------------------------------------------------------------- export
     def _jsonl_path(self) -> Optional[str]:

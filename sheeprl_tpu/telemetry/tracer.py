@@ -22,6 +22,14 @@ A process-wide "current tracer" hangs off this module (``current()`` /
 ``set_current()``) so low-level code (utils/timer, core/rollout, the replay
 infeed) can emit spans without threading a tracer object through every
 signature; the default is a shared disabled tracer.
+
+Since PR 11 every span carries a :mod:`~sheeprl_tpu.telemetry.trace_context`
+identity (trace_id / span_id / parent_id): ``span()`` derives a child of the
+active context on entry and restores the parent on exit, so causality falls
+out of ordinary ``with`` nesting, and ``add_span`` accepts an explicit
+``ctx=`` for work completed on another thread. A module-level flight sink
+(see :mod:`~sheeprl_tpu.telemetry.flight`) observes every recorded span so
+the crash-time ring stays populated without a second emission path.
 """
 
 from __future__ import annotations
@@ -31,7 +39,9 @@ import os
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, Iterator, List, Optional, Tuple
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+from sheeprl_tpu.telemetry import trace_context
 
 _US = 1e6  # seconds -> microseconds (the trace-event timestamp unit)
 
@@ -39,7 +49,7 @@ _US = 1e6  # seconds -> microseconds (the trace-event timestamp unit)
 class Span:
     """One completed region: host wall-clock, perf_counter timebase."""
 
-    __slots__ = ("name", "category", "start_s", "duration_s", "args")
+    __slots__ = ("name", "category", "start_s", "duration_s", "args", "trace_id", "span_id", "parent_id")
 
     def __init__(
         self,
@@ -48,12 +58,18 @@ class Span:
         start_s: float,
         duration_s: float,
         args: Optional[Dict[str, Any]] = None,
+        trace_id: Optional[str] = None,
+        span_id: Optional[str] = None,
+        parent_id: Optional[str] = None,
     ) -> None:
         self.name = name
         self.category = category
         self.start_s = start_s
         self.duration_s = duration_s
         self.args = args
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
         return f"Span({self.name!r}, cat={self.category!r}, dur={self.duration_s * 1e3:.3f}ms)"
@@ -61,9 +77,14 @@ class Span:
 
 class _SpanContext:
     """Context manager returned by :meth:`Tracer.span`. Reentrant-safe: a new
-    instance per ``span()`` call, so nesting the same name is fine."""
+    instance per ``span()`` call, so nesting the same name is fine.
 
-    __slots__ = ("_tracer", "_name", "_category", "_args", "_start")
+    On entry it derives a child of the active :class:`TraceContext` (when one
+    is installed) and makes it current, so spans opened inside this block
+    parent to this span; the token restores the parent context on exit even
+    when the body raises."""
+
+    __slots__ = ("_tracer", "_name", "_category", "_args", "_start", "_ctx", "_token")
 
     def __init__(self, tracer: "Tracer", name: str, category: str, args: Optional[Dict[str, Any]]):
         self._tracer = tracer
@@ -71,14 +92,24 @@ class _SpanContext:
         self._category = category
         self._args = args
         self._start = 0.0
+        self._ctx: Optional[trace_context.TraceContext] = None
+        self._token = None
 
     def __enter__(self) -> "_SpanContext":
+        parent = trace_context.current()
+        if parent is not None:
+            self._ctx = parent.child()
+            self._token = trace_context.set_current(self._ctx)
         self._start = time.perf_counter()
         return self
 
     def __exit__(self, *exc_info: Any) -> None:
+        duration = time.perf_counter() - self._start
+        if self._token is not None:
+            trace_context.reset(self._token)
+            self._token = None
         self._tracer.add_span(
-            self._name, self._category, self._start, time.perf_counter() - self._start, self._args
+            self._name, self._category, self._start, duration, self._args, ctx=self._ctx
         )
 
 
@@ -107,8 +138,11 @@ class Tracer:
         self._gauge_names: set = set()
         self.dropped = 0
         # perf_counter epoch: trace timestamps are relative to tracer birth
-        # (perf_counter's absolute origin is unspecified).
+        # (perf_counter's absolute origin is unspecified). The wall-clock
+        # twin, captured at the same instant, anchors exported traces to
+        # real time so the cross-process aggregator can align timelines.
         self._epoch = time.perf_counter()
+        self._epoch_wall = time.time()
 
     # ------------------------------------------------------------ recording
     def span(self, name: str, category: str = "host", **args: Any):
@@ -125,14 +159,41 @@ class Tracer:
         start_s: float,
         duration_s: float,
         args: Optional[Dict[str, Any]] = None,
+        ctx: Optional[trace_context.TraceContext] = None,
     ) -> None:
-        """Record an already-measured span (start in perf_counter seconds)."""
+        """Record an already-measured span (start in perf_counter seconds).
+
+        ``ctx`` carries the span's trace identity. Pass it explicitly for
+        work whose causal origin is another thread (the serve dispatcher
+        finishing a request, an async fetch harvested later); when omitted,
+        the span is stamped as a fresh child of the caller's active context.
+        """
         if not self.enabled:
             return
+        if ctx is None:
+            parent = trace_context.current()
+            if parent is not None:
+                ctx = parent.child()
+        span = Span(
+            name,
+            category,
+            start_s,
+            duration_s,
+            args,
+            trace_id=ctx.trace_id if ctx is not None else None,
+            span_id=ctx.span_id if ctx is not None else None,
+            parent_id=ctx.parent_id if ctx is not None else None,
+        )
         with self._lock:
             if len(self._spans) == self._spans.maxlen:
                 self.dropped += 1
-            self._spans.append(Span(name, category, start_s, duration_s, args))
+            self._spans.append(span)
+        sink = _flight_sink
+        if sink is not None:
+            try:
+                sink(span)
+            except Exception:  # noqa: BLE001 - forensics must never break the run
+                pass
 
     def count(self, name: str, value: float = 1.0) -> None:
         """Accumulate a named counter (monotonic within a run)."""
@@ -200,8 +261,14 @@ class Tracer:
                 "pid": pid,
                 "tid": tid,
             }
-            if s.args:
-                ev["args"] = s.args
+            args = dict(s.args) if s.args else {}
+            if s.trace_id is not None:
+                args["trace_id"] = s.trace_id
+                args["span_id"] = s.span_id
+                if s.parent_id is not None:
+                    args["parent_id"] = s.parent_id
+            if args:
+                ev["args"] = args
             events.append(ev)
         # Track-name metadata: one M event per category track.
         for cat, tid in categories.items():
@@ -228,7 +295,11 @@ class Tracer:
                         "args": {"value": value},
                     }
                 )
-        return {"traceEvents": events, "displayTimeUnit": "ms"}
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "metadata": {"pid": pid, "wall_epoch_s": self._epoch_wall},
+        }
 
     def export_chrome(self, path: str) -> str:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -246,11 +317,32 @@ class Tracer:
                 "ts_us": round(self._ts_us(s.start_s), 3),
                 "dur_us": round(s.duration_s * _US, 3),
             }
+            if s.trace_id is not None:
+                rec["trace_id"] = s.trace_id
+                rec["span_id"] = s.span_id
+                if s.parent_id is not None:
+                    rec["parent_id"] = s.parent_id
             if s.args:
                 rec["args"] = s.args
             yield json.dumps(rec)
         for name, value in sorted(self.counters().items()):
             yield json.dumps({"type": "counter", "name": name, "value": value})
+
+
+# ------------------------------------------------------------- flight sink
+# The flight recorder (telemetry/flight.py) registers a callable here and
+# observes every span any tracer records — one emission path feeds both the
+# export ring and the crash-time ring. Registered lazily to avoid an import
+# cycle (flight imports this module).
+_flight_sink: Optional[Callable[[Span], None]] = None
+
+
+def set_flight_sink(sink: Optional[Callable[[Span], None]]) -> Optional[Callable[[Span], None]]:
+    """Install the span observer (None to remove); returns the previous one."""
+    global _flight_sink
+    previous = _flight_sink
+    _flight_sink = sink
+    return previous
 
 
 # --------------------------------------------------------------- current()

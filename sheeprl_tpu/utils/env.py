@@ -296,6 +296,18 @@ def make_vector_env(
         from sheeprl_tpu.core.chaos import wrap_env_thunks
 
         thunks = wrap_env_thunks(thunks, chaos_cfg.get("injectors") or [], base)
+    tele_cfg = cfg.get("telemetry") or {}
+    flight_cfg = (tele_cfg.get("flight") or {}) if hasattr(tele_cfg, "get") else {}
+    if bool(flight_cfg.get("enabled", True)):
+        # Distributed tracing (telemetry/flight.py): the thunk runs INSIDE
+        # the worker process (async mode), where it adopts the env-var trace
+        # carrier published by Telemetry.open and spills step-window spans —
+        # the cross-process half of every flight dump. Because supervisor
+        # restarts rebuild slices from these same thunks, restarted worker
+        # generations rejoin the trace automatically.
+        from sheeprl_tpu.telemetry.flight import traced_env_thunk
+
+        thunks = [traced_env_thunk(t, base + i) for i, t in enumerate(thunks)]
     cls = gym.vector.SyncVectorEnv if cfg.env.sync_env else gym.vector.AsyncVectorEnv
     slices = int(cfg.env.get("pipeline_slices", 1) or 1)
     sup_cfg = res_cfg.get("supervisor") or {}

@@ -1,0 +1,49 @@
+"""GL008 true positives: spans that vanish (or leak their trace context) the
+moment the guarded region raises — discarded span calls, manual __enter__
+without a finally-guarded __exit__, and spans bound but never entered."""
+
+
+def discarded_span(tracer, payload):
+    # Bare call: the context manager is never entered, nothing records.
+    tracer.span("rollout/ship", "transfer")  # <- GL008
+    ship(payload)
+
+
+def manual_enter_unguarded_exit(tracer, batch):
+    # An exception in train() skips __exit__: the span never reaches the
+    # ring AND the child trace context stays installed for the thread.
+    span = tracer.span("train/step", "train")  # <- GL008
+    span.__enter__()
+    loss = train(batch)
+    span.__exit__(None, None, None)
+    return loss
+
+
+def exit_outside_finally(telemetry, fn):
+    # The except clause only covers ValueError; any other exception leaks.
+    cm = telemetry.span("io/save")  # <- GL008
+    cm.__enter__()
+    try:
+        fn()
+    except ValueError:
+        pass
+    cm.__exit__(None, None, None)
+
+
+def bound_and_dropped(self_tracer_holder, n):
+    pending = self_tracer_holder.tracer.span("fetch/harvest", "transfer")  # <- GL008
+    for _ in range(n):
+        poll()
+    return n
+
+
+def ship(payload):
+    return payload
+
+
+def train(batch):
+    return batch
+
+
+def poll():
+    return None

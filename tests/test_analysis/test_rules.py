@@ -9,7 +9,7 @@ from sheeprl_tpu.analysis import lint_file
 
 FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
 
-ALL_RULE_IDS = ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007")
+ALL_RULE_IDS = ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007", "GL008")
 
 
 def _lint_fixture(name):

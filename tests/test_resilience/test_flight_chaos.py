@@ -1,0 +1,134 @@
+"""Distributed-tracing chaos scenario driven through the CLI: a chaos
+``delayed_fetch`` stalls the action harvest inside the watchdog's armed
+window in a supervised async-env run, the watchdog trips (on_trip=warn),
+and the flight recorder writes ONE merged dump whose spans come from at
+least two processes — the trainer and its forked env workers — correlated
+under the run's single root trace ID. This is the acceptance scenario for
+the cross-process tracing + flight-recorder subsystem."""
+
+import glob
+import json
+import os
+
+import pytest
+
+from sheeprl_tpu.cli import run
+from sheeprl_tpu.core import chaos
+
+pytestmark = pytest.mark.chaos
+
+DELAY_S = 1.5
+WATCHDOG_TIMEOUT_S = 0.25
+INJECT_STEP = 8
+
+
+@pytest.fixture(autouse=True)
+def _isolated(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def _find_dumps(root):
+    return sorted(
+        glob.glob(os.path.join(root, "logs", "runs", "**", "flight", "flight_*.json"), recursive=True),
+        key=os.path.getmtime,
+    )
+
+
+def test_delayed_fetch_trip_dumps_a_multiprocess_trace(tmp_path):
+    run(
+        [
+            "exp=sac",
+            "env=dummy",
+            "env.id=continuous_dummy",
+            "env.wrapper.id=continuous_dummy",
+            "metric.log_level=1",
+            "metric.log_every=4",
+            "env.num_envs=2",
+            # Async vector env: the env thunks run in FORKED WORKER
+            # PROCESSES, which must adopt the env-var trace carrier and
+            # spill their spans for the trainer's dump to merge.
+            "env.sync_env=False",
+            "env.capture_video=False",
+            "algo.per_rank_batch_size=4",
+            "algo.learning_starts=4",
+            "algo.hidden_size=8",
+            "algo.run_test=False",
+            "algo.total_steps=48",
+            "buffer.memmap=False",
+            "buffer.size=64",
+            "buffer.checkpoint=False",
+            "checkpoint.every=0",
+            "fabric.accelerator=cpu",
+            "telemetry.enabled=True",
+            # XLA compiles also outlive the short watchdog deadline on CPU,
+            # so the dispatch guard trips too; drop the dump rate limit so
+            # the delayed_fetch trip is never shadowed by a compile trip.
+            "telemetry.flight.min_dump_interval_s=0.05",
+            "resilience.supervisor.enabled=True",
+            "resilience.watchdog.enabled=True",
+            f"resilience.watchdog.timeout_s={WATCHDOG_TIMEOUT_S}",
+            "resilience.watchdog.on_trip=warn",
+            "resilience.chaos.enabled=True",
+            "resilience.chaos.injectors="
+            f"[{{kind: delayed_fetch, seconds: {DELAY_S}, at_step: {INJECT_STEP}}}]",
+        ]
+    )
+
+    dumps = _find_dumps(str(tmp_path))
+    assert dumps, "watchdog trip produced no flight dump"
+    # Select the delayed_fetch-induced dump: its trip instant carries the
+    # fetch guard's label (compile-time dispatch trips may also dump).
+    doc = None
+    for path in dumps:
+        candidate = json.load(open(path))
+        trip_evs = [
+            ev for ev in candidate["traceEvents"] if ev["ph"] == "i" and ev["cat"] == "trip"
+        ]
+        if any(ev["args"].get("label", "").startswith("fetch/") for ev in trip_evs):
+            doc = candidate
+    assert doc is not None, f"no dump from the delayed_fetch trip among {dumps}"
+    assert doc["reason"] == "resilience/watchdog_trip"
+    assert "exceeded" in doc["message"]
+
+    # ≥2 processes contributed SPANS (trainer + at least one env worker).
+    with_spans = {pid: p for pid, p in doc["processes"].items() if p["spans"] > 0}
+    assert len(with_spans) >= 2, f"single-process dump: {doc['processes']}"
+    roles = {p["run_info"].get("role") for p in doc["processes"].values()}
+    assert {"trainer", "env_worker"} <= roles
+
+    # One trace ID spans ≥2 distinct pids — the run root published via the
+    # env carrier and adopted by every forked worker.
+    pids_by_trace = {}
+    for ev in doc["traceEvents"]:
+        if ev.get("ph") != "X":
+            continue
+        trace_id = (ev.get("args") or {}).get("trace_id")
+        if trace_id:
+            pids_by_trace.setdefault(trace_id, set()).add(ev["pid"])
+    multi = {tid: pids for tid, pids in pids_by_trace.items() if len(pids) >= 2}
+    assert multi, f"no trace id spans multiple processes: { {t: sorted(p) for t, p in pids_by_trace.items()} }"
+
+    # That shared trace is the run root recorded in telemetry.jsonl's meta.
+    jsonls = glob.glob(
+        os.path.join(str(tmp_path), "logs", "runs", "**", "telemetry.jsonl"), recursive=True
+    )
+    assert jsonls
+    meta = json.loads(open(jsonls[-1]).readline())
+    assert meta["type"] == "meta"
+    assert meta["trace_id"] in multi
+
+    # Perfetto-loadable: a trace-event doc with only known phases, numeric
+    # timestamps, and per-process track metadata.
+    assert doc["displayTimeUnit"] == "ms"
+    assert any(ev["ph"] == "M" and ev["name"] == "process_name" for ev in doc["traceEvents"])
+    for ev in doc["traceEvents"]:
+        assert ev["ph"] in ("X", "i", "M")
+        if ev["ph"] != "M":
+            assert isinstance(ev["ts"], float)
+
+    # The trip instant itself is in the ring, with the watchdog's label.
+    trips = [ev for ev in doc["traceEvents"] if ev["ph"] == "i" and ev["cat"] == "trip"]
+    assert any(ev["args"].get("label", "").startswith("fetch/") for ev in trips)

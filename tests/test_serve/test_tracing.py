@@ -1,0 +1,180 @@
+"""Serve causality tests: a client's traceparent/X-Request-Id survive the
+queue and reappear on the engine's batch span (links) and on every reply —
+success AND error paths — plus the structured access log."""
+
+import json
+import logging
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from sheeprl_tpu.serve.engine import InferenceEngine
+from sheeprl_tpu.serve.server import PolicyServer
+from sheeprl_tpu.telemetry import trace_context as tc
+from sheeprl_tpu.telemetry import tracer as tracer_mod
+
+from tests.test_serve.test_engine import EchoAdapter
+
+pytestmark = pytest.mark.serve
+
+CLIENT_TRACE = "ab" * 16  # 32 hex chars
+CLIENT_SPAN = "cd" * 8  # 16 hex chars
+CLIENT_TRACEPARENT = f"00-{CLIENT_TRACE}-{CLIENT_SPAN}-01"
+
+
+@pytest.fixture
+def served():
+    eng = InferenceEngine(max_batch=4, batch_window_s=0.0)
+    eng.host("echo", EchoAdapter(), warmup=False)
+    server = PolicyServer(eng, host="127.0.0.1", port=0).start()
+    yield server
+    server.close()
+
+
+def _post_raw(server, path, payload, headers=None):
+    req = urllib.request.Request(
+        server.address + path,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.status, dict(resp.headers), json.loads(resp.read())
+
+
+def _act(server, headers=None):
+    return _post_raw(
+        server, "/v1/act", {"model": "echo", "obs": {"x": [1, 2, 3, 4]}, "seed": 5}, headers
+    )
+
+
+def test_client_traceparent_reappears_on_the_batch_span(served):
+    status, headers, body = _act(
+        served, {"traceparent": CLIENT_TRACEPARENT, "X-Request-Id": "req-42"}
+    )
+    assert status == 200
+    # Echoed identity on the reply...
+    assert headers["X-Request-Id"] == "req-42"
+    assert body["request_id"] == "req-42"
+    # ...with a traceparent that CONTINUES the client's trace (new span id).
+    parsed = tc.parse_traceparent(headers["traceparent"])
+    assert parsed is not None and parsed[0] == CLIENT_TRACE
+    assert parsed[1] != CLIENT_SPAN
+
+    spans = tracer_mod.current().spans()
+    batch = [s for s in spans if s.name == "serve/batch" and s.args and s.args.get("links")]
+    assert batch, "no linked serve/batch span recorded"
+    links = [link for s in batch for link in s.args["links"]]
+    ours = [link for link in links if link["request_id"] == "req-42"]
+    # The ISSUE acceptance: the HTTP client's trace id reappears on the
+    # engine's batch span via the per-request link.
+    assert ours and ours[0]["trace_id"] == CLIENT_TRACE
+    # The batch span itself joined that trace (child of the first request).
+    assert any(s.trace_id == CLIENT_TRACE for s in batch)
+    # And the per-request span carries the queue/device/harvest breakdown.
+    reqs = [s for s in spans if s.name == "serve/request" and s.args.get("request_id") == "req-42"]
+    assert reqs
+    args = reqs[0].args
+    assert {"bucket", "queue_wait_s", "device_s", "harvest_s", "batch_span", "batch_trace"} <= set(args)
+    assert reqs[0].trace_id == CLIENT_TRACE
+    assert args["batch_trace"] == CLIENT_TRACE
+
+
+def test_request_id_minted_when_absent(served):
+    status, headers, body = _act(served)
+    assert status == 200
+    rid = headers["X-Request-Id"]
+    assert rid and body["request_id"] == rid
+    assert tc.parse_traceparent(headers["traceparent"]) is not None
+
+
+def _post_error(server, path, payload, headers=None):
+    try:
+        _post_raw(server, path, payload, headers)
+    except urllib.error.HTTPError as err:
+        return err.code, dict(err.headers), json.loads(err.read())
+    raise AssertionError("expected an HTTP error")
+
+
+def test_error_paths_carry_the_request_id(served):
+    code, headers, body = _post_error(
+        served,
+        "/v1/act",
+        {"model": "nope", "obs": {"x": [0, 0, 0, 0]}},
+        {"X-Request-Id": "err-7", "traceparent": CLIENT_TRACEPARENT},
+    )
+    assert code == 404
+    assert headers["X-Request-Id"] == "err-7"
+    assert body["request_id"] == "err-7"
+    assert tc.parse_traceparent(headers.get("traceparent"))[0] == CLIENT_TRACE
+
+
+def test_overload_429_carries_request_id_and_retry_after():
+    eng = InferenceEngine(max_batch=1, queue_capacity=1, batch_window_s=0.0, autostart=False)
+    eng.host("echo", EchoAdapter(), warmup=False)
+    server = PolicyServer(eng, host="127.0.0.1", port=0).start()
+    try:
+        fut = eng.submit("echo", {"x": [0, 0, 0, 0]})
+        code, headers, body = _post_error(
+            server,
+            "/v1/act",
+            {"model": "echo", "obs": {"x": [0, 0, 0, 0]}},
+            {"X-Request-Id": "shed-1"},
+        )
+        assert code == 429
+        assert "Retry-After" in headers
+        assert headers["X-Request-Id"] == "shed-1"
+        assert body["request_id"] == "shed-1"
+        eng.start()
+        fut.result(timeout=10)
+    finally:
+        server.close()
+
+
+def _access_lines(caplog, predicate, timeout_s=5.0):
+    # The access line is emitted on the server's handler thread AFTER the
+    # reply is sent, so the client can observe the response before the log
+    # record lands: poll instead of asserting immediately.
+    deadline = time.monotonic() + timeout_s
+    while True:
+        lines = [r.getMessage() for r in caplog.records if r.name == "sheeprl_tpu.serve.access"]
+        hits = [line for line in lines if predicate(line)]
+        if hits or time.monotonic() > deadline:
+            return lines, hits
+        time.sleep(0.01)
+
+
+def test_access_log_is_structured(served, caplog):
+    with caplog.at_level(logging.INFO, logger="sheeprl_tpu.serve.access"):
+        _act(served, {"X-Request-Id": "log-me"})
+        _post_error(served, "/v1/act", {"model": "nope", "obs": {"x": [0, 0, 0, 0]}})
+        lines, _ = _access_lines(caplog, lambda line: "status=404" in line)
+    ok = next(line for line in lines if "request_id=log-me" in line)
+    assert "route=POST /v1/act" in ok and "status=200" in ok
+    assert "latency_ms=" in ok and "bucket=" in ok
+    err = next(line for line in lines if "status=404" in line)
+    assert "request_id=" in err
+
+
+def test_overload_access_log_warns_with_retry_after(caplog):
+    eng = InferenceEngine(max_batch=1, queue_capacity=1, batch_window_s=0.0, autostart=False)
+    eng.host("echo", EchoAdapter(), warmup=False)
+    server = PolicyServer(eng, host="127.0.0.1", port=0).start()
+    try:
+        fut = eng.submit("echo", {"x": [0, 0, 0, 0]})
+        with caplog.at_level(logging.INFO, logger="sheeprl_tpu.serve.access"):
+            _post_error(server, "/v1/act", {"model": "echo", "obs": {"x": [0, 0, 0, 0]}})
+            _, hits = _access_lines(caplog, lambda line: "status=429" in line)
+        assert hits and "retry_after_s=" in hits[0]
+        warned = [
+            r
+            for r in caplog.records
+            if r.name == "sheeprl_tpu.serve.access" and r.levelno >= logging.WARNING
+        ]
+        assert warned, "the 429 access line must log at WARNING"
+        eng.start()
+        fut.result(timeout=10)
+    finally:
+        server.close()

@@ -1,0 +1,265 @@
+"""FlightRecorder unit tests: the crash ring, atomic spill files, the
+merged Perfetto dump, trip rate-limiting, crash-hook chaining, worker
+adoption, the TracedEnv proxy, the cross-process aggregator, and the
+``flight`` CLI subcommand."""
+
+import io
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from sheeprl_tpu.telemetry import flight
+from sheeprl_tpu.telemetry import trace_context as tc
+from sheeprl_tpu.telemetry import tracer as tracer_mod
+from sheeprl_tpu.telemetry.flight import FlightRecorder, TracedEnv
+from sheeprl_tpu.telemetry.tracer import Span, Tracer
+
+pytestmark = pytest.mark.telemetry
+
+
+@pytest.fixture(autouse=True)
+def _clean_globals(monkeypatch):
+    token = tc.set_current(None)
+    monkeypatch.delenv(tc.TRACEPARENT_ENV, raising=False)
+    monkeypatch.delenv(tc.TRACE_DIR_ENV, raising=False)
+    yield
+    flight.uninstall()
+    tc.reset(token)
+
+
+def _span(name, trace_id=None, span_id=None, parent_id=None, cat="host"):
+    return Span(name, cat, time.perf_counter(), 0.01, None, trace_id, span_id, parent_id)
+
+
+def test_ring_is_bounded():
+    rec = FlightRecorder(capacity=4)
+    for i in range(10):
+        rec.observe_span(_span(f"s{i}"))
+    records = rec.snapshot_records()
+    assert records[0]["type"] == "process_meta"
+    names = [r["name"] for r in records[1:]]
+    assert names == ["s6", "s7", "s8", "s9"]
+
+
+def test_record_event_stamps_the_active_trace():
+    rec = FlightRecorder()
+    ctx = tc.mint()
+    with tc.use(ctx):
+        rec.record_event({"type": "health_event", "metric": "grad_norm"})
+    rec.record_event({"type": "log", "message": "outside"})
+    events = [r for r in rec.snapshot_records() if r["type"] != "process_meta"]
+    assert events[0]["trace_id"] == ctx.trace_id
+    assert "trace_id" not in events[1]
+    assert all(e["pid"] == os.getpid() for e in events)
+
+
+def test_spill_writes_the_proc_file_atomically(tmp_path):
+    rec = FlightRecorder(trace_dir=str(tmp_path), run_info={"role": "trainer"})
+    rec.observe_span(_span("work", trace_id="a" * 32, span_id="b" * 16))
+    path = rec.spill()
+    assert path == str(tmp_path / f"proc_{os.getpid()}.jsonl")
+    assert sorted(os.listdir(tmp_path)) == [os.path.basename(path)]  # no tmp leftover
+    records = [json.loads(line) for line in open(path)]
+    assert records[0]["type"] == "process_meta"
+    assert records[0]["run_info"] == {"role": "trainer"}
+    assert records[1]["name"] == "work" and records[1]["trace_id"] == "a" * 32
+
+
+def test_dump_merges_sibling_processes_under_one_trace(tmp_path):
+    trace_id = "c" * 32
+    # A "worker" spill file from another pid, same trace.
+    with open(tmp_path / "proc_99999.jsonl", "w") as fp:
+        fp.write(json.dumps({"type": "process_meta", "pid": 99999, "wall_s": time.time(),
+                             "run_info": {"role": "env_worker"}, "metrics": {}}) + "\n")
+        fp.write(json.dumps({"type": "span", "name": "env/steps", "cat": "env", "pid": 99999,
+                             "wall_start_s": time.time(), "dur_s": 0.1,
+                             "trace_id": trace_id, "span_id": "d" * 16}) + "\n")
+    rec = FlightRecorder(trace_dir=str(tmp_path), run_info={"role": "trainer"})
+    rec.observe_span(_span("train/step", trace_id=trace_id, span_id="e" * 16))
+    path = rec.dump("watchdog", message="hung dispatch")
+    assert path is not None and os.path.basename(path).startswith("flight_")
+    doc = json.load(open(path))
+    assert doc["reason"] == "watchdog" and doc["pid"] == os.getpid()
+    assert set(doc["processes"]) == {str(os.getpid()), "99999"}
+    assert doc["processes"]["99999"]["run_info"] == {"role": "env_worker"}
+    # The single trace id is counted across both processes...
+    assert doc["trace_ids"][trace_id] >= 2
+    # ...and the trace events keep their REAL pids (one track group each).
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    by_pid = {e["pid"] for e in spans if e["args"].get("trace_id") == trace_id}
+    assert by_pid == {os.getpid(), 99999}
+    # Perfetto-loadable structure: only known phases, numeric timestamps.
+    for ev in doc["traceEvents"]:
+        assert ev["ph"] in ("X", "i", "M")
+        if ev["ph"] != "M":
+            assert isinstance(ev["ts"], float)
+    assert doc["displayTimeUnit"] == "ms"
+
+
+def test_dump_is_rate_limited_but_forceable(tmp_path):
+    rec = FlightRecorder(trace_dir=str(tmp_path), min_dump_interval_s=3600.0)
+    assert rec.dump("first") is not None
+    assert rec.dump("storm") is None  # within the window: one dump per storm
+    assert rec.dump("explicit", force=True) is not None
+
+
+def test_dump_without_trace_dir_is_none():
+    assert FlightRecorder().dump("anything") is None
+    assert flight.dump_on_trip("no recorder installed") is None
+
+
+def test_install_chains_and_uninstall_restores_excepthooks(tmp_path):
+    prev_hook = sys.excepthook
+    rec = FlightRecorder(trace_dir=str(tmp_path))
+    flight.install(rec)
+    try:
+        assert flight.current() is rec
+        assert sys.excepthook is not prev_hook
+        assert flight.dump_on_trip("trip", args={"k": 1}) is not None
+    finally:
+        flight.uninstall(rec)
+    assert flight.current() is None
+    assert sys.excepthook is prev_hook
+
+
+def test_installed_recorder_sees_tracer_spans(tmp_path):
+    rec = flight.install(FlightRecorder(trace_dir=str(tmp_path)))
+    live = Tracer()
+    prev = tracer_mod.set_current(live)
+    try:
+        with tc.use(tc.mint()):
+            with live.span("guarded", "host"):
+                pass
+    finally:
+        tracer_mod.set_current(prev)
+        flight.uninstall(rec)
+    names = [r.get("name") for r in rec.snapshot_records() if r["type"] == "span"]
+    assert "guarded" in names
+
+
+def test_ensure_live_tracer_only_when_disabled():
+    prev = tracer_mod.set_current(None)  # shared disabled tracer
+    try:
+        installed = flight.ensure_live_tracer(capacity=16)
+        assert installed is not None and tracer_mod.current() is installed
+        assert flight.ensure_live_tracer() is None  # already live
+    finally:
+        tracer_mod.set_current(prev)
+
+
+def test_adopt_worker_process_joins_the_carrier(tmp_path):
+    root = tc.mint()
+    tc.inject_env_carrier(root, str(tmp_path))
+    prev_tracer = tracer_mod.set_current(None)
+    try:
+        rec = flight.adopt_worker_process(run_info={"env": 3})
+        assert rec is not None and rec.run_info == {"role": "env_worker", "env": 3}
+        assert flight.adopt_worker_process() is rec  # idempotent per process
+        # The carrier was adopted: the worker context joins the parent trace.
+        assert tc.current().trace_id == root.trace_id
+        # The adopt-time spill makes the process visible immediately.
+        assert os.path.exists(tmp_path / f"proc_{os.getpid()}.jsonl")
+    finally:
+        flight.uninstall()
+        tracer_mod.set_current(prev_tracer)
+
+
+class _FakeEnv:
+    def __init__(self):
+        self.steps = 0
+        self.closed = False
+        self.metadata = {"render_modes": []}
+
+    def reset(self, **kwargs):
+        return 0, {}
+
+    def step(self, action):
+        self.steps += 1
+        return 0, 0.0, False, False, {}
+
+    def close(self):
+        self.closed = True
+
+
+def test_traced_env_emits_window_spans_and_spills(tmp_path):
+    root = tc.mint()
+    tc.inject_env_carrier(root, str(tmp_path))
+    prev_tracer = tracer_mod.set_current(None)
+    try:
+        env = flight.traced_env_thunk(_FakeEnv, env_idx=1, span_every=2)()
+        assert isinstance(env, TracedEnv)
+        env.reset()
+        for _ in range(4):
+            env.step(0)
+        env.close()
+        assert env._env.closed
+        assert env.metadata == {"render_modes": []}  # delegation
+        spill = tmp_path / f"proc_{os.getpid()}.jsonl"
+        records = [json.loads(line) for line in open(spill)]
+        spans = [r for r in records if r.get("type") == "span"]
+        names = {s["name"] for s in spans}
+        assert {"env/reset", "env/steps"} <= names
+        # Worker spans join the trainer's trace via the adopted carrier.
+        assert all(s.get("trace_id") == root.trace_id for s in spans)
+    finally:
+        flight.uninstall()
+        tracer_mod.set_current(prev_tracer)
+
+
+def test_aggregate_traces_rebases_across_sources(tmp_path):
+    trace_id = "f" * 32
+    # Source 1: an exported trace.json with a wall epoch.
+    t = Tracer()
+    with tc.use(tc.TraceContext(trace_id, "1" * 16)):
+        t.add_span("train/step", "train", time.perf_counter(), 0.2)
+    t.export_chrome(str(tmp_path / "trace.json"))
+    # Source 2: a worker spill file.
+    with open(tmp_path / "proc_777.jsonl", "w") as fp:
+        fp.write(json.dumps({"type": "span", "name": "env/steps", "cat": "env", "pid": 777,
+                             "wall_start_s": time.time(), "dur_s": 0.1,
+                             "trace_id": trace_id, "span_id": "2" * 16}) + "\n")
+        fp.write(json.dumps({"type": "span", "name": "other", "cat": "env", "pid": 777,
+                             "wall_start_s": time.time(), "dur_s": 0.1,
+                             "trace_id": "9" * 32, "span_id": "3" * 16}) + "\n")
+    doc = flight.aggregate_traces(str(tmp_path))
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in spans} >= {"train/step", "env/steps", "other"}
+    assert len(doc["metadata"]["sources"]) == 2
+    assert doc["metadata"]["trace_ids"][trace_id] == 2
+    pids = {e["pid"] for e in spans}
+    assert 777 in pids and len(pids) == 2
+    assert all(e["ts"] >= 0.0 for e in spans)  # rebased onto one timeline
+    # Filtering keeps only the requested trace.
+    only = flight.aggregate_traces(str(tmp_path), trace_id=trace_id)
+    kept = [e for e in only["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in kept} == {"train/step", "env/steps"}
+
+
+def test_flight_cli_lists_and_merges(tmp_path):
+    from sheeprl_tpu.telemetry.__main__ import flight as flight_cmd
+    from sheeprl_tpu.telemetry.__main__ import main
+
+    rec = FlightRecorder(trace_dir=str(tmp_path / "flight"), run_info={"algo": "sac"})
+    rec.observe_span(_span("train/step", trace_id="a" * 32, span_id="b" * 16))
+    dump = rec.dump("watchdog", message="hung dispatch")
+    out = io.StringIO()
+    assert flight_cmd(str(tmp_path), out=out) == 0
+    text = out.getvalue()
+    assert "reason=watchdog" in text and "hung dispatch" in text
+    assert "a" * 32 in text
+    # --merge via the real argv entrypoint.
+    merged = tmp_path / "merged.json"
+    assert main(["flight", str(tmp_path), "--merge", str(merged)]) == 0
+    doc = json.load(open(merged))
+    assert any(e.get("name") == "train/step" for e in doc["traceEvents"])
+    assert dump in doc["metadata"]["sources"]
+
+
+def test_flight_cli_empty_dir_is_an_error(tmp_path, capsys):
+    from sheeprl_tpu.telemetry.__main__ import flight as flight_cmd
+
+    assert flight_cmd(str(tmp_path), out=io.StringIO()) == 1
+    assert "no flight_" in capsys.readouterr().err

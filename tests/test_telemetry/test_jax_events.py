@@ -84,3 +84,23 @@ def test_memory_gauges_cpu_safe():
     # being absent rather than raising.
     gauges = JaxEventMonitor.memory_gauges(jax.devices()[0])
     assert isinstance(gauges, dict)
+
+
+def test_compile_events_reach_the_default_registry():
+    # The bridge to MetricsRegistry: a compile observed by the monitor also
+    # increments the process-wide `jax/*` counters, so Prometheus scrapes
+    # (/metrics) see XLA activity without the tracer mirroring step.
+    from sheeprl_tpu.telemetry.registry import default_registry
+
+    reg = default_registry()
+    before = reg.counter("jax/compiles").value
+    monitor = JaxEventMonitor(warmup_iters=100)
+    monitor.attach()
+    try:
+        _fresh_jit()(jnp.ones((9,)))
+    finally:
+        monitor.detach()
+    assert reg.counter("jax/compiles").value >= before + 1
+    assert reg.counter("jax/compile_secs").value > 0
+    # Prometheus rendering sanitizes the slash.
+    assert "jax_compiles_total" in reg.prometheus_text()

@@ -1,0 +1,122 @@
+"""TraceContext unit tests: W3C traceparent round-trip and rejection rules,
+child derivation, contextvar scoping, and the cross-process env carrier."""
+
+import threading
+
+import pytest
+
+from sheeprl_tpu.telemetry import trace_context as tc
+
+pytestmark = pytest.mark.telemetry
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_context(monkeypatch):
+    # Each test starts outside any trace and with a clean carrier.
+    token = tc.set_current(None)
+    monkeypatch.delenv(tc.TRACEPARENT_ENV, raising=False)
+    monkeypatch.delenv(tc.TRACE_DIR_ENV, raising=False)
+    yield
+    tc.reset(token)
+
+
+def test_traceparent_round_trip():
+    ctx = tc.mint()
+    header = ctx.to_traceparent()
+    assert header == f"00-{ctx.trace_id}-{ctx.span_id}-01"
+    back = tc.TraceContext.from_traceparent(header)
+    assert back is not None
+    assert (back.trace_id, back.span_id) == (ctx.trace_id, ctx.span_id)
+    assert back.parent_id is None
+
+
+@pytest.mark.parametrize(
+    "header",
+    [
+        None,
+        "",
+        "garbage",
+        "00-xyz-abc-01",
+        "00-" + "0" * 32 + "-1234567890abcdef-01",  # all-zero trace id
+        "00-" + "a" * 32 + "-" + "0" * 16 + "-01",  # all-zero span id
+        "ff-" + "a" * 32 + "-" + "b" * 16 + "-01",  # forbidden version
+        "00-" + "a" * 31 + "-" + "b" * 16 + "-01",  # short trace id
+    ],
+)
+def test_malformed_traceparent_rejected(header):
+    assert tc.parse_traceparent(header) is None
+
+
+def test_unknown_version_accepted_when_fields_parse():
+    assert tc.parse_traceparent("42-" + "a" * 32 + "-" + "b" * 16 + "-00") == (
+        "a" * 32,
+        "b" * 16,
+    )
+
+
+def test_child_keeps_trace_and_links_parent():
+    root = tc.mint()
+    child = root.child()
+    assert child.trace_id == root.trace_id
+    assert child.parent_id == root.span_id
+    assert child.span_id != root.span_id
+
+
+def test_mint_with_parent_is_a_child():
+    root = tc.mint()
+    minted = tc.mint(root)
+    assert minted.trace_id == root.trace_id
+    assert minted.parent_id == root.span_id
+
+
+def test_ids_are_hex_and_unique():
+    spans = {tc.new_span_id() for _ in range(64)}
+    assert len(spans) == 64
+    for s in spans:
+        assert len(s) == 16
+        int(s, 16)
+    trace = tc.new_trace_id()
+    assert len(trace) == 32
+    int(trace, 16)
+
+
+def test_use_scopes_and_restores():
+    assert tc.current() is None
+    ctx = tc.mint()
+    with tc.use(ctx):
+        assert tc.current() is ctx
+        inner = ctx.child()
+        with tc.use(inner):
+            assert tc.current() is inner
+        assert tc.current() is ctx
+    assert tc.current() is None
+
+
+def test_threads_do_not_inherit_the_context():
+    # contextvars don't flow into plain threads: cross-thread handoff must be
+    # explicit (the serve dispatcher / async-harvest ctx= argument).
+    seen = []
+    with tc.use(tc.mint()):
+        t = threading.Thread(target=lambda: seen.append(tc.current()))
+        t.start()
+        t.join()
+    assert seen == [None]
+
+
+def test_env_carrier_round_trip(tmp_path):
+    ctx = tc.mint()
+    tc.inject_env_carrier(ctx, str(tmp_path))
+    carried = tc.extract_env_carrier()
+    assert carried is not None and carried.trace_id == ctx.trace_id
+    assert tc.carrier_trace_dir() == str(tmp_path)
+    adopted = tc.adopt_env_carrier()
+    assert adopted is not None
+    # The worker context is a CHILD of the carried one: same trace, parented
+    # to the span the trainer published.
+    assert adopted.trace_id == ctx.trace_id
+    assert adopted.parent_id == ctx.span_id
+    assert tc.current() is adopted
+    tc.clear_env_carrier()
+    assert tc.extract_env_carrier() is None
+    assert tc.carrier_trace_dir() is None
+    assert tc.adopt_env_carrier() is None

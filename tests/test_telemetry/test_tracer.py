@@ -2,6 +2,7 @@
 JSONL export, the process-wide current-tracer switch."""
 
 import json
+import os
 
 import pytest
 
@@ -55,7 +56,11 @@ def test_chrome_trace_golden_structure(tmp_path):
 
     with open(path) as fp:
         doc = json.load(fp)
-    assert set(doc) == {"traceEvents", "displayTimeUnit"}
+    assert set(doc) == {"traceEvents", "displayTimeUnit", "metadata"}
+    # The wall-clock epoch is what lets the cross-process aggregator rebase
+    # this file against traces from other processes.
+    assert doc["metadata"]["pid"] == os.getpid()
+    assert doc["metadata"]["wall_epoch_s"] > 0
     events = doc["traceEvents"]
     assert isinstance(events, list) and events
     complete = [e for e in events if e["ph"] == "X"]
