@@ -31,7 +31,7 @@ Workloads:
 `python bench.py [dreamer_v3|dreamer_v3_devbuf|dreamer_v3_pipe|dreamer_v3_S|
 dreamer_v3_S_b32|dreamer_v3_S_b64|dreamer_v3_health|dreamer_v2|dreamer_v1|
 ppo|a2c|sac|sac_devbuf|sac_pipe|sac_resilience|sac_health|sac_flight|
-serve_sac|serve_sac_traced]`. The `*_pipe` legs are the
+serve_sac|serve_sac_traced|ppo_anakin|sac_anakin|dreamer_v3_anakin]`. The `*_pipe` legs are the
 pipelined-interaction A/B (fabric.async_fetch, env.pipeline_slices —
 core/interact.py); every result embeds the interaction time split and
 overlap fraction from the long run. `sac_resilience` is the fault-tolerance
@@ -48,7 +48,15 @@ closed-loop load test (sheeprl_tpu/serve): concurrent clients against the
 dynamic micro-batching engine, vs_baseline = batching speedup over one
 client. `serve_sac_traced` repeats it with a per-request trace context and
 a live tracer installed so request/batch span emission and linking is on
-the measured path (<2% of the `serve_sac` peak).
+the measured path (<2% of the `serve_sac` peak). The `*_anakin` legs
+(`ppo_anakin|sac_anakin|dreamer_v3_anakin`) are the Anakin-lane
+head-to-head (howto/anakin_lane.md): the SAME pure-JAX env and recipe
+through the fused rollout+train lane (core/fused_loop.py) and through the
+JaxToGymnasium host lane, one JSON row with the fused rate as headline,
+the host-lane rate embedded (`host_lane`, plus `fused_vs_host` — the fused
+lane must be strictly faster), and the fused dispatch accounting from
+core/fused_loop.last_run_stats() (`fused.dispatches_per_superstep` <= 2 is
+the lane's contract).
 Reference baselines from BASELINE.md (README.md:83-180); `dreamer_v3_S` is
 the north-star-scale workload (S model at the Atari-100K recipe shape) vs
 the RTX 3080's ~1.98 env-steps/s.
@@ -626,6 +634,135 @@ def bench_dreamer_v3_S(batch: int = None):
     return result
 
 
+def _bench_anakin(
+    algo: str,
+    exp: str,
+    total_steps: int,
+    baseline_sps: float,
+    *,
+    learning_starts: int = 0,
+    warmup_steps: int = 1536,
+    start_steps: int = 2048,
+    fused_extra=(),
+    host_extra=(),
+    common_extra=(),
+):
+    """Anakin head-to-head leg (howto/anakin_lane.md): the SAME pure-JAX env
+    and recipe through the fused lane (rollout + train inside donated jits,
+    core/fused_loop.py) and through the host lane (algo.fused_rollout=false:
+    JaxToGymnasium + SyncVectorEnv + core/interact.py). Both lanes share
+    every other knob, so `fused_vs_host` isolates exactly what fusing buys:
+    the per-step dispatch + transfer overhead the host lane pays T*E times
+    per superstep collapses to 1 (PPO) or 2 (SAC/DreamerV3) donated calls.
+    The headline value/vs_baseline stay comparable with the plain gym rows
+    (same step budget, same reference wall-clock); `fused` embeds the
+    dispatch accounting from the fused long run
+    (core/fused_loop.last_run_stats()) — dispatches_per_superstep <= 2 is
+    the lane's contract."""
+    from sheeprl_tpu.core import fused_loop
+
+    common = [
+        "metric.log_level=0",
+        "metric.disable_timer=True",
+        "algo.run_test=False",
+        "env.capture_video=False",
+        # In-process vector env on the host lane (matches the *_benchmarks
+        # recipes): a subprocess env would re-jit the jax step per worker
+        # and measure fork overhead, not the lane.
+        "env.sync_env=True",
+        *common_extra,
+    ]
+    fused = _timeboxed(
+        f"{algo}_anakin_env_steps_per_sec", exp, total_steps, baseline_sps,
+        learning_starts=learning_starts, warmup_steps=warmup_steps,
+        start_steps=start_steps,
+        extra=("algo.fused_rollout=True", *fused_extra, *common),
+    )
+    # interact.py never runs inside the fused lane; any split _timeboxed
+    # picked up is a stale readout from an earlier leg in this process.
+    fused.pop("interaction", None)
+    stats = fused_loop.last_run_stats()
+    host = _timeboxed(
+        f"{algo}_anakin_host_env_steps_per_sec", exp, total_steps, baseline_sps,
+        learning_starts=learning_starts, warmup_steps=warmup_steps,
+        start_steps=start_steps,
+        extra=("algo.fused_rollout=False", *host_extra, *common),
+    )
+    fused["fused"] = {
+        "supersteps": stats["supersteps"],
+        "jit_dispatches": stats["jit_dispatches"],
+        "env_steps": stats["env_steps"],
+        "dispatches_per_superstep": round(
+            stats["jit_dispatches"] / max(stats["supersteps"], 1), 3
+        ),
+    }
+    host_row = {
+        "metric": host["metric"],
+        "value": host["value"],
+        "vs_baseline": host["vs_baseline"],
+    }
+    if "interaction" in host:
+        host_row["interaction"] = host["interaction"]
+    fused["host_lane"] = host_row
+    fused["fused_vs_host"] = round(fused["value"] / max(host["value"], 1e-9), 3)
+    return fused
+
+
+def bench_ppo_anakin():
+    # Same step budget and reference wall-clock as the ppo row
+    # (README.md:100-117); the jax CartPole physics are bit-identical to
+    # Gymnasium's (tests/test_envs/test_jax_envs.py), so the rows compare.
+    # One donated dispatch covers the whole rollout scan + GAE + every
+    # update epoch per superstep.
+    return _bench_anakin(
+        "ppo", "ppo_anakin", 65536, 65536 / 81.27,
+        warmup_steps=512, start_steps=16384,
+    )
+
+
+def bench_sac_anakin():
+    # fused_train_steps=1024 sizes the train bucket above the per-superstep
+    # gradient debt (64 iters x 4 envs x replay_ratio 1.0 = 256 -> one
+    # power-of-two bucket), so every steady-state training superstep is
+    # exactly 1 rollout + 1 train dispatch; it also swallows the Ratio
+    # controller's one-time post-prefill catch-up (~1k steps) in 3 dispatches
+    # instead of 6, keeping the run-average dispatches_per_superstep <= 2.
+    # Warmup runs past learning_starts so the train executables hit the
+    # persistent compile cache in the measured runs.
+    return _bench_anakin(
+        "sac", "sac_anakin", 65536, 65536 / 320.21,
+        learning_starts=1024, warmup_steps=2048, start_steps=4096,
+        fused_extra=("algo.fused_train_steps=1024",),
+        host_extra=("fabric.player_sync=async",),
+    )
+
+
+def bench_dreamer_v3_anakin():
+    # Micro world model at the reference replay ratio (the
+    # dreamer_v3_benchmarks sizes) so the leg runs end-to-end on CPU —
+    # applied to BOTH lanes, so the head-to-head stays fair. 0.0625 x 16
+    # iters x 4 envs = 4 gradient steps per superstep = exactly one
+    # fused_train_steps=4 bucket: 1 rollout + 1 train dispatch.
+    micro = (
+        "algo.replay_ratio=0.0625",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.world_model.discrete_size=4",
+        "algo.world_model.stochastic_size=4",
+        "algo.world_model.encoder.cnn_channels_multiplier=2",
+        "algo.world_model.recurrent_model.recurrent_state_size=8",
+        "algo.world_model.transition_model.hidden_size=8",
+        "algo.world_model.representation_model.hidden_size=8",
+        "buffer.size=16384",
+        f"fabric.precision={_accel_precision()}",
+    )
+    return _bench_anakin(
+        "dreamer_v3", "dreamer_v3_anakin", 16384, 16384 / 1589.30,
+        learning_starts=1024, common_extra=micro,
+        host_extra=("fabric.player_sync=async",),
+    )
+
+
 def main() -> None:
     which = sys.argv[1] if len(sys.argv) > 1 else "dreamer_v3"
     # PPO/A2C/SAC are the reference's 4-CPU workloads and pin
@@ -673,6 +810,9 @@ def main() -> None:
         "sac_flight": bench_sac_flight,
         "serve_sac": bench_serve_sac,
         "serve_sac_traced": lambda: bench_serve_sac(traced=True),
+        "ppo_anakin": bench_ppo_anakin,
+        "sac_anakin": bench_sac_anakin,
+        "dreamer_v3_anakin": bench_dreamer_v3_anakin,
     }[which]()
     result["backend"] = jax.default_backend()
     print(json.dumps(result))

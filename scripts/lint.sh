@@ -66,4 +66,12 @@ echo "== graftlint (resilience + checkpoint, no baseline) =="
 python -m sheeprl_tpu.analysis --no-baseline \
     sheeprl_tpu/core/resilience.py sheeprl_tpu/core/chaos.py sheeprl_tpu/utils/checkpoint.py || rc=1
 
+# The Anakin lane's whole value proposition is "no host in the loop": the
+# pure-JAX envs and the fused rollout+train driver hold zero findings with
+# no baseline (GL001 key discipline inside the scans, GL002 coalesced
+# host syncs, GL005 donation safety, GL008 span safety).
+echo "== graftlint (jax envs + fused loop, no baseline) =="
+python -m sheeprl_tpu.analysis --no-baseline \
+    sheeprl_tpu/envs/jax/ sheeprl_tpu/core/fused_loop.py || rc=1
+
 exit "$rc"

@@ -878,11 +878,21 @@ VALIDATORS = {
     "sac_ae": validate_sac_ae,
 }
 
-# Validators whose recorded run is PENDING for a documented reason — runtime
-# beyond this host class, or awaiting a re-run after a budget change. Not
-# skipped silently: subset-run regeneration treats them as optional and the
-# report prints the note whenever no recorded run exists. Remove an entry
-# once its row is recorded and trustworthy again.
+# Validators whose recorded run is PENDING for a documented reason. TWO
+# distinct classes, and regeneration treats them differently:
+#
+# - HW_GATED_NOTES: runtime genuinely beyond this host class. Subset-run
+#   regeneration treats these as OPTIONAL — a cache covering everything
+#   else may refresh RESULTS.md with the gated rows rendered as pending.
+# - PENDING_RERUN_NOTES: the validator runs fine on this host but its row
+#   was evicted after a budget/seeding change and is awaiting a re-run.
+#   These BLOCK regeneration: the last observed numbers were red (below
+#   bar), so silently refreshing the table without them would launder a
+#   known-red validator into an optional-looking ⏳ row.
+#
+# Neither is skipped silently: the report prints the note whenever no
+# recorded run exists. Remove an entry once its row is recorded and
+# trustworthy again.
 HW_GATED_NOTES = {
     "sac_ae_small": (
         "sac_ae_small (the REDUCED-scale pixel probe: 32×32, quarter-width "
@@ -898,6 +908,18 @@ HW_GATED_NOTES = {
         "host. Every cheaper layer of SAC-AE evidence is in the suite: "
         "dry-run e2e, pixel pipeline, checkpoint round-trip."
     ),
+    "sac_ae": (
+        "sac_ae at FULL scale (64×64, full-width conv stack) has no recorded "
+        "run: measured at ~0.1 policy-steps/s on the 1-core build host, the "
+        "10,240-step probe needs ~24 h of CPU — gated on a faster host or "
+        "the accelerator, not on missing code. The sac_ae_small row above is "
+        "the same algorithm's learning proof at a scale this host affords "
+        "(32×32, quarter-width conv); record full scale with "
+        "`python scripts/validate_returns.py sac_ae`."
+    ),
+}
+
+PENDING_RERUN_NOTES = {
     "dreamer_v3_bf16": (
         "dreamer_v3 (bf16-mixed) is pending a re-run at the 32K budget "
         "(same story as dreamer_v2_bf16: the fresh 16K run reached "
@@ -905,7 +927,9 @@ HW_GATED_NOTES = {
         "budget; the stale 16K-era 162.5 predated the deterministic streams "
         "and was evicted). The 32-true dreamer_v3 row IS freshly recorded "
         "(32K run resumed to 48K; see its row note). Record with "
-        "`python scripts/validate_returns.py dreamer_v3_bf16` (~1 h CPU)."
+        "`python scripts/validate_returns.py dreamer_v3_bf16` (~1 h CPU). "
+        "Until then this validator BLOCKS subset-run RESULTS.md "
+        "regeneration: its last observed number was red."
     ),
     "dreamer_v2_bf16": (
         "dreamer_v2 (bf16-mixed) is pending a re-run at the 32K budget: "
@@ -915,16 +939,9 @@ HW_GATED_NOTES = {
         "the 150 bar; at 32K, 32-true reaches 383.0). The earlier 16K-era "
         "299.1 record predated the deterministic streams and was evicted "
         "rather than kept as evidence. Record with "
-        "`python scripts/validate_returns.py dreamer_v2_bf16` (~1 h CPU)."
-    ),
-    "sac_ae": (
-        "sac_ae at FULL scale (64×64, full-width conv stack) has no recorded "
-        "run: measured at ~0.1 policy-steps/s on the 1-core build host, the "
-        "10,240-step probe needs ~24 h of CPU — gated on a faster host or "
-        "the accelerator, not on missing code. The sac_ae_small row above is "
-        "the same algorithm's learning proof at a scale this host affords "
-        "(32×32, quarter-width conv); record full scale with "
-        "`python scripts/validate_returns.py sac_ae`."
+        "`python scripts/validate_returns.py dreamer_v2_bf16` (~1 h CPU). "
+        "Until then this validator BLOCKS subset-run RESULTS.md "
+        "regeneration: its last observed number was red."
     ),
 }
 
@@ -1000,6 +1017,8 @@ def _write_results(results, crashed=(), missing=()) -> None:
     for name in missing:
         if name in HW_GATED_NOTES:
             lines += ["", HW_GATED_NOTES[name]]
+        elif name in PENDING_RERUN_NOTES:
+            lines += ["", PENDING_RERUN_NOTES[name]]
     lines += [
         "",
         "Per-episode returns:",
@@ -1092,19 +1111,26 @@ def main() -> None:
     # committed full table with fewer rows.
     # Hardware-gated validators are optional for regeneration: a cache that
     # covers everything else may refresh the table, with the gated rows
-    # rendered as pending (their notes explain why).
+    # rendered as pending (their notes explain why). PENDING_RERUN rows are
+    # NOT optional — their last observed numbers were red, so regeneration
+    # stays blocked until they are freshly recorded.
     complete = all(n in cache for n in VALIDATORS if n not in HW_GATED_NOTES)
     if which == "all" or complete:
         rows = [cache[n] for n in VALIDATORS if n in cache]
         _write_results(rows, crashed, missing=[n for n in VALIDATORS if n not in cache and n not in crashed])
     else:
-        # Only non-pending validators BLOCK regeneration; list them apart
-        # from the pending-with-note ones so nobody burns hours recording
-        # an optional row.
-        blocking = sorted(set(VALIDATORS) - set(cache) - set(HW_GATED_NOTES))
-        pending = sorted((set(VALIDATORS) - set(cache)) & set(HW_GATED_NOTES))
+        # Only non-HW-gated validators BLOCK regeneration; list the
+        # known-red pending-rerun ones and the truly gated ones apart so
+        # it's clear which missing rows demand a run and which are merely
+        # waiting on hardware.
+        missing_all = set(VALIDATORS) - set(cache)
+        pending_rerun = sorted(missing_all & set(PENDING_RERUN_NOTES))
+        blocking = sorted(missing_all - set(HW_GATED_NOTES) - set(PENDING_RERUN_NOTES))
+        gated = sorted(missing_all & set(HW_GATED_NOTES))
         print(f"cache covers {len(cache)}/{len(VALIDATORS)} validators "
-              f"(blocking regeneration: {blocking}; pending-with-note, optional: {pending}); "
+              f"(blocking regeneration: {blocking}; "
+              f"pending re-run, also blocking: {pending_rerun}; "
+              f"hardware-gated, optional: {gated}); "
               "RESULTS.md left untouched")
     if crashed or any(r["mean_return"] < r["threshold"] for r in results):
         sys.exit(1)

@@ -451,6 +451,13 @@ def _target_update_taus(cumulative: int, k: int, freq: int, tau: float) -> np.nd
 
 @register_algorithm()
 def main(runtime, cfg: Dict[str, Any]):
+    from sheeprl_tpu.core.fused_loop import dreamer_v3_fused_main, fused_enabled
+
+    if fused_enabled(cfg):
+        # Anakin lane: pure-JAX env, rollout AND train inside one jit
+        # (core/fused_loop.py). The host-interaction path below is untouched.
+        return dreamer_v3_fused_main(runtime, cfg)
+
     mesh = runtime.mesh
     rank = runtime.global_rank
     world_size = jax.process_count()

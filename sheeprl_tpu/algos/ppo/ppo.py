@@ -53,23 +53,36 @@ from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
 from sheeprl_tpu.config.instantiate import instantiate
 
 
-def make_train_step(
+def make_optimizer(cfg: Dict[str, Any]) -> tuple:
+    """Build the PPO optimizer with the lr injected as a hyperparam (so
+    annealing is a hyperparam update, not a rebuild). Returns (tx, base_lr)
+    — shared by the host-interaction main and the fused Anakin lane so both
+    produce byte-compatible optimizer states."""
+    optim_cfg = dict(cfg.algo.optimizer)
+    optim_target = optim_cfg.pop("_target_")
+    base_lr = float(optim_cfg.pop("lr"))
+
+    def make_tx(lr):
+        from sheeprl_tpu.config.instantiate import locate
+
+        inner = locate(optim_target)(lr=lr, **optim_cfg)
+        if cfg.algo.max_grad_norm > 0.0:
+            return optax.chain(optax.clip_by_global_norm(cfg.algo.max_grad_norm), inner)
+        return inner
+
+    return optax.inject_hyperparams(make_tx)(lr=base_lr), base_lr
+
+
+def make_update_pool(
     agent: PPOAgent,
     tx: optax.GradientTransformation,
     cfg: Dict[str, Any],
     mesh,
-    fused_gae: bool = True,
 ):
-    """Build the jitted full-update function (epochs × minibatches in-graph).
-
-    ``fused_gae=True`` (the coupled loop): the jit takes the raw rollout —
-    big tensors flat ``(T*E, ...)``, per-step scalars ``(T, E, 1)``, the
-    final obs — and runs bootstrap + GAE in-graph before the scans (see
-    core/rollout.py for the transfer layout). ``fused_gae=False``
-    (ppo_decoupled, which computes GAE on the PLAYER device and scatters
-    the finished pool to the trainer partition): the jit takes the flat
-    pool with returns/advantages already present.
-    """
+    """Build the pure (un-jitted) full PPO update over a flat sample pool:
+    ALL epochs × minibatches as nested `lax.scan`s, permutations drawn
+    in-graph. Shared by :func:`make_train_step` (which jits it standalone)
+    and core/fused_loop.py (which inlines it after the in-jit rollout)."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     update_epochs = int(cfg.algo.update_epochs)
@@ -147,6 +160,32 @@ def make_train_step(
         (params, opt_state), metrics = jax.lax.scan(epoch_body, (params, opt_state), keys)
         return params, opt_state, jax.tree_util.tree_map(lambda m: m.mean(0), metrics), next_key
 
+    return update_pool
+
+
+def make_train_step(
+    agent: PPOAgent,
+    tx: optax.GradientTransformation,
+    cfg: Dict[str, Any],
+    mesh,
+    fused_gae: bool = True,
+):
+    """Build the jitted full-update function (epochs × minibatches in-graph).
+
+    ``fused_gae=True`` (the coupled loop): the jit takes the raw rollout —
+    big tensors flat ``(T*E, ...)``, per-step scalars ``(T, E, 1)``, the
+    final obs — and runs bootstrap + GAE in-graph before the scans (see
+    core/rollout.py for the transfer layout). ``fused_gae=False``
+    (ppo_decoupled, which computes GAE on the PLAYER device and scatters
+    the finished pool to the trainer partition): the jit takes the flat
+    pool with returns/advantages already present.
+    """
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    obs_keys = cnn_keys + list(cfg.algo.mlp_keys.encoder)
+    gamma = float(cfg.algo.gamma)
+    gae_lambda = float(cfg.algo.gae_lambda)
+    update_pool = make_update_pool(agent, tx, cfg, mesh)
+
     if not fused_gae:
 
         @partial(jax.jit, donate_argnums=(0, 1))
@@ -170,6 +209,13 @@ def make_train_step(
 
 @register_algorithm()
 def main(runtime, cfg: Dict[str, Any]):
+    from sheeprl_tpu.core.fused_loop import fused_enabled, ppo_fused_main
+
+    if fused_enabled(cfg):
+        # Anakin lane: pure-JAX env, rollout AND train inside one jit
+        # (core/fused_loop.py). The host-interaction path below is untouched.
+        return ppo_fused_main(runtime, cfg)
+
     initial_ent_coef = float(cfg.algo.ent_coef)
     initial_clip_coef = float(cfg.algo.clip_coef)
     mesh = runtime.mesh
@@ -217,20 +263,7 @@ def main(runtime, cfg: Dict[str, Any]):
             state["agent"] if state is not None else None,
         )
 
-        # optimizer: inject lr so annealing is a hyperparam update, not a rebuild
-        optim_cfg = dict(cfg.algo.optimizer)
-        optim_target = optim_cfg.pop("_target_")
-        base_lr = float(optim_cfg.pop("lr"))
-
-        def make_tx(lr):
-            from sheeprl_tpu.config.instantiate import locate
-
-            inner = locate(optim_target)(lr=lr, **optim_cfg)
-            if cfg.algo.max_grad_norm > 0.0:
-                return optax.chain(optax.clip_by_global_norm(cfg.algo.max_grad_norm), inner)
-            return inner
-
-        tx = optax.inject_hyperparams(make_tx)(lr=base_lr)
+        tx, base_lr = make_optimizer(cfg)
         opt_state = tx.init(params)
         if state is not None:
             opt_state = restore_opt_state(opt_state, state["optimizer"])

@@ -184,6 +184,13 @@ def make_fused_train_step(
 
 @register_algorithm()
 def main(runtime, cfg: Dict[str, Any]):
+    from sheeprl_tpu.core.fused_loop import fused_enabled, sac_fused_main
+
+    if fused_enabled(cfg):
+        # Anakin lane: pure-JAX env, rollout AND train inside one jit
+        # (core/fused_loop.py). The host-interaction path below is untouched.
+        return sac_fused_main(runtime, cfg)
+
     mesh = runtime.mesh
     rank = runtime.global_rank
     world_size = jax.process_count()

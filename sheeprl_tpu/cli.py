@@ -144,6 +144,30 @@ def check_configs(cfg: dotdict) -> None:
                 "health.enabled=True but metric.log_level=0: sentinels observe at the metric "
                 "log cadence, so nothing will be watched. Set metric.log_level >= 1.",
             )
+    # Anakin lane (core/fused_loop.py): fused rollout+train needs a pure-JAX
+    # env and an algorithm with a fused driver.
+    if bool(cfg.algo.get("fused_rollout", False)):
+        if not bool(cfg.env.get("jax_native", False)):
+            raise ValueError(
+                "algo.fused_rollout=True requires env.jax_native=True: the fused superstep "
+                "steps the env inside the training jit, so it must be a pure-JAX env "
+                "(sheeprl_tpu/envs/jax — e.g. env=jax_cartpole, env=jax_pendulum)."
+            )
+        if cfg.algo.name not in ("ppo", "sac", "dreamer_v3"):
+            raise ValueError(
+                f"algo.fused_rollout is implemented for ppo, sac and dreamer_v3; got '{cfg.algo.name}'. "
+                "Run this algorithm on a jax env through the host lane (env.jax_native with "
+                "algo.fused_rollout=false uses the JaxToGymnasium wrapper) instead."
+            )
+        if int(cfg.algo.get("fused_superstep_steps", 64)) < 1:
+            raise ValueError("algo.fused_superstep_steps must be >= 1")
+    if bool(cfg.env.get("jax_native", False)):
+        from sheeprl_tpu.envs.jax import make_jax_env
+
+        try:
+            make_jax_env(cfg.env.id)
+        except ValueError as err:
+            raise ValueError(f"env.jax_native=True but env.id is not a registered jax env: {err}") from err
     entry = algorithm_registry[cfg.algo.name]
     if (
         entry.decoupled

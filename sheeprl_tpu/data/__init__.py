@@ -5,7 +5,7 @@ from sheeprl_tpu.data.buffers import (
     SequentialReplayBuffer,
     get_array,
 )
-from sheeprl_tpu.data.memmap import MemmapArray
+from sheeprl_tpu.data.memmap import MemmapArray, ownership_transfer_scope
 
 __all__ = [
     "EnvIndependentReplayBuffer",
@@ -14,4 +14,5 @@ __all__ = [
     "SequentialReplayBuffer",
     "get_array",
     "MemmapArray",
+    "ownership_transfer_scope",
 ]

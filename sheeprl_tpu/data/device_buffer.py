@@ -315,6 +315,80 @@ class DeviceReplayRing:
         trc.count("ring_write_rows", int(mask.sum()))
         return True
 
+    # ------------------------------------------------- fused-lane interface
+    def allocate(self, specs: Dict[str, Tuple[Sequence[int], Any]]) -> None:
+        """Eagerly allocate the ring from explicit per-key feature specs.
+
+        The host-interaction lane allocates lazily on the first ``add`` (the
+        staged row fixes shapes/dtypes); the fused lane writes rows *inside*
+        the superstep jit and never stages, so the ring must exist — with
+        the HBM budget check already passed — before the first dispatch.
+        ``specs`` maps key -> (feature_shape, dtype). No-op when already
+        allocated with identical specs; mismatched re-allocation raises.
+        """
+        if not self.active:
+            return
+        normalized = {
+            key: (tuple(int(s) for s in feature), np.dtype(dtype))
+            for key, (feature, dtype) in specs.items()
+        }
+        if self._specs is not None:
+            if self._specs != normalized:
+                raise ValueError(
+                    f"DeviceReplayRing.allocate specs mismatch: ring holds {self._specs}, "
+                    f"caller wants {normalized}"
+                )
+            if self._data is not None:
+                return
+        self._specs = normalized
+        self._allocate()
+
+    def make_step_write_fn(self) -> Callable[[Dict[str, Any], Dict[str, jax.Array], jax.Array], Dict[str, Any]]:
+        """Build the pure in-jit per-step writer ``write(state, row, mask)``.
+
+        The fused rollout scan appends one ``[E, *f]`` row per env step
+        directly into the ring pytree carried through the scan — zero host
+        staging, zero transfers. ``mask`` ([E] bool) gates which env
+        columns advance (dreamer's sparse reset rows); masked-out columns
+        are scattered out of bounds and dropped. Semantics match one
+        staged ``add`` + ``flush`` per masked column, so the host mirror
+        stays in lockstep via :meth:`advance_host`.
+        """
+        capacity = self.capacity
+        env_ids = jnp.arange(self.n_envs)
+
+        def write(state: Dict[str, Any], row: Dict[str, jax.Array], mask: jax.Array) -> Dict[str, Any]:
+            pos = state["pos"]
+            added = state["added"]
+            inc = mask.astype(jnp.int32)
+            t_idx = jnp.where(mask, pos, capacity)  # out-of-bounds -> dropped
+            data = {
+                key: value.at[t_idx, env_ids].set(
+                    row[key].astype(value.dtype), mode="drop"
+                )
+                for key, value in state["data"].items()
+            }
+            return {
+                "data": data,
+                "pos": (pos + inc) % capacity,
+                "added": jnp.minimum(added + inc, capacity),
+            }
+
+        return write
+
+    def adopt_state(self, state: Dict[str, Any], steps_written: Any = 0) -> None:
+        """Adopt the ring pytree a fused superstep returned (donated in, new
+        buffers out) and advance the host pos/added mirrors by the rows the
+        superstep wrote per env — pure host arithmetic, no device sync."""
+        if not self.active:
+            return
+        self._data = state["data"]
+        self._pos = state["pos"]
+        self._added = state["added"]
+        steps = np.asarray(steps_written, dtype=np.int64)
+        self._host_pos = (self._host_pos + steps) % self.capacity
+        self._host_added = np.minimum(self._host_added + steps, self.capacity)
+
     # ------------------------------------------------------------ sampling
     @property
     def state(self) -> Dict[str, Any]:

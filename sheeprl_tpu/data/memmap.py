@@ -10,13 +10,44 @@ duck-typing so buffer code can treat it as a plain array.
 from __future__ import annotations
 
 import os
+import threading
+from contextlib import contextmanager
 from pathlib import Path
-from typing import Any, Optional, Tuple
+from typing import Any, Iterator, Optional, Tuple
 
 import numpy as np
 from numpy.typing import DTypeLike
 
 _VALID_MODES = ("r+", "w+", "c", "copyonwrite", "readwrite", "write")
+
+# Thread-local flag set by ownership_transfer_scope(): only pickles taken
+# inside the scope strip file ownership from the SOURCE object.
+_TRANSFER_SCOPE = threading.local()
+
+
+@contextmanager
+def ownership_transfer_scope() -> Iterator[None]:
+    """Mark the enclosed pickling as a durable persistence path.
+
+    Pickling a :class:`MemmapArray` inside this scope relinquishes the
+    *source* object's file ownership: the pickle is a durable external
+    reference to the backing file (a buffer inside a checkpoint), and
+    deleting the file when the source is collected would strand it — a
+    resumed run would open a deleted file. The checkpoint save path
+    (``utils/checkpoint.py``) wraps its aux pickle in this scope.
+
+    Outside the scope, pickling still produces a non-owning copy (worker
+    processes never delete the file) but the source KEEPS ownership: a
+    transient pickle — shipping the buffer to an env worker, a debug
+    ``copy.deepcopy`` probe — must not silently leak the backing file's
+    lifetime to the run directory.
+    """
+    prev = getattr(_TRANSFER_SCOPE, "active", False)
+    _TRANSFER_SCOPE.active = True
+    try:
+        yield
+    finally:
+        _TRANSFER_SCOPE.active = prev
 
 
 class MemmapArray:
@@ -117,15 +148,17 @@ class MemmapArray:
         state["_array"] = None
         # Unpickled copies (e.g. in worker processes) never own the file.
         state["_has_ownership"] = False
-        # Being pickled means an external reference to the backing file now
-        # exists (a buffer-in-checkpoint, a worker): unlinking it when THIS
-        # object is collected would strand that reference — a resumed run
-        # would open a deleted file (observed: FileNotFoundError on the
-        # first post-resume add). Relinquish deletion; the file's lifetime
-        # now follows the run directory, not this process. (A transient
-        # pickle leaks the file — the lesser evil vs deleting data a
-        # checkpoint depends on; run dirs are user-collected anyway.)
-        self._has_ownership = False
+        # Inside ownership_transfer_scope() a durable external reference to
+        # the backing file now exists (a buffer-in-checkpoint): unlinking it
+        # when THIS object is collected would strand that reference — a
+        # resumed run would open a deleted file (observed: FileNotFoundError
+        # on the first post-resume add). Relinquish deletion; the file's
+        # lifetime now follows the run directory, not this process. Outside
+        # the scope the pickle is transient (a worker ship-over) and the
+        # source keeps ownership — stripping it here used to leak every
+        # memmap file a worker ever saw.
+        if getattr(_TRANSFER_SCOPE, "active", False):
+            self._has_ownership = False
         return state
 
     def __setstate__(self, state: dict) -> None:

@@ -1,0 +1,112 @@
+"""The pure-functional environment protocol of the Anakin lane.
+
+Podracer/Anakin (arXiv:2104.06272) gets its throughput from one property:
+the environment is a jit-safe pytree transform, so rollout AND training
+compile into a single XLA program and "interaction cost" disappears into
+the schedule. This module pins down the contract every first-party jax env
+(and every adapted gymnax-style env) satisfies:
+
+- ``reset(key) -> (state, obs)``: a fresh episode from a PRNG key. ``state``
+  is an arbitrary pytree (arrays only); ``obs`` is a single array.
+- ``step(state, action, key) -> (state, obs, reward, done, info)``: one
+  transition. ``reward`` is a float32 scalar, ``done`` a bool scalar, and
+  ``info`` a dict carrying at least ``terminated`` and ``truncated`` bool
+  scalars (``done = terminated | truncated``) so SAME_STEP autoreset and
+  the PPO truncation bootstrap can distinguish the two in-scan.
+
+Both functions are pure: vmap over a batch of states gives the vectorized
+env, `lax.scan` over steps gives the rollout, and the same instance drives
+the host-compatibility lane through
+:class:`~sheeprl_tpu.envs.jax.to_gymnasium.JaxToGymnasium`.
+
+Episode truncation is the env's own job (there is no TimeLimit wrapper
+inside a scan): envs carry a step counter in ``state`` and raise
+``truncated`` at :attr:`JaxEnv.max_episode_steps`.
+
+Action canonicalization: the Gymnasium lane rescales every bounded Box
+action space to [-1, 1] (utils/env.py wraps with RescaleAction), so agents
+always see the canonical space. :func:`canonical_action_space` /
+:func:`action_to_env` reproduce exactly that convention for the fused lane,
+keeping policies — and checkpoints — interchangeable between lanes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import gymnasium as gym
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["JaxEnv", "EnvState", "StepOut", "canonical_action_space", "action_to_env"]
+
+# State is an arbitrary pytree of arrays; steps return the 5-tuple below.
+EnvState = Any
+StepOut = Tuple[EnvState, jax.Array, jax.Array, jax.Array, Dict[str, jax.Array]]
+
+
+class JaxEnv:
+    """Base class for pure-functional environments.
+
+    Subclasses define :attr:`observation_space` / :attr:`action_space`
+    (single-env gymnasium spaces, reused verbatim by ``JaxToGymnasium``),
+    :attr:`max_episode_steps`, and the two pure methods. The base class
+    holds no mutable episode state — instances are safe to share across
+    jits, vmaps and threads.
+    """
+
+    observation_space: gym.Space
+    action_space: gym.Space
+    #: Steps after which ``truncated`` is raised; 0 disables truncation.
+    max_episode_steps: int = 0
+
+    def reset(self, key: jax.Array) -> Tuple[EnvState, jax.Array]:
+        raise NotImplementedError
+
+    def step(self, state: EnvState, action: jax.Array, key: jax.Array) -> StepOut:
+        raise NotImplementedError
+
+    # ------------------------------------------------------------- helpers
+    def _timeout(self, t: jax.Array) -> jax.Array:
+        """Truncation flag for an in-state step counter ``t`` (post-step)."""
+        if self.max_episode_steps <= 0:
+            return jnp.zeros_like(t, dtype=jnp.bool_)
+        return t >= self.max_episode_steps
+
+
+def canonical_action_space(env: JaxEnv) -> gym.Space:
+    """The action space agents see — Box spaces rescaled to [-1, 1].
+
+    Mirrors utils/env.py's RescaleAction wrapping so an agent built for the
+    fused lane has identical action semantics (and identical parameter
+    shapes) to one built on the Gymnasium lane.
+    """
+    space = env.action_space
+    if isinstance(space, gym.spaces.Box) and not (
+        np.allclose(space.low, -1.0) and np.allclose(space.high, 1.0)
+    ):
+        return gym.spaces.Box(-1.0, 1.0, space.shape, np.float32)
+    return space
+
+
+def action_to_env(env: JaxEnv) -> Callable[[jax.Array], jax.Array]:
+    """Pure map from canonical policy actions to the env's native actions.
+
+    The affine inverse of RescaleAction for rescaled Box spaces, identity
+    otherwise — applied in-scan right before ``env.step``.
+    """
+    space = env.action_space
+    if isinstance(space, gym.spaces.Box) and not (
+        np.allclose(space.low, -1.0) and np.allclose(space.high, 1.0)
+    ):
+        low = jnp.asarray(space.low, jnp.float32)
+        high = jnp.asarray(space.high, jnp.float32)
+
+        def rescale(action: jax.Array) -> jax.Array:
+            clipped = jnp.clip(action, -1.0, 1.0)
+            return low + (clipped + 1.0) * 0.5 * (high - low)
+
+        return rescale
+    return lambda action: action

@@ -1,0 +1,82 @@
+"""CartPole as a pure-functional jax env (Gymnasium `CartPole-v1` physics).
+
+Constants, Euler integration order, termination thresholds and the
+always-1.0 reward follow gymnasium's `cartpole.py` exactly, so the
+step-for-step equivalence test can copy a jax state into
+``env.unwrapped.state`` and walk both transition functions in lockstep.
+The only intentional difference: truncation (the 500-step limit Gymnasium
+delegates to TimeLimit) lives in the in-state step counter, because a
+wrapper cannot exist inside a `lax.scan`.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import gymnasium as gym
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.envs.jax.base import EnvState, JaxEnv, StepOut
+
+__all__ = ["CartPole"]
+
+
+class CartPole(JaxEnv):
+    gravity = 9.8
+    masscart = 1.0
+    masspole = 0.1
+    total_mass = masspole + masscart
+    length = 0.5  # half the pole's length
+    polemass_length = masspole * length
+    force_mag = 10.0
+    tau = 0.02  # seconds between state updates (Euler)
+    theta_threshold_radians = 12 * 2 * np.pi / 360
+    x_threshold = 2.4
+    max_episode_steps = 500
+
+    def __init__(self) -> None:
+        high = np.array(
+            [
+                self.x_threshold * 2,
+                np.finfo(np.float32).max,
+                self.theta_threshold_radians * 2,
+                np.finfo(np.float32).max,
+            ],
+            dtype=np.float32,
+        )
+        self.observation_space = gym.spaces.Box(-high, high, dtype=np.float32)
+        self.action_space = gym.spaces.Discrete(2)
+
+    def reset(self, key: jax.Array) -> Tuple[EnvState, jax.Array]:
+        s = jax.random.uniform(key, (4,), jnp.float32, minval=-0.05, maxval=0.05)
+        state = {"s": s, "t": jnp.zeros((), jnp.int32)}
+        return state, s
+
+    def step(self, state: EnvState, action: jax.Array, key: jax.Array) -> StepOut:
+        del key  # deterministic dynamics
+        s = state["s"]
+        x, x_dot, theta, theta_dot = s[0], s[1], s[2], s[3]
+        force = jnp.where(action.reshape(()).astype(jnp.int32) == 1, self.force_mag, -self.force_mag)
+        costheta = jnp.cos(theta)
+        sintheta = jnp.sin(theta)
+        temp = (force + self.polemass_length * theta_dot**2 * sintheta) / self.total_mass
+        thetaacc = (self.gravity * sintheta - costheta * temp) / (
+            self.length * (4.0 / 3.0 - self.masspole * costheta**2 / self.total_mass)
+        )
+        xacc = temp - self.polemass_length * thetaacc * costheta / self.total_mass
+        # Euler order matters for exactness: positions advance on the OLD
+        # velocities (gymnasium kinematics_integrator == "euler").
+        x = x + self.tau * x_dot
+        x_dot = x_dot + self.tau * xacc
+        theta = theta + self.tau * theta_dot
+        theta_dot = theta_dot + self.tau * thetaacc
+        s = jnp.stack([x, x_dot, theta, theta_dot]).astype(jnp.float32)
+        t = state["t"] + 1
+        terminated = (jnp.abs(x) > self.x_threshold) | (jnp.abs(theta) > self.theta_threshold_radians)
+        truncated = self._timeout(t) & ~terminated
+        reward = jnp.ones((), jnp.float32)  # 1.0 every step, incl. the terminating one
+        info: Dict[str, jax.Array] = {"terminated": terminated, "truncated": truncated}
+        return {"s": s, "t": t}, s, reward, terminated | truncated, info

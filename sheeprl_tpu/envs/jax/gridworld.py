@@ -1,0 +1,83 @@
+"""A pixel gridworld as a pure-functional jax env (dreamer_v3's native env).
+
+An N×N grid rendered to an RGB uint8 image entirely with jnp ops: the
+agent (red) navigates to the goal (green) with 4 discrete moves. Reward is
++1.0 on reaching the goal (terminates) and a small step penalty otherwise;
+episodes truncate at :attr:`Gridworld.max_episode_steps`. Agent and goal
+cells are drawn per-episode from the reset key, so the world-model has
+actual variety to learn.
+
+Rendering stays uint8 end-to-end (frames cross into the train jit
+unnormalized, exactly like the host pixel pipeline) and the canvas is
+scaled to ``screen_size`` with `jnp.repeat`, so obs shape matches what the
+Gymnasium lane's resize would produce and the two lanes build identical
+encoders.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import gymnasium as gym
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.envs.jax.base import EnvState, JaxEnv, StepOut
+
+__all__ = ["Gridworld"]
+
+_BACKGROUND = 24
+_GOAL_RGB = (40, 220, 40)
+_AGENT_RGB = (220, 40, 40)
+# Action -> (drow, dcol): up, down, left, right.
+_MOVES = ((-1, 0), (1, 0), (0, -1), (0, 1))
+
+
+class Gridworld(JaxEnv):
+    max_episode_steps = 100
+
+    def __init__(self, grid_size: int = 8, screen_size: int = 64, step_penalty: float = 0.01) -> None:
+        if screen_size % grid_size != 0:
+            raise ValueError(f"screen_size ({screen_size}) must be a multiple of grid_size ({grid_size})")
+        self.grid_size = int(grid_size)
+        self.screen_size = int(screen_size)
+        self.cell = self.screen_size // self.grid_size
+        self.step_penalty = float(step_penalty)
+        self.observation_space = gym.spaces.Box(0, 255, (self.screen_size, self.screen_size, 3), np.uint8)
+        self.action_space = gym.spaces.Discrete(4)
+
+    # ------------------------------------------------------------ rendering
+    def _render(self, agent: jax.Array, goal: jax.Array) -> jax.Array:
+        grid = jnp.full((self.grid_size, self.grid_size, 3), _BACKGROUND, jnp.uint8)
+        grid = grid.at[goal[0], goal[1]].set(jnp.asarray(_GOAL_RGB, jnp.uint8))
+        grid = grid.at[agent[0], agent[1]].set(jnp.asarray(_AGENT_RGB, jnp.uint8))
+        return jnp.repeat(jnp.repeat(grid, self.cell, axis=0), self.cell, axis=1)
+
+    # ------------------------------------------------------------- protocol
+    def reset(self, key: jax.Array) -> Tuple[EnvState, jax.Array]:
+        n_cells = self.grid_size * self.grid_size
+        k_agent, k_goal = jax.random.split(key)
+        agent_flat = jax.random.randint(k_agent, (), 0, n_cells)
+        goal_flat = jax.random.randint(k_goal, (), 0, n_cells)
+        # Never spawn on the goal: nudge a colliding goal to the next cell.
+        goal_flat = jnp.where(goal_flat == agent_flat, (goal_flat + 1) % n_cells, goal_flat)
+        agent = jnp.stack([agent_flat // self.grid_size, agent_flat % self.grid_size]).astype(jnp.int32)
+        goal = jnp.stack([goal_flat // self.grid_size, goal_flat % self.grid_size]).astype(jnp.int32)
+        state = {"agent": agent, "goal": goal, "t": jnp.zeros((), jnp.int32)}
+        return state, self._render(agent, goal)
+
+    def step(self, state: EnvState, action: jax.Array, key: jax.Array) -> StepOut:
+        del key  # deterministic dynamics
+        moves = jnp.asarray(_MOVES, jnp.int32)
+        delta = moves[action.reshape(()).astype(jnp.int32)]
+        agent = jnp.clip(state["agent"] + delta, 0, self.grid_size - 1)
+        t = state["t"] + 1
+        terminated = jnp.all(agent == state["goal"])
+        truncated = self._timeout(t) & ~terminated
+        reward = jnp.where(terminated, 1.0, -self.step_penalty).astype(jnp.float32)
+        obs = self._render(agent, state["goal"])
+        info: Dict[str, jax.Array] = {"terminated": terminated, "truncated": truncated}
+        new_state = {"agent": agent, "goal": state["goal"], "t": t}
+        return new_state, obs, reward, terminated | truncated, info

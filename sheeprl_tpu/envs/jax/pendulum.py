@@ -1,0 +1,70 @@
+"""Pendulum as a pure-functional jax env (Gymnasium `Pendulum-v1` physics).
+
+Continuous-control counterpart for the SAC fused lane. Dynamics, reward
+(`-(angle^2 + 0.1*thetadot^2 + 0.001*u^2)`), torque clipping and the reset
+distribution follow gymnasium's `pendulum.py` exactly; the 200-step
+truncation (TimeLimit on the Gymnasium side) lives in the in-state step
+counter. The native action space is Box(-2, 2): the canonical-agent
+rescaling to [-1, 1] is applied by the lane (base.py `action_to_env`),
+matching the RescaleAction wrapper of the host pipeline.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import gymnasium as gym
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.envs.jax.base import EnvState, JaxEnv, StepOut
+
+__all__ = ["Pendulum"]
+
+
+def _angle_normalize(x: jax.Array) -> jax.Array:
+    return ((x + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+
+
+class Pendulum(JaxEnv):
+    max_speed = 8.0
+    max_torque = 2.0
+    dt = 0.05
+    g = 10.0
+    m = 1.0
+    length = 1.0
+    max_episode_steps = 200
+
+    def __init__(self) -> None:
+        high = np.array([1.0, 1.0, self.max_speed], dtype=np.float32)
+        self.observation_space = gym.spaces.Box(-high, high, dtype=np.float32)
+        self.action_space = gym.spaces.Box(-self.max_torque, self.max_torque, (1,), np.float32)
+
+    def _obs(self, th: jax.Array, thdot: jax.Array) -> jax.Array:
+        return jnp.stack([jnp.cos(th), jnp.sin(th), thdot]).astype(jnp.float32)
+
+    def reset(self, key: jax.Array) -> Tuple[EnvState, jax.Array]:
+        high = jnp.array([jnp.pi, 1.0], jnp.float32)
+        s = jax.random.uniform(key, (2,), jnp.float32, minval=-high, maxval=high)
+        state = {"s": s, "t": jnp.zeros((), jnp.int32)}
+        return state, self._obs(s[0], s[1])
+
+    def step(self, state: EnvState, action: jax.Array, key: jax.Array) -> StepOut:
+        del key  # deterministic dynamics
+        th, thdot = state["s"][0], state["s"][1]
+        u = jnp.clip(action.reshape(()), -self.max_torque, self.max_torque)
+        costs = _angle_normalize(th) ** 2 + 0.1 * thdot**2 + 0.001 * u**2
+        newthdot = thdot + (
+            3.0 * self.g / (2.0 * self.length) * jnp.sin(th) + 3.0 / (self.m * self.length**2) * u
+        ) * self.dt
+        newthdot = jnp.clip(newthdot, -self.max_speed, self.max_speed)
+        newth = th + newthdot * self.dt
+        s = jnp.stack([newth, newthdot]).astype(jnp.float32)
+        t = state["t"] + 1
+        terminated = jnp.zeros((), jnp.bool_)
+        truncated = self._timeout(t)
+        reward = (-costs).astype(jnp.float32)
+        info: Dict[str, jax.Array] = {"terminated": terminated, "truncated": truncated}
+        return {"s": s, "t": t}, self._obs(newth, newthdot), reward, terminated | truncated, info

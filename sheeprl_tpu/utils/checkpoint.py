@@ -346,8 +346,15 @@ def save_checkpoint(
         with ocp.StandardCheckpointer() as ckptr:
             ckptr.save(os.path.join(staging, "arrays"), arrays)
         staging_aux = os.path.join(staging, "aux.pkl")
+        # The aux payload may hold MemmapArrays (buffer-in-checkpoint): this
+        # pickle is a durable reference to their backing files, so the
+        # sources must relinquish deletion — declared via the scope rather
+        # than as a side effect of any pickling (see data/memmap.py).
+        from sheeprl_tpu.data.memmap import ownership_transfer_scope
+
         with open(staging_aux, "wb") as fp:
-            pickle.dump(aux, fp)
+            with ownership_transfer_scope():
+                pickle.dump(aux, fp)
             fp.flush()
             os.fsync(fp.fileno())
         chaos.maybe_fail("checkpoint.before_manifest")

@@ -1,0 +1,179 @@
+"""Anakin-lane e2e tests: the fused rollout+train drivers, lane parity on
+the shared counters, cross-lane checkpoint resume (fused <-> Gymnasium) and
+the cli's fused-config validation."""
+
+import os
+
+import pytest
+
+from sheeprl_tpu.cli import evaluation, run
+from sheeprl_tpu.utils.checkpoint import load_checkpoint
+
+
+@pytest.fixture(autouse=True)
+def _chdir_tmp(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+
+
+def find_checkpoints(root):
+    ckpts = []
+    for r, dirs, files in os.walk(root):
+        for d in dirs:
+            if d.startswith("ckpt_") and d.endswith(".ckpt"):
+                ckpts.append(os.path.join(r, d))
+    return sorted(ckpts)
+
+
+def ppo_anakin_overrides(fused=True, **extra):
+    args = [
+        "exp=ppo_anakin",
+        "metric.log_level=0",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "algo.rollout_steps=8",
+        "algo.total_steps=64",
+        "algo.per_rank_batch_size=4",
+        "algo.update_epochs=1",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.encoder.mlp_features_dim=8",
+        "algo.run_test=False",
+        f"algo.fused_rollout={fused}",
+        "buffer.memmap=False",
+        "checkpoint.every=0",
+        "fabric.accelerator=cpu",
+        "fabric.devices=1",
+    ]
+    for k, v in extra.items():
+        args.append(f"{k}={v}")
+    return args
+
+
+def sac_anakin_overrides(fused=True, **extra):
+    args = [
+        "exp=sac_anakin",
+        "metric.log_level=0",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "algo.fused_superstep_steps=8",
+        "algo.fused_train_steps=4",
+        "algo.total_steps=96",
+        "algo.learning_starts=32",
+        "algo.per_rank_batch_size=4",
+        "algo.hidden_size=8",
+        "algo.run_test=False",
+        f"algo.fused_rollout={fused}",
+        "buffer.size=256",
+        "buffer.memmap=False",
+        "checkpoint.every=0",
+        "fabric.accelerator=cpu",
+        "fabric.devices=1",
+    ]
+    for k, v in extra.items():
+        args.append(f"{k}={v}")
+    return args
+
+
+def _with_save_last(args):
+    return [a for a in args if not a.startswith("checkpoint.every")] + [
+        "checkpoint.save_last=True"
+    ]
+
+
+class TestFusedPPO:
+    def test_fused_run_completes_with_expected_counters(self, tmp_path):
+        from sheeprl_tpu.core import fused_loop
+
+        run(ppo_anakin_overrides())
+        stats = fused_loop.last_run_stats()
+        # total_steps=64 at 2 envs x 8 rollout steps = 4 supersteps, one
+        # donated dispatch each, 64 env steps total.
+        assert stats["supersteps"] == 4
+        assert stats["env_steps"] == 64
+        assert stats["jit_dispatches"] == stats["supersteps"]
+
+    def test_lane_counter_parity(self, tmp_path):
+        """Fused and interact() lanes on the SAME jax env and budget finish
+        with identical progress counters in their checkpoints."""
+        run(_with_save_last(ppo_anakin_overrides(fused=True)))
+        fused_ckpts = find_checkpoints(tmp_path / "logs")
+        assert fused_ckpts, "fused lane wrote no checkpoint"
+        fused_state = load_checkpoint(fused_ckpts[-1])
+        run(_with_save_last(ppo_anakin_overrides(fused=False)))
+        gym_ckpts = [c for c in find_checkpoints(tmp_path / "logs") if c not in fused_ckpts]
+        assert gym_ckpts, "gymnasium lane wrote no checkpoint"
+        gym_state = load_checkpoint(gym_ckpts[-1])
+        assert fused_state["iter_num"] == gym_state["iter_num"]
+        assert fused_state["batch_size"] == gym_state["batch_size"]
+        assert set(fused_state.keys()) == set(gym_state.keys())
+
+    def test_fused_checkpoint_resumes_on_gymnasium_lane(self, tmp_path):
+        run(_with_save_last(ppo_anakin_overrides(fused=True)))
+        ckpts = find_checkpoints(tmp_path / "logs")
+        assert ckpts
+        evaluation([f"checkpoint_path={ckpts[-1]}", "fabric.accelerator=cpu"])
+        resume = ppo_anakin_overrides(fused=False, **{"algo.total_steps": 128})
+        resume.append(f"checkpoint.resume_from={ckpts[-1]}")
+        run(resume)
+
+    def test_gymnasium_checkpoint_resumes_on_fused_lane(self, tmp_path):
+        run(_with_save_last(ppo_anakin_overrides(fused=False)))
+        ckpts = find_checkpoints(tmp_path / "logs")
+        assert ckpts
+        resume = ppo_anakin_overrides(fused=True, **{"algo.total_steps": 128})
+        resume.append(f"checkpoint.resume_from={ckpts[-1]}")
+        run(resume)
+
+
+class TestFusedSAC:
+    def test_fused_run_completes_with_expected_counters(self, tmp_path):
+        from sheeprl_tpu.core import fused_loop
+
+        run(sac_anakin_overrides())
+        stats = fused_loop.last_run_stats()
+        # 96 total steps at 2 envs = 48 iterations in supersteps of 8.
+        assert stats["supersteps"] == 6
+        assert stats["env_steps"] == 96
+        assert stats["jit_dispatches"] >= stats["supersteps"]
+
+    def test_fused_checkpoint_resumes_on_gymnasium_lane(self, tmp_path):
+        run(_with_save_last(sac_anakin_overrides(fused=True)))
+        ckpts = find_checkpoints(tmp_path / "logs")
+        assert ckpts
+        evaluation([f"checkpoint_path={ckpts[-1]}", "fabric.accelerator=cpu"])
+        resume = sac_anakin_overrides(fused=False, **{"algo.total_steps": 128})
+        resume.append(f"checkpoint.resume_from={ckpts[-1]}")
+        run(resume)
+
+    def test_gymnasium_checkpoint_resumes_on_fused_lane(self, tmp_path):
+        run(_with_save_last(sac_anakin_overrides(fused=False)))
+        ckpts = find_checkpoints(tmp_path / "logs")
+        assert ckpts
+        resume = sac_anakin_overrides(fused=True, **{"algo.total_steps": 128})
+        resume.append(f"checkpoint.resume_from={ckpts[-1]}")
+        run(resume)
+
+
+class TestFusedConfigValidation:
+    def test_fused_rollout_requires_jax_native(self, tmp_path):
+        with pytest.raises(ValueError, match="jax_native"):
+            run(ppo_anakin_overrides(**{"env.jax_native": False}))
+
+    def test_fused_rollout_rejects_unsupported_algo(self, tmp_path):
+        with pytest.raises(ValueError, match="fused_rollout"):
+            run([
+                "exp=a2c",
+                "env=jax_cartpole",
+                "dry_run=True",
+                "metric.log_level=0",
+                "+algo.fused_rollout=True",
+                "fabric.accelerator=cpu",
+            ])
+
+    def test_jax_native_requires_registered_env(self, tmp_path):
+        with pytest.raises(ValueError, match="registered jax env"):
+            run(ppo_anakin_overrides(**{"env.id": "not_a_jax_env"}))
+
+    def test_superstep_steps_must_be_positive(self, tmp_path):
+        with pytest.raises(ValueError, match="fused_superstep_steps"):
+            run(sac_anakin_overrides(**{"algo.fused_superstep_steps": 0}))

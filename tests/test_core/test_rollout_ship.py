@@ -1,0 +1,113 @@
+"""Lock tests for core/rollout.py's ship-layout decision: shard when the
+env axis divides the data axis, fall back to coherent replication when it
+does not (single process or post-allgather), and refuse the incoherent
+multi-process replicate."""
+
+import types
+
+import numpy as np
+import pytest
+
+import jax as real_jax
+
+from sheeprl_tpu.core.rollout import _ship_rollout
+
+
+class FakeRuntime:
+    def __init__(self, world_size):
+        self.world_size = world_size
+
+    def shard_batch(self, tree, axis=0):
+        return ("sharded", axis, tree)
+
+    def replicate(self, tree):
+        return ("replicated", tree)
+
+
+def _fake_jax(process_count):
+    """Real jax with only process_count() overridden: the share_data path
+    still runs the real (single-host) allgather underneath."""
+    fake = types.SimpleNamespace(
+        process_count=lambda: process_count,
+        tree_util=real_jax.tree_util,
+    )
+    return fake
+
+
+def _local_data(T=4, E=2):
+    data = {
+        "observations": np.zeros((T, E, 3), np.float32),
+        "actions": np.zeros((T, E, 1), np.float32),
+        "rewards": np.zeros((T, E, 1), np.float32),
+        "values": np.zeros((T, E, 1), np.float32),
+        "dones": np.zeros((T, E, 1), np.float32),
+    }
+    next_obs = {"observations": np.zeros((E, 3), np.float32)}
+    return data, next_obs
+
+
+class TestShipLayout:
+    def test_divisible_env_axis_shards(self):
+        data, next_obs = _local_data(E=4)
+        runtime = FakeRuntime(world_size=2)
+        out_data, out_next = _ship_rollout(
+            runtime, data, ("observations", "actions"), next_obs, False, _fake_jax(1)
+        )
+        assert out_data[0] == "sharded" and out_data[1] == 1
+        assert out_next[0] == "sharded" and out_next[1] == 0
+
+    def test_single_process_indivisible_replicates_with_warning(self):
+        data, next_obs = _local_data(E=2)
+        runtime = FakeRuntime(world_size=3)
+        with pytest.warns(UserWarning, match="replicated to every device"):
+            out_data, out_next = _ship_rollout(
+                runtime, data, ("observations", "actions"), next_obs, False, _fake_jax(1)
+            )
+        assert out_data[0] == "replicated"
+        assert out_next[0] == "replicated"
+
+    def test_multi_process_indivisible_without_share_data_raises(self):
+        """Replication is incoherent when processes hold DIFFERENT rollouts:
+        the fallback must refuse, pointing at buffer.share_data."""
+        data, next_obs = _local_data(E=2)
+        runtime = FakeRuntime(world_size=3)
+        with pytest.raises(ValueError, match="share_data"):
+            _ship_rollout(
+                runtime, data, ("observations", "actions"), next_obs, False, _fake_jax(2)
+            )
+
+    @pytest.fixture
+    def _two_process_allgather(self, monkeypatch):
+        """process_allgather returns trees with a leading process axis; on a
+        single host it is a no-op, so simulate P=2 by stacking two copies."""
+        from jax.experimental import multihost_utils
+
+        monkeypatch.setattr(
+            multihost_utils,
+            "process_allgather",
+            lambda tree: real_jax.tree_util.tree_map(lambda v: np.stack([v, v]), tree),
+        )
+
+    def test_share_data_gather_then_indivisible_replicates(self, _two_process_allgather):
+        """After the share_data allgather every process holds the identical
+        union, so the indivisible fallback IS coherent and replicates."""
+        data, next_obs = _local_data(E=2)
+        runtime = FakeRuntime(world_size=3)
+        with pytest.warns(UserWarning, match="replicated to every device"):
+            out_data, out_next = _ship_rollout(
+                runtime, data, ("observations", "actions"), next_obs, True, _fake_jax(2)
+            )
+        assert out_data[0] == "replicated"
+        # The gather reshapes (P, T, E, ...) into (T, P*E, ...): with two
+        # simulated processes the env axis doubles (2 -> 4, not % 3 == 0).
+        assert out_data[1]["rewards"].shape == (4, 4, 1)
+        assert out_next[1]["observations"].shape == (4, 3)
+
+    def test_share_data_gather_then_divisible_shards(self, _two_process_allgather):
+        data, next_obs = _local_data(E=2)
+        runtime = FakeRuntime(world_size=2)
+        out_data, out_next = _ship_rollout(
+            runtime, data, ("observations", "actions"), next_obs, True, _fake_jax(2)
+        )
+        assert out_data[0] == "sharded"
+        assert out_data[2]["observations"].shape == (4, 4, 3)

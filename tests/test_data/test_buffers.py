@@ -300,14 +300,20 @@ class TestMemmapArray:
         del arr
         assert not filename.exists()
 
-    def test_pickling_relinquishes_source_ownership(self, tmp_path):
-        """A pickled mapping (buffer-in-checkpoint) must survive the source
-        process: collecting the ORIGINAL after pickling may not unlink the
-        backing file, or a resumed run would open a deleted file (observed
-        as FileNotFoundError on the first post-resume add)."""
+    def test_persistence_pickling_relinquishes_source_ownership(self, tmp_path):
+        """A pickled mapping on a persistence path (buffer-in-checkpoint)
+        must survive the source process: collecting the ORIGINAL after
+        pickling may not unlink the backing file, or a resumed run would
+        open a deleted file (observed as FileNotFoundError on the first
+        post-resume add). Persistence paths declare themselves with
+        ownership_transfer_scope() — utils/checkpoint.py wraps its aux
+        pickle in it."""
+        from sheeprl_tpu.data.memmap import ownership_transfer_scope
+
         arr = MemmapArray(tmp_path / "c.memmap", np.float32, (2, 2))
         arr[:] = 3
-        blob = pickle.dumps(arr)
+        with ownership_transfer_scope():
+            blob = pickle.dumps(arr)
         filename = arr.filename
         del arr  # the "training process exits"
         assert filename.exists()
@@ -315,6 +321,32 @@ class TestMemmapArray:
         np.testing.assert_array_equal(np.asarray(restored), 3)
         restored[0, 0] = 9  # post-resume writes must work too
         assert float(restored[0, 0]) == 9.0
+
+    def test_transient_pickling_keeps_source_ownership(self, tmp_path):
+        """Outside ownership_transfer_scope() a pickle is transient (a
+        worker ship-over): the clone never owns the file, but the source
+        keeps ownership so the backing file doesn't leak past its life."""
+        arr = MemmapArray(tmp_path / "t.memmap", np.float32, (2, 2))
+        arr[:] = 4
+        clone = pickle.loads(pickle.dumps(arr))
+        assert not clone.has_ownership
+        assert arr.has_ownership
+        filename = arr.filename
+        del clone  # non-owner: file stays
+        assert filename.exists()
+        del arr  # owner: file goes
+        assert not filename.exists()
+
+    def test_ownership_transfer_scope_restores_previous_state(self, tmp_path):
+        from sheeprl_tpu.data import memmap as memmap_mod
+        from sheeprl_tpu.data.memmap import ownership_transfer_scope
+
+        with ownership_transfer_scope():
+            with ownership_transfer_scope():
+                assert memmap_mod._TRANSFER_SCOPE.active
+            # Nested exit must not clear the outer scope.
+            assert memmap_mod._TRANSFER_SCOPE.active
+        assert not memmap_mod._TRANSFER_SCOPE.active
 
     def test_from_array(self, tmp_path):
         src = np.arange(6, dtype=np.int32).reshape(2, 3)

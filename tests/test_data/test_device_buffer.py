@@ -182,3 +182,99 @@ class TestAmend:
         ring.flush()
         ring.amend_last(0, {"rewards": np.full((1,), 5.0, np.float32)})
         assert float(np.asarray(ring.state["data"]["rewards"])[2, 0, 0]) == 5.0
+
+
+class TestFusedLaneInterface:
+    """The in-jit writer path the Anakin lane uses: eager allocate from
+    specs, per-step masked writes inside a scan, and host-mirror adoption
+    of the donated state."""
+
+    SPECS = {
+        "obs": ((1,), np.float32),
+        "rewards": ((1,), np.float32),
+    }
+
+    def test_allocate_then_state_without_add(self):
+        ring = make_ring(8, 2)
+        ring.allocate(self.SPECS)
+        state = ring.state  # must not raise: the ring exists pre-first-add
+        assert state["data"]["obs"].shape == (8, 2, 1)
+        assert np.asarray(state["pos"]).tolist() == [0, 0]
+
+    def test_allocate_identical_specs_is_noop_mismatch_raises(self):
+        ring = make_ring(8, 2)
+        ring.allocate(self.SPECS)
+        ring.allocate(self.SPECS)  # no-op
+        with pytest.raises(ValueError, match="specs mismatch"):
+            ring.allocate({"obs": ((3,), np.float32), "rewards": ((1,), np.float32)})
+
+    def test_step_write_fn_appends_and_wraps(self):
+        ring = make_ring(4, 2)
+        ring.allocate(self.SPECS)
+        write = jax.jit(ring.make_step_write_fn())
+        state = ring.state
+        ones_mask = np.ones((2,), bool)
+        for t in range(6):
+            row = {
+                "obs": np.full((2, 1), float(t), np.float32),
+                "rewards": np.zeros((2, 1), np.float32),
+            }
+            state = write(state, row, ones_mask)
+        ring.adopt_state(state, 6)
+        assert np.asarray(ring.state["pos"]).tolist() == [2, 2]
+        assert np.asarray(ring.state["added"]).tolist() == [4, 4]
+        # 6 rows through capacity 4: values 2..5 survive.
+        stored = np.sort(np.asarray(ring.state["data"]["obs"])[:, 0, 0])
+        np.testing.assert_array_equal(stored, [2.0, 3.0, 4.0, 5.0])
+
+    def test_step_write_fn_mask_gates_env_columns(self):
+        ring = make_ring(8, 2)
+        ring.allocate(self.SPECS)
+        write = ring.make_step_write_fn()
+        state = ring.state
+        row = {
+            "obs": np.full((2, 1), 9.0, np.float32),
+            "rewards": np.zeros((2, 1), np.float32),
+        }
+        state = write(state, row, np.asarray([False, True]))
+        ring.adopt_state(state, np.asarray([0, 1]))
+        assert np.asarray(ring.state["pos"]).tolist() == [0, 1]
+        assert float(np.asarray(ring.state["data"]["obs"])[0, 1, 0]) == 9.0
+        # The masked-out column wrote nothing.
+        assert float(np.asarray(ring.state["data"]["obs"])[0, 0, 0]) == 0.0
+
+    def test_adopt_state_advances_host_mirror_for_ready(self):
+        ring = make_ring(8, 2)
+        ring.allocate(self.SPECS)
+        assert not ring.ready(2)
+        write = ring.make_step_write_fn()
+        state = ring.state
+        for t in range(3):
+            row = {
+                "obs": np.full((2, 1), float(t), np.float32),
+                "rewards": np.zeros((2, 1), np.float32),
+            }
+            state = write(state, row, np.ones((2,), bool))
+        ring.adopt_state(state, 3)
+        assert ring.ready(3)
+        assert not ring.ready(4)
+
+    def test_fused_writes_compose_with_host_add(self):
+        """allocate() fixes specs first; later host-lane adds must cast and
+        land after the in-jit rows (resume path: allocate -> load -> flush)."""
+        ring = make_ring(8, 2)
+        ring.allocate(self.SPECS)
+        write = ring.make_step_write_fn()
+        state = write(
+            ring.state,
+            {
+                "obs": np.full((2, 1), 1.0, np.float32),
+                "rewards": np.zeros((2, 1), np.float32),
+            },
+            np.ones((2,), bool),
+        )
+        ring.adopt_state(state, 1)
+        ring.add(make_steps(2, 2, base=10))
+        ring.flush()
+        col = np.asarray(ring.state["data"]["obs"])[:3, 0, 0]
+        np.testing.assert_array_equal(col, [1.0, 10.0, 12.0])
