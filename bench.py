@@ -31,7 +31,9 @@ Workloads:
 `python bench.py [dreamer_v3|dreamer_v3_devbuf|dreamer_v3_pipe|dreamer_v3_S|
 dreamer_v3_S_b32|dreamer_v3_S_b64|dreamer_v3_health|dreamer_v2|dreamer_v1|
 ppo|a2c|sac|sac_devbuf|sac_pipe|sac_resilience|sac_health|sac_flight|
-serve_sac|serve_sac_traced|ppo_anakin|sac_anakin|dreamer_v3_anakin]`. The `*_pipe` legs are the
+serve_sac|serve_sac_traced|ppo_anakin|sac_anakin|dreamer_v3_anakin|
+graftlint_repo]`. `graftlint_repo` is the static-analysis leg: whole-package
+graftlint wall time vs the 10 s CI-gate budget (no jax import on that path). The `*_pipe` legs are the
 pipelined-interaction A/B (fabric.async_fetch, env.pipeline_slices —
 core/interact.py); every result embeds the interaction time split and
 overlap fraction from the long run. `sac_resilience` is the fault-tolerance
@@ -763,8 +765,36 @@ def bench_dreamer_v3_anakin():
     )
 
 
+def bench_graftlint_repo():
+    """Analyzer wall time over the whole package: the CI lint gate's <=10 s
+    CPU budget as a measured number instead of a vibe. vs_baseline is
+    budget/actual, so >=1.0 means within budget. No jax import anywhere on
+    this path — graftlint deliberately runs without the accelerator stack."""
+    from sheeprl_tpu.analysis.runner import lint_paths_ex
+
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    t0 = time.perf_counter()
+    result = lint_paths_ex([os.path.join(repo_root, "sheeprl_tpu")], root=repo_root)
+    wall = time.perf_counter() - t0
+    return {
+        "metric": "graftlint_repo_wall_seconds",
+        "value": round(wall, 3),
+        "unit": "seconds",
+        "vs_baseline": round(10.0 / max(wall, 1e-9), 3),
+        "files_scanned": result.files_scanned,
+        "findings": len(result.findings),
+        "suppressed": result.suppressed,
+        "parse_seconds": round(result.parse_s, 3),
+        "backend": "none",
+    }
+
+
 def main() -> None:
     which = sys.argv[1] if len(sys.argv) > 1 else "dreamer_v3"
+    if which == "graftlint_repo":
+        # Static-analysis leg: no accelerator probe, no jax, no registry.
+        print(json.dumps(bench_graftlint_repo()))
+        return
     # PPO/A2C/SAC are the reference's 4-CPU workloads and pin
     # fabric.accelerator=cpu in their exp configs; select the CPU platform
     # outright so the accelerator plugin is never initialized for them.

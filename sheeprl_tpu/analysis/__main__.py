@@ -20,8 +20,10 @@ from sheeprl_tpu.analysis.baseline import (
     save_baseline,
 )
 from sheeprl_tpu.analysis.registry import all_rules
-from sheeprl_tpu.analysis.reporter import render_json, render_text
-from sheeprl_tpu.analysis.runner import lint_paths
+from sheeprl_tpu.analysis.reporter import render_json, render_sarif, render_text
+from sheeprl_tpu.analysis.runner import changed_files, lint_paths_ex
+
+_RENDERERS = {"text": render_text, "json": render_json, "sarif": render_sarif}
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -30,7 +32,31 @@ def build_parser() -> argparse.ArgumentParser:
         description="graftlint: JAX correctness linter for sheeprl-tpu",
     )
     parser.add_argument("paths", nargs="*", default=["sheeprl_tpu"], help="files or directories to lint")
-    parser.add_argument("--json", action="store_true", help="emit the stable JSON report instead of text")
+    parser.add_argument(
+        "--format",
+        choices=sorted(_RENDERERS),
+        default=None,
+        help="output format (default: text). `sarif` emits SARIF 2.1.0 for CI annotators.",
+    )
+    parser.add_argument("--json", action="store_true", help="alias for --format json")
+    parser.add_argument(
+        "--changed-only",
+        metavar="REF",
+        default=None,
+        help="restrict *reported* findings to files changed vs the git ref "
+        "(analysis still runs project-wide so cross-module rules stay sound)",
+    )
+    parser.add_argument(
+        "--jobs",
+        type=int,
+        default=None,
+        help="parallel file-scan workers (default: min(8, cpus); 1 = serial)",
+    )
+    parser.add_argument(
+        "--stats",
+        action="store_true",
+        help="print per-rule wall-time stats to stderr after the report",
+    )
     parser.add_argument("--baseline", default=None, help=f"baseline file (default: nearest {BASELINE_FILENAME})")
     parser.add_argument("--no-baseline", action="store_true", help="ignore any baseline file")
     parser.add_argument(
@@ -55,6 +81,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"{rule.id}  {rule.name}: {rule.rationale}")
         return 0
 
+    if args.json and args.format not in (None, "json"):
+        print("graftlint: --json conflicts with --format", file=sys.stderr)
+        return 2
+    fmt = args.format or ("json" if args.json else "text")
+
     for path in args.paths:
         if not os.path.exists(path):
             print(f"graftlint: path does not exist: {path}", file=sys.stderr)
@@ -74,7 +105,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         baseline_path = discover_baseline(os.path.abspath(args.paths[0]))
     root = os.path.dirname(os.path.abspath(baseline_path)) if baseline_path else os.getcwd()
 
-    findings, files_scanned, suppressed = lint_paths(args.paths, root=root, rules=rules)
+    result = lint_paths_ex(args.paths, root=root, rules=rules, jobs=args.jobs)
+    findings = result.findings
+
+    if args.changed_only:
+        changed = changed_files(args.changed_only, cwd=root)
+        if changed is None:
+            print(
+                f"graftlint: could not diff against {args.changed_only!r}; "
+                "reporting all findings",
+                file=sys.stderr,
+            )
+        else:
+            changed_set = {p.replace(os.sep, "/") for p in changed}
+            findings = [f for f in findings if f.path in changed_set]
 
     if args.write_baseline:
         target = baseline_path or os.path.join(os.getcwd(), BASELINE_FILENAME)
@@ -86,8 +130,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     if baseline_path and not args.no_baseline:
         findings, baselined = apply_baseline(findings, load_baseline(baseline_path))
 
-    render = render_json if args.json else render_text
-    print(render(findings, files_scanned, baselined=baselined, suppressed=suppressed))
+    render = _RENDERERS[fmt]
+    print(render(findings, result.files_scanned, baselined=baselined, suppressed=result.suppressed))
+
+    if args.stats:
+        print(
+            f"graftlint: {result.files_scanned} file(s) in {result.total_s:.2f}s "
+            f"(parse {result.parse_s:.2f}s)",
+            file=sys.stderr,
+        )
+        for rule_id, dt in sorted(result.rule_timings.items(), key=lambda kv: -kv[1]):
+            print(f"  {rule_id}  {dt * 1000:8.1f} ms", file=sys.stderr)
+
     return 1 if findings else 0
 
 

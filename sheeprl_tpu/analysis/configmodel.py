@@ -1,0 +1,256 @@
+"""Static model of a Hydra-lite ``configs/`` tree for GL011.
+
+GL011 has to answer two questions without running the composer:
+
+1. does a ``cfg.<path>`` read in code resolve to *any* key the config tree
+   can produce, under *any* group selection?
+2. is a YAML leaf reachable by any code read or ``${...}`` interpolation,
+   or is it dead weight?
+
+Composing with the real :mod:`sheeprl_tpu.config.loader` cannot answer
+either: the root config pins ``exp: ???`` (composition fails without an
+experiment) and any *single* composition sees exactly one option per group
+— keys that only exist in the non-default ``algo: dreamer_v3`` would flag
+as unknown under the default ``algo: default``. So the model is a **union
+mount**: every file of every group is mounted at the package that group
+composes into, and a path resolves when any mounted file provides it.
+
+Mount packages come from three places, mirroring the composer's rules:
+
+* the group path itself (``algo/ppo.yaml`` mounts at ``algo``);
+* a ``# @package <pkg>`` header (``_global_`` mounts at the root — the
+  whole ``exp/`` group; a literal path mounts there);
+* ``@pkg`` entries in a file's own defaults list: ``/optim@world_model.
+  optimizer: adam`` inside ``algo/dreamer_v3.yaml`` re-mounts the entire
+  ``optim`` group under ``algo.world_model.optimizer`` — *all* optim
+  files, because any of them could be selected.
+
+The union is deliberately permissive for resolution (question 1 never
+false-positives because a key lives in a sibling option) and deliberately
+*structural* for deadness: a leaf under an "open" mapping — one holding a
+``_target_`` (consumed wholesale by instantiate) or non-identifier keys
+(``Loss/value_loss`` metric names, looked up dynamically) — is never dead.
+
+Parsing uses ``yaml.compose`` so every leaf carries its source line for
+the finding; per-line ``# graftlint: disable=GL011`` comments in the YAML
+are honored through the same suppression table as Python files.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from sheeprl_tpu.analysis.context import parse_suppressions
+
+try:  # pragma: no cover - exercised only when PyYAML is genuinely absent
+    import yaml
+except Exception:  # noqa: BLE001
+    yaml = None  # type: ignore[assignment]
+
+_PACKAGE_RE = re.compile(r"^#\s*@package\s+(\S+)", re.MULTILINE)
+_INTERP_RE = re.compile(r"\$\{([A-Za-z_][\w.]*)\}")
+_PKG_DEFAULT_RE = re.compile(r"^/?(?P<group>[\w/]+)@(?P<pkg>[\w.]+)$")
+_IDENT_RE = re.compile(r"^[A-Za-z_]\w*$")
+
+# Structural keys of the composition machinery itself — never config data.
+_META_KEYS = {"defaults", "_self_"}
+
+
+@dataclass(frozen=True)
+class ConfigLeaf:
+    path: str  # full dotted path after mounting ("algo.mlp_layers")
+    file: str  # absolute path of the defining YAML file
+    line: int  # 1-indexed source line of the key
+
+
+@dataclass
+class ConfigModel:
+    root: str  # the configs/ directory
+    known: Set[str] = field(default_factory=set)  # every leaf + prefix
+    leaves: List[ConfigLeaf] = field(default_factory=list)
+    open_prefixes: Set[str] = field(default_factory=set)  # dynamic subtrees
+    interp_used: Set[str] = field(default_factory=set)
+    suppressions: Dict[str, Dict[int, Set[str]]] = field(default_factory=dict)
+    lines: Dict[str, List[str]] = field(default_factory=dict)
+
+    # ------------------------------------------------------------- resolution
+    def resolves(self, path: str) -> bool:
+        """Can any composition produce this dotted path?"""
+        if not path or path in self.known:
+            return True
+        return self._under_open(path)
+
+    def _under_open(self, path: str) -> bool:
+        parts = path.split(".")
+        for i in range(len(parts), 0, -1):
+            if ".".join(parts[:i]) in self.open_prefixes:
+                return True
+        return False
+
+    # --------------------------------------------------------------- deadness
+    def dead_leaves(self, used: Set[str]) -> List[ConfigLeaf]:
+        """Leaves no code read, interpolation, or open subtree reaches.
+
+        ``used`` holds dotted paths extracted from code. A leaf is live when
+        any used path lies on its root-to-leaf chain in either direction: a
+        read of ``algo`` wholesale keeps every ``algo.*`` leaf, a read of
+        ``algo.mlp_keys.encoder.0`` keeps the ``algo.mlp_keys.encoder``
+        leaf."""
+        touched = used | self.interp_used
+        out: List[ConfigLeaf] = []
+        for leaf in self.leaves:
+            if leaf.path.rsplit(".", 1)[-1].startswith("_"):
+                continue
+            if self._under_open(leaf.path):
+                continue
+            if any(_on_chain(u, leaf.path) for u in touched):
+                continue
+            out.append(leaf)
+        return out
+
+    # ------------------------------------------------------------------ build
+    @classmethod
+    def load(cls, root: str) -> "ConfigModel":
+        model = cls(root=os.path.abspath(root))
+        if yaml is None:
+            # Without a YAML parser everything resolves and nothing is dead:
+            # the rule degrades to silent rather than wrong.
+            model.open_prefixes.add("")
+            return model
+        sources: Dict[str, str] = {}
+        for file in _yaml_files(model.root):
+            try:
+                with open(file, "r", encoding="utf-8") as fh:
+                    sources[file] = fh.read()
+            except OSError:
+                continue
+            model.suppressions[file] = parse_suppressions(sources[file])
+            model.lines[file] = sources[file].splitlines()
+        mounts = _plan_mounts(model.root, sources)
+        for package, file in mounts:
+            model._mount(package, file, sources[file])
+        # Prefixes of every leaf resolve (reading `cfg.algo` is fine).
+        for leaf in list(model.leaves):
+            parts = leaf.path.split(".")
+            for i in range(1, len(parts) + 1):
+                model.known.add(".".join(parts[:i]))
+        for match in _INTERP_RE.finditer("\n".join(sources.values())):
+            model.interp_used.add(match.group(1))
+        return model
+
+    def _mount(self, package: str, file: str, source: str) -> None:
+        try:
+            node = yaml.compose(source)  # type: ignore[union-attr]
+        except yaml.YAMLError:  # type: ignore[union-attr]
+            return
+        if node is None or not isinstance(node, yaml.MappingNode):  # type: ignore[union-attr]
+            return
+        self._walk(node, package, file, top=True)
+
+    def _walk(self, node, prefix: str, file: str, top: bool = False) -> None:
+        for key_node, value_node in node.value:
+            key = getattr(key_node, "value", None)
+            if not isinstance(key, str):
+                self.open_prefixes.add(prefix)
+                continue
+            if top and key in _META_KEYS:
+                continue
+            if not _IDENT_RE.match(key):
+                # `Loss/value_loss`, `${...}` keys: dynamic lookup territory.
+                self.open_prefixes.add(prefix)
+                continue
+            path = f"{prefix}.{key}" if prefix else key
+            if key == "_target_":
+                # instantiate() consumes the whole mapping; sibling keys are
+                # constructor kwargs, unknowable statically.
+                self.open_prefixes.add(prefix)
+            if isinstance(value_node, yaml.MappingNode) and value_node.value:  # type: ignore[union-attr]
+                self._walk(value_node, path, file)
+            else:
+                self.known.add(path)
+                self.leaves.append(
+                    ConfigLeaf(path=path, file=file, line=key_node.start_mark.line + 1)
+                )
+
+
+def _on_chain(a: str, b: str) -> bool:
+    """True when `a` and `b` lie on one root-to-leaf chain."""
+    return a == b or b.startswith(a + ".") or a.startswith(b + ".")
+
+
+def _yaml_files(root: str) -> Iterator[str]:
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames.sort()
+        for name in sorted(filenames):
+            if name.endswith((".yaml", ".yml")):
+                yield os.path.join(dirpath, name)
+
+
+def _base_package(root: str, file: str, source: str) -> str:
+    """Natural mount package: the group path, unless a header overrides."""
+    rel_dir = os.path.relpath(os.path.dirname(file), root)
+    group_pkg = "" if rel_dir == "." else rel_dir.replace(os.sep, ".")
+    m = _PACKAGE_RE.search(source)
+    if m:
+        declared = m.group(1)
+        if declared == "_global_":
+            return ""
+        if declared == "_group_":
+            return group_pkg
+        return declared.replace("/", ".")
+    return group_pkg
+
+
+def _defaults_entries(source: str) -> List[Tuple[str, object]]:
+    """(key, value) pairs of the file's defaults list, best effort."""
+    try:
+        data = yaml.safe_load(source)  # type: ignore[union-attr]
+    except Exception:  # noqa: BLE001
+        return []
+    if not isinstance(data, dict):
+        return []
+    defaults = data.get("defaults")
+    if not isinstance(defaults, list):
+        return []
+    out: List[Tuple[str, object]] = []
+    for entry in defaults:
+        if isinstance(entry, dict):
+            for k, v in entry.items():
+                if isinstance(k, str):
+                    out.append((k, v))
+    return out
+
+
+def _plan_mounts(root: str, sources: Dict[str, str]) -> List[Tuple[str, str]]:
+    """(package, file) union mounts: natural group mounts plus the transitive
+    ``@pkg`` re-mounts pulled in by defaults lists."""
+    by_group: Dict[str, List[str]] = {}
+    natural: List[Tuple[str, str]] = []
+    for file, source in sources.items():
+        rel_dir = os.path.relpath(os.path.dirname(file), root)
+        group = "" if rel_dir == "." else rel_dir.replace(os.sep, "/")
+        by_group.setdefault(group, []).append(file)
+        natural.append((_base_package(root, file, source), file))
+
+    mounts: List[Tuple[str, str]] = []
+    seen: Set[Tuple[str, str]] = set()
+    worklist = list(natural)
+    while worklist:
+        package, file = worklist.pop()
+        if (package, file) in seen:
+            continue
+        seen.add((package, file))
+        mounts.append((package, file))
+        for key, _value in _defaults_entries(sources[file]):
+            spec = key[len("override "):] if key.startswith("override ") else key
+            m = _PKG_DEFAULT_RE.match(spec.strip())
+            if m is None:
+                continue
+            target_pkg = m.group("pkg")
+            mounted_at = f"{package}.{target_pkg}" if package else target_pkg
+            for member in by_group.get(m.group("group"), []):
+                worklist.append((mounted_at, member))
+    return mounts

@@ -1,0 +1,202 @@
+"""Intra-procedural dataflow: ordered def-use chains over local names.
+
+One :class:`ScopeDataflow` covers one scope (a module body or one function
+body, nested defs excluded — they are separate scopes with their own chains).
+Events are linear in source order, the same flow approximation the per-file
+rules already use: precise enough for read-after-invalidate and
+rebound-after-capture queries, cheap enough to run over the whole repo on
+every lint.
+
+Rules query through :class:`sheeprl_tpu.analysis.project.AnalysisContext`,
+which caches one instance per scope node.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+SCOPE_BARRIERS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+
+Pos = Tuple[int, int]  # (lineno, col_offset)
+
+
+@dataclass(frozen=True)
+class Event:
+    """One definition or use of a local name."""
+
+    name: str
+    kind: str  # "def" | "use"
+    line: int
+    col: int
+    node: ast.AST
+
+    @property
+    def pos(self) -> Pos:
+        return (self.line, self.col)
+
+
+def walk_scope(node: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk that stays inside the current scope (no nested def/class)."""
+    stack = [node]
+    while stack:
+        current = stack.pop()
+        yield current
+        for child in ast.iter_child_nodes(current):
+            if isinstance(child, SCOPE_BARRIERS):
+                continue
+            stack.append(child)
+
+
+def _scope_body(scope: ast.AST) -> List[ast.stmt]:
+    if isinstance(scope, ast.Module):
+        return scope.body
+    body = getattr(scope, "body", [])
+    return body if isinstance(body, list) else []
+
+
+class ScopeDataflow:
+    """Def-use chains for one scope, ordered by source position."""
+
+    def __init__(self, scope: ast.AST) -> None:
+        self.scope = scope
+        self.events: Dict[str, List[Event]] = {}
+        self._collect()
+
+    # ------------------------------------------------------------ collection
+    def _add(self, name: str, kind: str, node: ast.AST) -> None:
+        ev = Event(
+            name=name,
+            kind=kind,
+            line=getattr(node, "lineno", 0),
+            col=getattr(node, "col_offset", 0),
+            node=node,
+        )
+        self.events.setdefault(name, []).append(ev)
+
+    def _collect(self) -> None:
+        for stmt in _scope_body(self.scope):
+            for node in walk_scope(stmt):
+                if isinstance(node, ast.Name):
+                    kind = "def" if isinstance(node.ctx, (ast.Store, ast.Del)) else "use"
+                    self._add(node.id, kind, node)
+                elif isinstance(node, ast.ExceptHandler) and node.name:
+                    self._add(node.name, "def", node)
+        # Parameters are definitions at the scope header.
+        args = getattr(self.scope, "args", None)
+        if args is not None:
+            for a in args.posonlyargs + args.args + args.kwonlyargs:
+                self._add(a.arg, "def", a)
+            for a in (args.vararg, args.kwarg):
+                if a is not None:
+                    self._add(a.arg, "def", a)
+        for evs in self.events.values():
+            evs.sort(key=lambda e: e.pos)
+
+    # --------------------------------------------------------------- queries
+    def local_names(self) -> Set[str]:
+        """Names with at least one definition in this scope."""
+        return {n for n, evs in self.events.items() if any(e.kind == "def" for e in evs)}
+
+    def events_for(self, name: str) -> List[Event]:
+        return self.events.get(name, [])
+
+    def first_event_after(self, name: str, pos: Pos) -> Optional[Event]:
+        for ev in self.events.get(name, []):
+            if ev.pos > pos:
+                return ev
+        return None
+
+    def defs_after(self, name: str, pos: Pos) -> List[Event]:
+        return [e for e in self.events.get(name, []) if e.kind == "def" and e.pos > pos]
+
+    def use_before_redef(self, name: str, pos: Pos) -> Optional[Event]:
+        """First use of `name` after `pos` that is not preceded by a redef.
+
+        The query behind read-after-invalidate rules: a "use" answer means the
+        stale value is observed; a redef in between clears the hazard.
+        """
+        ev = self.first_event_after(name, pos)
+        if ev is not None and ev.kind == "use":
+            return ev
+        return None
+
+
+def _child_stmts(stmt: ast.stmt) -> List[ast.stmt]:
+    out: List[ast.stmt] = []
+    for name in ("body", "orelse", "finalbody"):
+        out.extend(getattr(stmt, name, []) or [])
+    for handler in getattr(stmt, "handlers", []) or []:
+        out.extend(handler.body)
+    return [s for s in out if not isinstance(s, SCOPE_BARRIERS)]
+
+
+def statement_of(scope: ast.AST, target: ast.AST) -> Optional[ast.stmt]:
+    """The innermost statement of `scope` that lexically contains `target`.
+
+    Innermost matters: for a call inside a loop body the statement must be
+    the assignment/expression itself, not the whole ``for`` — otherwise the
+    "after this statement" position skips past the loop and every in-loop
+    read-after query degenerates to the code behind the loop."""
+
+    def find(stmts: List[ast.stmt]) -> Optional[ast.stmt]:
+        for stmt in stmts:
+            if any(n is target for n in walk_scope(stmt)):
+                inner = find(_child_stmts(stmt))
+                return inner if inner is not None else stmt
+        return None
+
+    return find(_scope_body(scope))
+
+
+def assigned_names(stmt: ast.stmt, value_contains: ast.AST) -> Set[str]:
+    """Names rebound by `stmt` when `value_contains` sits in its value side.
+
+    Covers `x = f(x)`, `x, y = f(x)`, `x += f(x)`, `x: T = f(x)` and walrus
+    targets anywhere in the statement — the sanctioned rebind-the-result
+    patterns that clear an invalidated buffer immediately.
+    """
+    out: Set[str] = set()
+    value = getattr(stmt, "value", None)
+    if value is not None and any(n is value_contains for n in ast.walk(value)):
+        targets: List[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        for target in targets:
+            out |= {n.id for n in ast.walk(target) if isinstance(n, ast.Name)}
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.NamedExpr) and any(
+            n is value_contains for n in ast.walk(node.value)
+        ):
+            out |= {n.id for n in ast.walk(node.target) if isinstance(n, ast.Name)}
+    return out
+
+
+def free_loads(fn: ast.AST) -> Dict[str, List[ast.Name]]:
+    """Closure reads: names loaded anywhere in `fn` (nested scopes included)
+    that `fn` itself never binds — candidates for capture from the enclosing
+    scope. Builtins are not filtered here; callers match against the
+    enclosing scope's locals, which excludes them naturally."""
+    bound: Set[str] = set()
+    args = getattr(fn, "args", None)
+    if args is not None:
+        for a in args.posonlyargs + args.args + args.kwonlyargs:
+            bound.add(a.arg)
+        for a in (args.vararg, args.kwarg):
+            if a is not None:
+                bound.add(a.arg)
+    loads: Dict[str, List[ast.Name]] = {}
+    body = getattr(fn, "body", [])
+    for stmt in body if isinstance(body, list) else [body]:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, (ast.Store, ast.Del)):
+                    bound.add(node.id)
+                else:
+                    loads.setdefault(node.id, []).append(node)
+            elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                bound.update(node.names)
+    return {name: nodes for name, nodes in loads.items() if name not in bound}

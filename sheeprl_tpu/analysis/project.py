@@ -1,0 +1,399 @@
+"""Project-wide analysis core: module graph, symbol table, call edges,
+jit-boundary inference.
+
+The per-file :class:`~sheeprl_tpu.analysis.context.LintContext` sees one
+tree; every hazard that crosses a function or module boundary (donation
+misuse at an imported call site, host side effects three calls below a jit
+boundary, config keys that only exist in YAML) needs the whole program. An
+:class:`AnalysisContext` owns one LintContext per scanned file plus the
+project indices rules query through:
+
+* **symbol table** — every function/method in every module, by qualified
+  name, with module-level callable names (including ``f = jax.jit(g, ...)``
+  wrappers) resolvable across imports;
+* **call-edge index** — caller symbol -> callee symbol for direct-name,
+  dotted (``mod.f(...)``) and ``self.method(...)`` call sites;
+* **jit boundary closure** — a function is *in-jit* when it is reachable
+  from any ``jax.jit``/``pjit``/``lax.scan``/``vmap`` callee through the
+  call graph, not merely when it is lexically decorated. ``jit_chain()``
+  reports the call path back to the tracing entry for diagnostics;
+* **dataflow cache** — one :class:`ScopeDataflow` per scope node.
+
+Findings are reported through the owning module's LintContext so per-line
+suppressions and snippets keep working unchanged.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from sheeprl_tpu.analysis.context import JitFunction, LintContext, parse_jit_call
+from sheeprl_tpu.analysis.dataflow import ScopeDataflow
+
+# Entry points whose callees trace. The per-file index covers jax.jit/pmap
+# decorators and lax bodies; the project closure adds the transform calls
+# that take a function *reference* which may live in another module.
+_TRACING_ENTRY_PATHS = {
+    "jax.jit",
+    "jax.pmap",
+    "jax.vmap",
+    "jax.pjit",
+    "jax.experimental.pjit.pjit",
+    "jax.lax.scan",
+    "jax.lax.cond",
+    "jax.lax.while_loop",
+    "jax.lax.fori_loop",
+    "jax.lax.map",
+    "jax.lax.switch",
+    "jax.lax.associative_scan",
+}
+
+
+@dataclass(frozen=True)
+class SymbolKey:
+    module: str  # dotted module name ("" when unresolvable)
+    qualname: str  # "f" | "Class.method" | "outer.<locals>.inner"
+
+    def __str__(self) -> str:  # for diagnostics
+        return f"{self.module}:{self.qualname}" if self.module else self.qualname
+
+
+@dataclass
+class Symbol:
+    key: SymbolKey
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    module_path: str  # file path (display path of the owning LintContext)
+    class_name: Optional[str] = None  # enclosing class, if a method
+
+
+@dataclass
+class ModuleInfo:
+    """One scanned file plus its per-module symbol indices."""
+
+    name: str  # dotted module name derived from the path
+    path: str  # display path (repo-relative)
+    ctx: LintContext
+    symbols: Dict[str, Symbol] = field(default_factory=dict)  # qualname -> Symbol
+    by_node: Dict[int, Symbol] = field(default_factory=dict)  # id(node) -> Symbol
+    top_level: Dict[str, str] = field(default_factory=dict)  # local name -> qualname
+    jit_wrapped: Dict[str, JitFunction] = field(default_factory=dict)
+
+
+def module_name_for(path: str) -> str:
+    """Dotted module name for a file: walk up through package dirs
+    (those with an ``__init__.py``) so ``sheeprl_tpu/core/x.py`` maps to
+    ``sheeprl_tpu.core.x`` regardless of where the scan was rooted."""
+    abs_path = os.path.abspath(path)
+    parts = [os.path.splitext(os.path.basename(abs_path))[0]]
+    current = os.path.dirname(abs_path)
+    while os.path.isfile(os.path.join(current, "__init__.py")):
+        parts.append(os.path.basename(current))
+        parent = os.path.dirname(current)
+        if parent == current:
+            break
+        current = parent
+    name = ".".join(reversed(parts))
+    return name[: -len(".__init__")] if name.endswith(".__init__") else name
+
+
+class _SymbolCollector(ast.NodeVisitor):
+    """Builds the qualname-indexed function table for one module."""
+
+    def __init__(self, info: ModuleInfo) -> None:
+        self.info = info
+        self._stack: List[Tuple[str, str]] = []  # (kind, name)
+
+    def _qualname(self, name: str) -> str:
+        parts: List[str] = []
+        for kind, frame in self._stack:
+            parts.append(frame)
+            if kind == "function":
+                parts.append("<locals>")
+        parts.append(name)
+        return ".".join(parts)
+
+    def _class_name(self) -> Optional[str]:
+        for kind, frame in reversed(self._stack):
+            if kind == "class":
+                return frame
+            return None  # a function frame between us and any class
+        return None
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._stack.append(("class", node.name))
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def _visit_fn(self, node) -> None:
+        qualname = self._qualname(node.name)
+        sym = Symbol(
+            key=SymbolKey(self.info.name, qualname),
+            node=node,
+            module_path=self.info.path,
+            class_name=self._class_name(),
+        )
+        self.info.symbols[qualname] = sym
+        self.info.by_node[id(node)] = sym
+        if not self._stack:
+            self.info.top_level[node.name] = qualname
+        self._stack.append(("function", node.name))
+        self.generic_visit(node)
+        self._stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+
+class AnalysisContext:
+    """Whole-project view over a set of per-file LintContexts."""
+
+    def __init__(self, contexts: List[LintContext]) -> None:
+        self.modules: List[ModuleInfo] = []
+        self.by_name: Dict[str, ModuleInfo] = {}
+        for ctx in contexts:
+            info = ModuleInfo(name=module_name_for(ctx.path), path=ctx.path, ctx=ctx)
+            _SymbolCollector(info).visit(ctx.tree)
+            self._collect_jit_wrapped(info)
+            self.modules.append(info)
+            # First scanned module wins a name collision (out-of-package
+            # fixture stems); project resolution is best-effort there.
+            self.by_name.setdefault(info.name, info)
+        self._dataflow_cache: Dict[int, ScopeDataflow] = {}
+        self._call_edges: Optional[Dict[SymbolKey, List[Tuple[SymbolKey, ast.Call]]]] = None
+        self._in_jit: Optional[Dict[SymbolKey, Tuple[SymbolKey, ...]]] = None
+        # Findings on non-Python files (YAML config keys) and rule-scoped
+        # caches (the GL011 config model) live on the project context.
+        self.external_findings: List = []
+        self.external_suppressed = 0
+        self.caches: Dict[str, object] = {}
+
+    # ------------------------------------------------------------- symbol API
+    def _collect_jit_wrapped(self, info: ModuleInfo) -> None:
+        """Module-level ``name = jax.jit(fn, ...)`` wrappers, callable from
+        other modules as ``info.name + '.' + name``."""
+        for stmt in info.ctx.tree.body:
+            if not (isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call)):
+                continue
+            meta = parse_jit_call(stmt.value, info.ctx.resolver)
+            if meta is None:
+                continue
+            inner = stmt.value.args[0] if stmt.value.args else None
+            if inner is not None:
+                resolved = info.ctx.resolver.resolve(inner)
+                if resolved and resolved in info.top_level:
+                    meta.node = info.symbols[info.top_level[resolved]].node
+                else:
+                    meta.node = stmt.value
+            for target in stmt.targets:
+                if isinstance(target, ast.Name):
+                    info.jit_wrapped[target.id] = meta
+
+    def resolve_path(self, dotted: str) -> Optional[Symbol]:
+        """``pkg.mod.fn`` / ``pkg.mod.Class.method`` -> Symbol, if scanned."""
+        parts = dotted.split(".")
+        for split in range(len(parts) - 1, 0, -1):
+            module = ".".join(parts[:split])
+            info = self.by_name.get(module)
+            if info is None:
+                continue
+            qualname = ".".join(parts[split:])
+            sym = info.symbols.get(qualname)
+            if sym is not None:
+                return sym
+            local = info.top_level.get(qualname)
+            if local is not None:
+                return info.symbols.get(local)
+        return None
+
+    def resolve_call(self, info: ModuleInfo, call: ast.Call, enclosing: Optional[Symbol] = None) -> Optional[Symbol]:
+        """Best-effort callee resolution for direct-name, dotted, and
+        ``self.method`` call sites."""
+        func = call.func
+        if isinstance(func, ast.Name):
+            # Lexically visible nested defs first (innermost frame outward),
+            # then module top-level, then imports.
+            if enclosing is not None:
+                prefix = enclosing.key.qualname
+                while prefix:
+                    sym = info.symbols.get(f"{prefix}.<locals>.{func.id}")
+                    if sym is not None:
+                        return sym
+                    if ".<locals>." not in prefix:
+                        break
+                    prefix = prefix.rsplit(".<locals>.", 1)[0]
+            qual = info.top_level.get(func.id)
+            if qual is not None:
+                return info.symbols.get(qual)
+            dotted = info.ctx.resolver.aliases.get(func.id)
+            if dotted:
+                return self.resolve_path(dotted)
+            return None
+        if isinstance(func, ast.Attribute):
+            if (
+                isinstance(func.value, ast.Name)
+                and func.value.id == "self"
+                and enclosing is not None
+                and enclosing.class_name
+            ):
+                owner = info.symbols.get(f"{enclosing.class_name}.{func.attr}")
+                if owner is not None:
+                    return owner
+                # method defined on a nested class path, e.g. Outer.Inner.m
+                prefix = enclosing.key.qualname.rsplit(".", 1)[0]
+                return info.symbols.get(f"{prefix}.{func.attr}")
+            dotted = info.ctx.resolver.resolve(func)
+            if dotted:
+                return self.resolve_path(dotted)
+        return None
+
+    # -------------------------------------------------------------- call graph
+    def call_edges(self) -> Dict[SymbolKey, List[Tuple[SymbolKey, ast.Call]]]:
+        if self._call_edges is not None:
+            return self._call_edges
+        edges: Dict[SymbolKey, List[Tuple[SymbolKey, ast.Call]]] = {}
+        for info in self.modules:
+            for sym in info.symbols.values():
+                caller_edges: List[Tuple[SymbolKey, ast.Call]] = []
+                for node in ast.walk(sym.node):
+                    if isinstance(node, ast.Call):
+                        callee = self.resolve_call(info, node, enclosing=sym)
+                        if callee is not None and callee.key != sym.key:
+                            caller_edges.append((callee.key, node))
+                if caller_edges:
+                    edges[sym.key] = caller_edges
+        self._call_edges = edges
+        return edges
+
+    # ------------------------------------------------------------ jit closure
+    def _jit_seeds(self) -> Dict[SymbolKey, Tuple[SymbolKey, ...]]:
+        """Symbols that trace directly: decorated/wrapped jit functions and
+        function references handed to a tracing entry point anywhere."""
+        seeds: Dict[SymbolKey, Tuple[SymbolKey, ...]] = {}
+        for info in self.modules:
+            for jf in info.ctx.jitted_functions():
+                sym = info.by_node.get(id(jf.node))
+                if sym is not None:
+                    seeds[sym.key] = ()
+            for node in ast.walk(info.ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                path = info.ctx.resolver.resolve(node.func)
+                if path not in _TRACING_ENTRY_PATHS:
+                    continue
+                for arg in node.args:
+                    if isinstance(arg, (ast.Name, ast.Attribute)):
+                        target = None
+                        if isinstance(arg, ast.Name):
+                            qual = info.top_level.get(arg.id)
+                            target = info.symbols.get(qual) if qual else None
+                            if target is None:
+                                dotted = info.ctx.resolver.aliases.get(arg.id)
+                                target = self.resolve_path(dotted) if dotted else None
+                        else:
+                            dotted = info.ctx.resolver.resolve(arg)
+                            target = self.resolve_path(dotted) if dotted else None
+                        if target is not None:
+                            seeds.setdefault(target.key, ())
+        return seeds
+
+    def jit_closure(self) -> Dict[SymbolKey, Tuple[SymbolKey, ...]]:
+        """key -> chain of callers back to the tracing entry (empty chain for
+        a direct jit boundary). Membership == "this body runs under a trace"."""
+        if self._in_jit is not None:
+            return self._in_jit
+        closure = dict(self._jit_seeds())
+        edges = self.call_edges()
+        frontier = list(closure)
+        while frontier:
+            current = frontier.pop()
+            chain = closure[current]
+            for callee, _ in edges.get(current, ()):
+                if callee not in closure:
+                    closure[callee] = (current,) + chain
+                    frontier.append(callee)
+        self._in_jit = closure
+        return closure
+
+    def in_jit(self, sym: Symbol) -> bool:
+        return sym.key in self.jit_closure()
+
+    def jit_chain(self, sym: Symbol) -> Tuple[SymbolKey, ...]:
+        return self.jit_closure().get(sym.key, ())
+
+    # ----------------------------------------------------- donation (GL009)
+    def donating_callables(self) -> Dict[str, Tuple[ModuleInfo, JitFunction]]:
+        """Fully-qualified path -> donating jit callable, across all modules:
+        both ``@partial(jax.jit, donate_argnums=...)`` decorated defs and
+        module-level ``f = jax.jit(g, donate_argnums=...)`` wrappers."""
+        out: Dict[str, Tuple[ModuleInfo, JitFunction]] = {}
+        for info in self.modules:
+            for jf in info.ctx.jitted_functions():
+                if jf.donate_argnums and hasattr(jf.node, "name"):
+                    sym = info.by_node.get(id(jf.node))
+                    if sym is not None and "." not in sym.key.qualname:
+                        out[f"{info.name}.{sym.key.qualname}"] = (info, jf)
+            for local, jf in info.jit_wrapped.items():
+                if jf.donate_argnums:
+                    out[f"{info.name}.{local}"] = (info, jf)
+        return out
+
+    # ---------------------------------------------------------------- helpers
+    def dataflow(self, scope: ast.AST) -> ScopeDataflow:
+        df = self._dataflow_cache.get(id(scope))
+        if df is None:
+            df = ScopeDataflow(scope)
+            self._dataflow_cache[id(scope)] = df
+        return df
+
+    def iter_functions(self) -> Iterator[Tuple[ModuleInfo, Symbol]]:
+        for info in self.modules:
+            for sym in info.symbols.values():
+                yield info, sym
+
+    def report_external(
+        self,
+        rule: str,
+        path: str,
+        line: int,
+        message: str,
+        snippet: str = "",
+        suppressions: Optional[Dict[int, Set[str]]] = None,
+    ) -> None:
+        """Report a finding on a non-Python file (YAML), honoring the same
+        per-line ``# graftlint: disable=...`` convention."""
+        from sheeprl_tpu.analysis.finding import Finding
+
+        ids = (suppressions or {}).get(line, set())
+        if "ALL" in ids or rule.upper() in ids:
+            self.external_suppressed += 1
+            return
+        finding = Finding(rule=rule, path=path, line=line, col=1, message=message, snippet=snippet)
+        if finding not in self.external_findings:
+            self.external_findings.append(finding)
+
+    # ------------------------------------------------------- config discovery
+    def config_root_for(self, info: ModuleInfo) -> Optional[str]:
+        """Nearest ``configs/config.yaml`` tree walking up from the module —
+        the package's own Hydra-lite root for the live repo, a sibling
+        ``configs/`` dir for fixture corpora."""
+        current = os.path.dirname(os.path.abspath(info.ctx.path))
+        for _ in range(12):
+            candidate = os.path.join(current, "configs")
+            if os.path.isfile(os.path.join(candidate, "config.yaml")):
+                return candidate
+            parent = os.path.dirname(current)
+            if parent == current:
+                return None
+            current = parent
+        return None
+
+    def modules_by_config_root(self) -> Dict[str, List[ModuleInfo]]:
+        grouped: Dict[str, List[ModuleInfo]] = {}
+        for info in self.modules:
+            root = self.config_root_for(info)
+            if root is not None:
+                grouped.setdefault(root, []).append(info)
+        return grouped

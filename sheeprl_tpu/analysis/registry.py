@@ -24,6 +24,24 @@ class Rule:
         raise NotImplementedError
 
 
+class ProjectRule(Rule):
+    """A rule that needs the whole program: module graph, call edges, the
+    jit-boundary closure, or the merged config tree. Implements
+    `check_project(actx)` against an
+    :class:`~sheeprl_tpu.analysis.project.AnalysisContext`; the runner calls
+    it once per scan (after every file is parsed), not once per file.
+    `check(ctx)` keeps single-file linting working by wrapping the one
+    context into a single-module project."""
+
+    def check(self, ctx: LintContext) -> None:
+        from sheeprl_tpu.analysis.project import AnalysisContext
+
+        self.check_project(AnalysisContext([ctx]))
+
+    def check_project(self, actx) -> None:
+        raise NotImplementedError
+
+
 def register_rule(cls: Type[Rule]) -> Type[Rule]:
     if not _RULE_ID_RE.match(cls.id):
         raise ValueError(f"rule id {cls.id!r} must match GLnnn")
@@ -38,3 +56,11 @@ def all_rules() -> List[Rule]:
     import sheeprl_tpu.analysis.rules  # noqa: F401
 
     return [RULES[k] for k in sorted(RULES)]
+
+
+def file_rules() -> List[Rule]:
+    return [r for r in all_rules() if not isinstance(r, ProjectRule)]
+
+
+def project_rules() -> List[ProjectRule]:
+    return [r for r in all_rules() if isinstance(r, ProjectRule)]

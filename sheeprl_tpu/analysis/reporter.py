@@ -54,3 +54,80 @@ def _counts(findings: List[Finding]) -> Dict[str, int]:
     for f in findings:
         counts[f.rule] = counts.get(f.rule, 0) + 1
     return counts
+
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = "https://json.schemastore.org/sarif-2.1.0.json"
+TOOL_VERSION = "2.0.0"
+
+
+def render_sarif(
+    findings: List[Finding],
+    files_scanned: int,
+    baselined: int = 0,
+    suppressed: int = 0,
+) -> str:
+    """SARIF 2.1.0 — the interchange format CI annotators and IDEs ingest.
+
+    One run, one driver, the full rule table (so a clean scan still
+    documents what was checked), one result per finding with a physical
+    location and the source snippet embedded in the region."""
+    from sheeprl_tpu.analysis.registry import all_rules
+
+    rules = all_rules()
+    rule_index = {r.id: i for i, r in enumerate(rules)}
+    rules_json = [
+        {
+            "id": r.id,
+            "name": r.name,
+            "shortDescription": {"text": r.name},
+            "fullDescription": {"text": r.rationale},
+            "defaultConfiguration": {"level": "warning"},
+        }
+        for r in rules
+    ]
+    results = []
+    for f in findings:
+        region: Dict[str, Any] = {"startLine": max(1, f.line), "startColumn": max(1, f.col)}
+        if f.snippet:
+            region["snippet"] = {"text": f.snippet}
+        result: Dict[str, Any] = {
+            "ruleId": f.rule,
+            "level": "warning",
+            "message": {"text": f.message},
+            "locations": [
+                {
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": f.path.replace("\\", "/")},
+                        "region": region,
+                    }
+                }
+            ],
+        }
+        if f.rule in rule_index:
+            result["ruleIndex"] = rule_index[f.rule]
+        results.append(result)
+    payload: Dict[str, Any] = {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "graftlint",
+                        "version": TOOL_VERSION,
+                        "informationUri": "https://github.com/calmlab/sheeprl",
+                        "rules": rules_json,
+                    }
+                },
+                "columnKind": "utf16CodeUnits",
+                "results": results,
+                "properties": {
+                    "filesScanned": files_scanned,
+                    "baselined": baselined,
+                    "suppressed": suppressed,
+                },
+            }
+        ],
+    }
+    return json.dumps(payload, indent=2, sort_keys=False)

@@ -9,4 +9,9 @@ from sheeprl_tpu.analysis.rules import (  # noqa: F401
     gl006_blocking_fetch,
     gl007_atomic_persistence,
     gl008_span_leak,
+    gl009_use_after_donate,
+    gl010_lock_discipline,
+    gl011_config_drift,
+    gl012_in_jit_impurity,
+    gl013_stale_closure,
 )

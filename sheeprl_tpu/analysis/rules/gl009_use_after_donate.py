@@ -1,0 +1,114 @@
+"""GL009: use-after-donate across module boundaries.
+
+GL005 catches the hazard when the donating jitted callable is *defined in
+the same file* as the call site. In this codebase that is the minority
+case: train steps are built in ``algos/*/...py``, wrapped with
+``donate_argnums`` there, and *called* from the train loop, the fused
+Anakin driver, or the serve engine — a different module every time. The
+python-side buffer is still invalidated at dispatch; the read-after still
+raises ``Array has been deleted`` on device backends and still works
+silently on CPU, so the bug ships.
+
+Analysis (project-wide): collect every donating jit callable in the
+program — ``@partial(jax.jit, donate_argnums=...)`` defs and module-level
+``f = jax.jit(g, donate_argnums=...)`` wrappers — then resolve each
+*cross-module* call site through the import graph (both ``from m import
+step; step(state)`` and ``import m; m.step(state)`` spellings). For every
+donated positional argument that is a plain name, the def-use chain of the
+enclosing scope answers "is the name read again before any rebind?"; the
+call's own assignment targets (``state = step(state)``) clear immediately.
+
+Same-module call sites stay GL005 territory — the two rules partition the
+hazard, they never double-report.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from sheeprl_tpu.analysis.context import JitFunction
+from sheeprl_tpu.analysis.dataflow import assigned_names, statement_of, walk_scope
+from sheeprl_tpu.analysis.project import AnalysisContext, ModuleInfo
+from sheeprl_tpu.analysis.registry import ProjectRule, register_rule
+
+
+def _scopes(tree: ast.Module):
+    yield tree
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+@register_rule
+class CrossModuleDonationRule(ProjectRule):
+    id = "GL009"
+    name = "use-after-donate-cross-module"
+    rationale = (
+        "A buffer donated to an imported jitted callable is invalidated at "
+        "dispatch; reading it afterwards crashes on device backends."
+    )
+
+    def check_project(self, actx: AnalysisContext) -> None:
+        donating = actx.donating_callables()
+        if not donating:
+            return
+        for info in actx.modules:
+            self._check_module(actx, info, donating)
+
+    def _check_module(
+        self,
+        actx: AnalysisContext,
+        info: ModuleInfo,
+        donating: Dict[str, Tuple[ModuleInfo, JitFunction]],
+    ) -> None:
+        # Imported names bound to donating callables defined elsewhere.
+        by_alias: Dict[str, Tuple[str, JitFunction]] = {}
+        for alias, dotted in info.ctx.resolver.aliases.items():
+            entry = donating.get(dotted)
+            if entry is not None and entry[0] is not info:
+                by_alias[alias] = (dotted, entry[1])
+        for scope in _scopes(info.ctx.tree):
+            df = None
+            for node in walk_scope(scope):
+                if not isinstance(node, ast.Call):
+                    continue
+                resolved: Tuple[str, JitFunction] | None = None
+                if isinstance(node.func, ast.Name):
+                    resolved = by_alias.get(node.func.id)
+                elif isinstance(node.func, ast.Attribute):
+                    dotted = info.ctx.resolver.resolve(node.func)
+                    if dotted:
+                        entry = donating.get(dotted)
+                        if entry is not None and entry[0] is not info:
+                            resolved = (dotted, entry[1])
+                if resolved is None:
+                    continue
+                dotted_name, jf = resolved
+                donated: Set[str] = {
+                    node.args[i].id
+                    for i in jf.donate_argnums
+                    if i < len(node.args) and isinstance(node.args[i], ast.Name)
+                }
+                if not donated:
+                    continue
+                stmt = statement_of(scope, node)
+                if stmt is None:
+                    continue
+                donated -= assigned_names(stmt, node)
+                if not donated:
+                    continue
+                if df is None:
+                    df = actx.dataflow(scope)
+                end = (stmt.end_lineno or stmt.lineno, stmt.end_col_offset or 0)
+                for name in sorted(donated):
+                    ev = df.use_before_redef(name, end)
+                    if ev is not None:
+                        info.ctx.report(
+                            self.id,
+                            ev.node,
+                            f"`{name}` was donated to `{dotted_name}` at line "
+                            f"{node.lineno} (donate_argnums, defined in another "
+                            "module) and is read afterwards; the buffer is "
+                            "invalidated on device — rebind the result",
+                        )

@@ -1,0 +1,279 @@
+"""GL010: lock discipline over annotated shared state.
+
+The serve engine, the metrics registry, the flight recorder, and the
+interaction pipeline all mutate state that is reachable from multiple
+threads (HTTP handler threads, the trainer thread, watchdog/monitor
+threads, forked-env supervisors). Python's GIL hides most torn reads but
+none of the lost-update or inconsistent-snapshot bugs — and those corrupt
+metrics silently or, in the engine, batch the wrong sessions together.
+
+The contract is declared in the code with an annotation on the line that
+creates the state:
+
+    self._sessions = {}        # graftlint: guarded-by(self._cv)
+    _default_registry = None   # graftlint: guarded-by(_default_lock)
+
+Every *mutation* of an annotated name — attribute rebind, ``del``, item
+assignment, augmented assignment, or a call of a known mutating method
+(``append``/``pop``/``update``/``add``/…) — must then sit lexically inside
+``with <lock>:`` on the owning lock. Exemptions, in order of preference:
+
+* ``__init__``/``__del__`` bodies (single-threaded construction/teardown);
+* methods whose name ends in ``_locked`` (the documented caller-holds-lock
+  convention — name the requirement into the signature);
+* a per-line ``# graftlint: disable=GL010`` with a justifying comment.
+
+Reads are deliberately not flagged: the annotation convention targets
+lost updates first, and read-side flagging would drown the signal in
+benign racy-read telemetry.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from sheeprl_tpu.analysis.project import AnalysisContext, ModuleInfo
+from sheeprl_tpu.analysis.registry import ProjectRule, register_rule
+
+_GUARDED_RE = re.compile(r"#\s*graftlint:\s*guarded-by\(([^)]+)\)")
+
+_MUTATORS = {
+    "append",
+    "appendleft",
+    "extend",
+    "extendleft",
+    "insert",
+    "remove",
+    "discard",
+    "pop",
+    "popleft",
+    "popitem",
+    "clear",
+    "update",
+    "setdefault",
+    "add",
+    "sort",
+    "reverse",
+}
+
+_EXEMPT_METHODS = {"__init__", "__del__", "__post_init__"}
+
+
+@dataclass
+class _Guard:
+    attr: str  # guarded attribute/global name
+    lock: str  # normalized lock spelling ("self._cv" or "_lock")
+    is_instance: bool  # True: self.<attr>; False: module-level global
+    class_node: Optional[ast.ClassDef]  # owning class for instance state
+    decl_line: int
+
+
+def _normalize_lock(raw: str, is_instance: bool) -> str:
+    raw = raw.strip()
+    if is_instance and not raw.startswith("self."):
+        return f"self.{raw}"
+    return raw
+
+
+def _expr_dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _ParentMap(dict):
+    @classmethod
+    def build(cls, tree: ast.AST) -> "_ParentMap":
+        pm = cls()
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                pm[id(child)] = parent
+        return pm
+
+    def ancestors(self, node: ast.AST):
+        current = self.get(id(node))
+        while current is not None:
+            yield current
+            current = self.get(id(current))
+
+
+@register_rule
+class LockDisciplineRule(ProjectRule):
+    id = "GL010"
+    name = "lock-discipline"
+    rationale = (
+        "State annotated `# graftlint: guarded-by(<lock>)` must only be "
+        "mutated with the owning lock held (`with <lock>:`); unlocked "
+        "mutation from a second thread is a silent lost update."
+    )
+
+    def check_project(self, actx: AnalysisContext) -> None:
+        for info in actx.modules:
+            guards = self._collect_guards(info)
+            if guards:
+                self._check_module(info, guards)
+
+    # ------------------------------------------------------------ annotations
+    def _collect_guards(self, info: ModuleInfo) -> List[_Guard]:
+        annotated: Dict[int, str] = {}
+        for lineno, line in enumerate(info.ctx.lines, start=1):
+            m = _GUARDED_RE.search(line)
+            if m:
+                annotated[lineno] = m.group(1)
+        if not annotated:
+            return []
+        pm = _ParentMap.build(info.ctx.tree)
+        guards: List[_Guard] = []
+        for node in ast.walk(info.ctx.tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                continue
+            lock_raw = annotated.get(node.lineno)
+            if lock_raw is None:
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for target in targets:
+                if (
+                    isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                ):
+                    class_node = next(
+                        (a for a in pm.ancestors(node) if isinstance(a, ast.ClassDef)), None
+                    )
+                    guards.append(
+                        _Guard(
+                            attr=target.attr,
+                            lock=_normalize_lock(lock_raw, True),
+                            is_instance=True,
+                            class_node=class_node,
+                            decl_line=node.lineno,
+                        )
+                    )
+                elif isinstance(target, ast.Name):
+                    guards.append(
+                        _Guard(
+                            attr=target.id,
+                            lock=_normalize_lock(lock_raw, False),
+                            is_instance=False,
+                            class_node=None,
+                            decl_line=node.lineno,
+                        )
+                    )
+        return guards
+
+    # --------------------------------------------------------------- checking
+    def _check_module(self, info: ModuleInfo, guards: List[_Guard]) -> None:
+        pm = _ParentMap.build(info.ctx.tree)
+        instance = {
+            (id(g.class_node), g.attr): g for g in guards if g.is_instance and g.class_node
+        }
+        module_guards = {g.attr: g for g in guards if not g.is_instance}
+
+        for node in ast.walk(info.ctx.tree):
+            target = self._mutation_target(node)
+            if target is None:
+                continue
+            guard = self._guard_for(target, instance, module_guards, pm, node)
+            if guard is None:
+                continue
+            if node.lineno == guard.decl_line:
+                continue  # the annotated declaration itself
+            if self._is_exempt(node, guard, pm):
+                continue
+            what = f"self.{guard.attr}" if guard.is_instance else guard.attr
+            info.ctx.report(
+                self.id,
+                node,
+                f"mutation of `{what}` (declared guarded-by {guard.lock} at "
+                f"line {guard.decl_line}) outside `with {guard.lock}:`; "
+                "unlocked mutation from a second thread is a lost update — "
+                "take the lock, or move the mutation into a `*_locked` method",
+            )
+
+    def _mutation_target(self, node: ast.AST) -> Optional[ast.AST]:
+        """The attribute/name being mutated by `node`, if it is a mutation."""
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                base = self._storage_base(t)
+                if base is not None:
+                    return base
+            return None
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                base = self._storage_base(t)
+                if base is not None:
+                    return base
+            return None
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr in _MUTATORS:
+                return node.func.value
+        return None
+
+    @staticmethod
+    def _storage_base(target: ast.AST) -> Optional[ast.AST]:
+        """`self.x`, `x`, `self.x[k]`, `x[k]` -> the `self.x` / `x` base."""
+        if isinstance(target, ast.Subscript):
+            target = target.value
+        if isinstance(target, (ast.Attribute, ast.Name)):
+            return target
+        return None
+
+    def _guard_for(
+        self,
+        target: ast.AST,
+        instance: Dict[Tuple[int, str], _Guard],
+        module_guards: Dict[str, _Guard],
+        pm: _ParentMap,
+        site: ast.AST,
+    ) -> Optional[_Guard]:
+        if (
+            isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"
+        ):
+            class_node = next(
+                (a for a in pm.ancestors(site) if isinstance(a, ast.ClassDef)), None
+            )
+            if class_node is None:
+                return None
+            return instance.get((id(class_node), target.attr))
+        if isinstance(target, ast.Name):
+            guard = module_guards.get(target.id)
+            if guard is None:
+                return None
+            # Only function-scope mutations count: module top-level runs at
+            # import time, single-threaded. A function mutates the global
+            # through a `global` declaration or by mutating-in-place.
+            in_function = any(
+                isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))
+                for a in pm.ancestors(site)
+            )
+            return guard if in_function else None
+        return None
+
+    def _is_exempt(self, site: ast.AST, guard: _Guard, pm: _ParentMap) -> bool:
+        lock_self_free = guard.lock[len("self.") :] if guard.lock.startswith("self.") else guard.lock
+        for ancestor in pm.ancestors(site):
+            if isinstance(ancestor, ast.With):
+                for item in ancestor.items:
+                    dotted = _expr_dotted(item.context_expr)
+                    if dotted is None and isinstance(item.context_expr, ast.Call):
+                        dotted = _expr_dotted(item.context_expr.func)
+                    if dotted in (guard.lock, lock_self_free):
+                        return True
+            if isinstance(ancestor, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if ancestor.name in _EXEMPT_METHODS or ancestor.name.endswith("_locked"):
+                    return True
+                # Stop at the method boundary: a `with` in a *caller* cannot
+                # be seen statically; that is what `_locked` naming is for.
+                return False
+        return False

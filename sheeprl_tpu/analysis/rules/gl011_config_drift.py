@@ -1,0 +1,266 @@
+"""GL011: config-key drift between code and the Hydra-lite ``configs/`` tree.
+
+The config schema lives in YAML, the reads live in Python, and nothing
+type-checks the seam. Drift accumulates from both sides:
+
+* code reads ``cfg.algo.replay_ratio`` after the key was renamed in YAML —
+  the run dies at minute 40 when the branch finally executes, or worse,
+  ``cfg.get("replay_ratio", default)`` silently trains with the default;
+* YAML carries ``algo.old_knob`` that no code has read for six PRs — every
+  future reader assumes it does something.
+
+This rule resolves every ``cfg.*`` path the code reads against a
+:class:`~sheeprl_tpu.analysis.configmodel.ConfigModel` — a union mount of
+every group option, so a key only present under ``algo: dreamer_v3`` still
+resolves — and flags the two drift directions:
+
+* **unknown read** (reported at the Python expression): the dotted path
+  cannot be produced by any composition;
+* **dead YAML key** (reported at the YAML line): a leaf no code read, no
+  ``${...}`` interpolation, and no dynamic (``_target_``/non-identifier
+  key) subtree reaches.
+
+Noise control, in order of load-bearing-ness: a scope's reads only flag
+when at least one read from the same root *does* resolve (a function whose
+``cfg`` parameter receives a sub-config — ``build_head(cfg.algo)`` callee
+style — never resolves at the root and is skipped wholesale); dynamic
+subscripts (``cfg.envs[i]``) stop the chain; dict-protocol methods
+(``.items()``/``.get(...)``/``.keys()``) are stripped; a read of a prefix
+keeps the whole subtree alive for deadness."""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from sheeprl_tpu.analysis.configmodel import ConfigModel
+from sheeprl_tpu.analysis.dataflow import walk_scope
+from sheeprl_tpu.analysis.project import AnalysisContext, ModuleInfo
+from sheeprl_tpu.analysis.registry import ProjectRule, register_rule
+
+_ROOT_NAMES = {"cfg"}
+_DYNAMIC = "<dynamic>"
+_DICT_METHODS = {
+    "get",
+    "keys",
+    "values",
+    "items",
+    "pop",
+    "update",
+    "copy",
+    "setdefault",
+    "to_container",
+    "to_dict",
+    "as_dict",
+}
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _chain(node: ast.AST) -> Optional[Tuple[str, List[str]]]:
+    """``cfg.a.b``, ``cfg["a"].b``, ``cfg.a.get("b")`` -> ("cfg", [a, b]).
+
+    Dynamic segments (non-constant subscripts) become a ``<dynamic>``
+    marker; anything else that is not a config access returns None."""
+    segs: List[str] = []
+    while True:
+        if isinstance(node, ast.Attribute):
+            segs.append(node.attr)
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            key = _const_str(node.slice)
+            segs.append(key if key is not None else _DYNAMIC)
+            node = node.value
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr == "get"
+                and node.args
+                and _const_str(node.args[0]) is not None
+            ):
+                segs.append(_const_str(node.args[0]))
+                node = func.value
+            elif (
+                isinstance(func, ast.Name)
+                and func.id == "getattr"
+                and len(node.args) >= 2
+                and _const_str(node.args[1]) is not None
+            ):
+                segs.append(_const_str(node.args[1]))
+                node = node.args[0]
+            else:
+                return None
+        elif isinstance(node, ast.Name):
+            return node.id, list(reversed(segs))
+        else:
+            return None
+
+
+class _Read:
+    __slots__ = ("path", "node", "flaggable", "is_write")
+
+    def __init__(self, path: str, node: ast.AST, flaggable: bool, is_write: bool) -> None:
+        self.path = path
+        self.node = node
+        self.flaggable = flaggable
+        self.is_write = is_write
+
+
+@register_rule
+class ConfigDriftRule(ProjectRule):
+    id = "GL011"
+    name = "config-key-drift"
+    rationale = (
+        "Every `cfg.*` path in code must exist somewhere in the merged "
+        "configs/ tree, and every YAML leaf must be reachable by some read "
+        "or interpolation; both drift directions ship runtime surprises."
+    )
+
+    def check_project(self, actx: AnalysisContext) -> None:
+        for root, modules in sorted(actx.modules_by_config_root().items()):
+            cache_key = f"GL011:{root}"
+            model = actx.caches.get(cache_key)
+            if model is None:
+                model = ConfigModel.load(root)
+                actx.caches[cache_key] = model
+            self._check_tree(actx, model, modules)
+
+    def _check_tree(
+        self, actx: AnalysisContext, model: ConfigModel, modules: List[ModuleInfo]
+    ) -> None:
+        # Phase 1: collect every read and write across the whole tree first —
+        # a key registered at runtime (`cfg.to_log = ...` in the CLI) must
+        # resolve reads in *other* modules before any flagging happens.
+        used: Set[str] = set()
+        written: Set[str] = set()
+        per_scope: List[Tuple[ModuleInfo, List[_Read]]] = []
+        for info in modules:
+            for scope in self._scopes(info.ctx.tree):
+                reads = self._scope_reads(scope)
+                if not reads:
+                    continue
+                for r in reads:
+                    used.add(r.path)
+                    if r.is_write and r.flaggable:
+                        written.add(r.path)
+                per_scope.append((info, reads))
+
+        def resolves(path: str) -> bool:
+            if model.resolves(path):
+                return True
+            return any(w == path or path.startswith(w + ".") for w in written)
+
+        # Phase 2: flag unknown reads, longest failing chain only (the parent
+        # prefix must still resolve), in scopes anchored by >=1 resolving read.
+        for info, reads in per_scope:
+            if not any(r.flaggable and resolves(r.path) for r in reads):
+                continue
+            for r in reads:
+                if not r.flaggable or r.is_write or resolves(r.path):
+                    continue
+                parent = r.path.rsplit(".", 1)[0] if "." in r.path else ""
+                if resolves(parent):
+                    info.ctx.report(
+                        self.id,
+                        r.node,
+                        f"config path `{r.path}` does not exist under any "
+                        "composition of "
+                        f"{os.path.basename(os.path.dirname(model.root))}/configs "
+                        "— renamed or removed in YAML? `cfg.get(...)` would "
+                        "silently fall back to its default",
+                    )
+        # Deadness is a whole-package property: a partial scan (one file, one
+        # subpackage) starves the used-set and would flag everything the
+        # unscanned modules read. Only report dead keys when the scan covers
+        # every module of the package that owns the configs/ tree.
+        if len(modules) >= self._package_py_count(model.root):
+            self._report_dead(actx, model, used)
+
+    @staticmethod
+    def _package_py_count(config_root: str) -> int:
+        """Number of .py files in the package owning the configs/ tree."""
+        package_dir = os.path.dirname(config_root)
+        count = 0
+        for dirpath, dirnames, filenames in os.walk(package_dir):
+            dirnames[:] = [d for d in dirnames if d not in ("__pycache__", ".git")]
+            count += sum(1 for n in filenames if n.endswith(".py"))
+        return count
+
+    def _report_dead(self, actx: AnalysisContext, model: ConfigModel, used: Set[str]) -> None:
+        for leaf in model.dead_leaves(used):
+            rel = os.path.relpath(leaf.file, os.getcwd())
+            display = (leaf.file if rel.startswith("..") else rel).replace(os.sep, "/")
+            lines = model.lines.get(leaf.file, [])
+            snippet = lines[leaf.line - 1].strip() if 0 < leaf.line <= len(lines) else ""
+            actx.report_external(
+                self.id,
+                display,
+                leaf.line,
+                f"config key `{leaf.path}` is never read by any `cfg.*` path "
+                "or `${...}` interpolation — dead weight, or the code-side "
+                "read was renamed; delete it or suppress with a justification",
+                snippet=snippet,
+                suppressions=model.suppressions.get(leaf.file),
+            )
+
+    # --------------------------------------------------------- read extraction
+    @staticmethod
+    def _scopes(tree: ast.Module):
+        yield tree
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+
+    def _scope_reads(self, scope: ast.AST) -> List[_Read]:
+        # One forward pass for single-level aliases (`algo_cfg = cfg.algo`),
+        # then a full pass extracting dotted reads from roots and aliases.
+        aliases: Dict[str, str] = {}
+        for node in walk_scope(scope):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+                chain = _chain(node.value)
+                if (
+                    isinstance(target, ast.Name)
+                    and chain is not None
+                    and chain[0] in _ROOT_NAMES
+                    and chain[1]
+                    and _DYNAMIC not in chain[1]
+                ):
+                    aliases[target.id] = ".".join(chain[1])
+        reads: List[_Read] = []
+        for node in walk_scope(scope):
+            if not isinstance(node, (ast.Attribute, ast.Subscript, ast.Call)):
+                continue
+            chain = _chain(node)
+            if chain is None:
+                continue
+            root_name, segs = chain
+            if root_name == "self" and segs and segs[0] in _ROOT_NAMES:
+                root_name, segs = segs[0], segs[1:]
+                if not segs:
+                    continue
+            if root_name in _ROOT_NAMES:
+                prefix: List[str] = []
+            elif root_name in aliases:
+                prefix = aliases[root_name].split(".")
+            else:
+                continue
+            segs = prefix + segs
+            if segs and segs[-1] in _DICT_METHODS:
+                segs = segs[:-1]
+            if not segs:
+                continue
+            flaggable = _DYNAMIC not in segs
+            if not flaggable:
+                segs = segs[: segs.index(_DYNAMIC)]
+                if not segs:
+                    continue
+            is_write = isinstance(getattr(node, "ctx", None), (ast.Store, ast.Del))
+            reads.append(_Read(".".join(segs), node, flaggable, is_write))
+        return reads

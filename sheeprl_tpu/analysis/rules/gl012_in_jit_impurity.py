@@ -1,0 +1,126 @@
+"""GL012: host side effects reachable from a jit boundary.
+
+A traced function body runs **once**, at trace time. ``time.time()`` inside
+it stamps the trace, not the step: every subsequent call of the compiled
+executable sees the same frozen value. ``np.random.*`` draws a host sample
+once and bakes it into the graph as a constant. ``print`` fires at trace
+time only (then never again), ``global`` mutation happens once per
+recompile, and file I/O runs at unpredictable times relative to the
+asynchronously-dispatched device work.
+
+The lexical version of this check is easy and useless: nobody calls
+``time.time()`` in the decorated function — they call it in a helper three
+frames down. This rule therefore walks the project jit closure (a function
+is in-jit when *reachable from* any ``jax.jit``/``lax.scan``/``vmap``
+callee through the call graph) and flags host effects anywhere inside it,
+reporting the caller chain back to the tracing entry so the reader can see
+*why* a seemingly innocent utility is traced.
+
+Sanctioned escape hatches are skipped wholesale: anything under a
+``jax.debug.print``/``jax.debug.callback``, ``jax.pure_callback``,
+``jax.experimental.io_callback`` or ``host_callback`` call is exactly the
+supported way to do host work under a trace."""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from sheeprl_tpu.analysis.dataflow import walk_scope
+from sheeprl_tpu.analysis.project import AnalysisContext
+from sheeprl_tpu.analysis.registry import ProjectRule, register_rule
+
+_IMPURE_PREFIXES = (
+    "time.",
+    "random.",
+    "numpy.random.",
+    "datetime.",
+    "secrets.",
+    "logging.",
+)
+_IMPURE_BUILTINS = {"print", "open", "input"}
+_ESCAPE_PREFIXES = (
+    "jax.debug.",
+    "jax.pure_callback",
+    "jax.experimental.io_callback",
+    "jax.experimental.host_callback.",
+    "jax.experimental.checkify.",
+)
+
+_HINTS = {
+    "time.": "the timestamp freezes at trace time — time the *dispatch* on the host side",
+    "random.": "the draw is baked into the graph as a constant — thread a jax.random key",
+    "numpy.random.": "the draw is baked into the graph as a constant — thread a jax.random key",
+    "print": "fires once at trace time, then never — use jax.debug.print",
+}
+
+
+def _hint(path: str) -> str:
+    for prefix, hint in _HINTS.items():
+        if path.startswith(prefix):
+            return hint
+    return "runs at trace time, not per step — hoist it out of the traced region or use jax.pure_callback"
+
+
+@register_rule
+class InJitImpurityRule(ProjectRule):
+    id = "GL012"
+    name = "in-jit-impurity"
+    rationale = (
+        "Host side effects (time, host RNG, print/I-O, global mutation) in "
+        "any function reachable from a jit boundary execute once at trace "
+        "time instead of per step."
+    )
+
+    def check_project(self, actx: AnalysisContext) -> None:
+        closure = actx.jit_closure()
+        for info, sym in actx.iter_functions():
+            chain = closure.get(sym.key)
+            if chain is None:
+                continue
+            via = "".join(f", traced via {k}" for k in chain[:1])
+            escaped = self._escaped_nodes(info, sym.node)
+            for node in walk_scope(sym.node):
+                if id(node) in escaped:
+                    continue
+                label = self._impurity(info, node)
+                if label is None:
+                    continue
+                info.ctx.report(
+                    self.id,
+                    node,
+                    f"`{label}` inside `{sym.key.qualname}` which is in the "
+                    f"jit closure{via}: {_hint(label)}",
+                )
+
+    def _escaped_nodes(self, info, fn: ast.AST) -> Set[int]:
+        """ids of every node under a sanctioned host-callback call."""
+        escaped: Set[int] = set()
+        for node in walk_scope(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            path = info.ctx.resolver.resolve(node.func)
+            if path and (
+                path.startswith(_ESCAPE_PREFIXES) or path in ("jax.pure_callback",)
+            ):
+                for sub in ast.walk(node):
+                    escaped.add(id(sub))
+        return escaped
+
+    def _impurity(self, info, node: ast.AST) -> Optional[str]:
+        """A short label when `node` is a host side effect, else None."""
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Name):
+                name = node.func.id
+                if name in _IMPURE_BUILTINS and name not in info.ctx.resolver.aliases:
+                    return name
+                return None
+            path = info.ctx.resolver.resolve(node.func)
+            if path and path.startswith(_IMPURE_PREFIXES):
+                return path
+            return None
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            names = ", ".join(node.names)
+            kw = "global" if isinstance(node, ast.Global) else "nonlocal"
+            return f"{kw} {names}"
+        return None

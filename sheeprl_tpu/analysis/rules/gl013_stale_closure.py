@@ -1,0 +1,121 @@
+"""GL013: jitted closures over values rebuilt or rebound per call.
+
+``jax.jit`` keys its compilation cache on the *function object* plus the
+abstract values of the arguments. Two closure patterns defeat it:
+
+* **jit-in-a-loop** — decorating (or wrapping) a function defined inside a
+  loop creates a fresh function object every iteration, so every iteration
+  pays a full retrace+compile. The profiler shows a training loop that
+  never leaves compilation.
+* **stale capture** — a jitted function reads a free variable that the
+  enclosing scope *rebinds after the definition*. The trace bakes in the
+  value it saw at first call; later rebinds are silently ignored (the
+  compiled executable keeps the stale constant), which is worse than the
+  recompile — it is a wrong-answer bug with no symptom.
+
+The factory idiom (``make_train_step(cfg)`` returning a jitted closure
+over ``cfg``) is the backbone of this codebase and is *fine*: the capture
+is created once and never rebound. So this rule only fires when the def
+sits inside a loop, or when the enclosing scope's def-use chain shows a
+rebind of a captured name after the definition."""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional, Tuple
+
+from sheeprl_tpu.analysis.dataflow import free_loads
+from sheeprl_tpu.analysis.project import AnalysisContext, ModuleInfo
+from sheeprl_tpu.analysis.registry import ProjectRule, register_rule
+
+_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.Module)
+_LOOPS = (ast.For, ast.AsyncFor, ast.While)
+
+
+def _parents(tree: ast.AST) -> Dict[int, ast.AST]:
+    pm: Dict[int, ast.AST] = {}
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            pm[id(child)] = parent
+    return pm
+
+
+def _ancestry(pm: Dict[int, ast.AST], node: ast.AST) -> Iterator[ast.AST]:
+    current = pm.get(id(node))
+    while current is not None:
+        yield current
+        current = pm.get(id(current))
+
+
+@register_rule
+class StaleClosureRule(ProjectRule):
+    id = "GL013"
+    name = "stale-closure-recompile"
+    rationale = (
+        "A jitted function defined in a loop retraces every iteration; one "
+        "whose captured free variable is rebound after the definition bakes "
+        "the stale value into the trace silently."
+    )
+
+    def check_project(self, actx: AnalysisContext) -> None:
+        for info in actx.modules:
+            self._check_module(actx, info)
+
+    def _check_module(self, actx: AnalysisContext, info: ModuleInfo) -> None:
+        pm: Optional[Dict[int, ast.AST]] = None
+        for jf in info.ctx.jitted_functions():
+            node = jf.node
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if jf.reason != "jit":
+                continue  # lax bodies are re-traced by design of the caller
+            if pm is None:
+                pm = _parents(info.ctx.tree)
+            enclosing, loop = self._context_of(pm, node)
+            if loop is not None:
+                info.ctx.report(
+                    self.id,
+                    node,
+                    f"jitted function `{node.name}` is defined inside a loop "
+                    f"(line {loop.lineno}): each iteration creates a new "
+                    "function object and jax.jit recompiles from scratch — "
+                    "hoist the definition out of the loop",
+                )
+                continue
+            if enclosing is None:
+                continue
+            self._check_stale_capture(actx, info, node, enclosing)
+
+    def _context_of(
+        self, pm: Dict[int, ast.AST], node: ast.AST
+    ) -> Tuple[Optional[ast.AST], Optional[ast.AST]]:
+        """(enclosing scope, loop between def and that scope — if any)."""
+        loop = None
+        for ancestor in _ancestry(pm, node):
+            if loop is None and isinstance(ancestor, _LOOPS):
+                loop = ancestor
+            if isinstance(ancestor, _SCOPES):
+                return ancestor, loop
+        return None, loop
+
+    def _check_stale_capture(
+        self, actx: AnalysisContext, info: ModuleInfo, node: ast.AST, enclosing: ast.AST
+    ) -> None:
+        if isinstance(enclosing, ast.Lambda):
+            return
+        df = actx.dataflow(enclosing)
+        pos = (node.end_lineno or node.lineno, node.end_col_offset or 0)
+        for name in sorted(free_loads(node)):
+            if name not in df.local_names():
+                continue
+            rebinds = df.defs_after(name, pos)
+            if not rebinds:
+                continue
+            info.ctx.report(
+                self.id,
+                node,
+                f"jitted function `{node.name}` closes over `{name}`, which "
+                f"the enclosing scope rebinds at line {rebinds[0].line} — the "
+                "trace keeps the value captured at first call and silently "
+                "ignores the rebind; pass it as an argument instead",
+            )

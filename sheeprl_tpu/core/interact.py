@@ -43,6 +43,7 @@ regardless of what the vector env does underneath.
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
@@ -306,14 +307,18 @@ class FetchStats:
         }
 
 
-_LAST_RUN_STATS: Optional[Dict[str, float]] = None
+# bench.py reads the slot from its own thread while a decoupled trainer
+# may still be publishing; swap under the lock.
+_stats_lock = threading.Lock()
+_LAST_RUN_STATS: Optional[Dict[str, float]] = None  # graftlint: guarded-by(_stats_lock)
 
 
 def last_run_stats() -> Optional[Dict[str, float]]:
     """The stats dict from the most recent :meth:`InteractionPipeline.publish`
     in this process — how ``bench.py`` reads a leg's interaction time split
     without parsing logs."""
-    return _LAST_RUN_STATS
+    with _stats_lock:
+        return _LAST_RUN_STATS
 
 
 # ------------------------------------------------------------- PendingFetch
@@ -651,6 +656,7 @@ class InteractionPipeline:
         overlap-fraction gauge to the current tracer."""
         global _LAST_RUN_STATS
         stats = self.snapshot()
-        _LAST_RUN_STATS = stats
+        with _stats_lock:
+            _LAST_RUN_STATS = stats
         tracer_mod.current().set_gauge(OVERLAP_GAUGE, stats["overlap_fraction"])
         return stats

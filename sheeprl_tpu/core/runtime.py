@@ -145,11 +145,15 @@ class DispatchThrottle:
     def add(self, token: Any) -> None:
         self._queue.append(token)
         while len(self._queue) > self._depth:
-            jax.block_until_ready(self._queue.popleft())
+            # Deliberate backpressure: blocking on the OLDEST in-flight step is
+            # what bounds device queue depth (async dispatch would otherwise
+            # run away); the current step keeps riding.
+            jax.block_until_ready(self._queue.popleft())  # graftlint: disable=GL002
 
     def drain(self) -> None:
         while self._queue:
-            jax.block_until_ready(self._queue.popleft())
+            # End-of-run barrier: draining the pipeline is an explicit sync point.
+            jax.block_until_ready(self._queue.popleft())  # graftlint: disable=GL002
 
 
 def enable_xla_determinism() -> None:

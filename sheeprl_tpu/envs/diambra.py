@@ -124,7 +124,7 @@ class DiambraWrapper(gym.Wrapper):
 
     def step(self, action: Any) -> Tuple[Any, float, bool, bool, Dict[str, Any]]:
         if self._action_type == "discrete" and isinstance(action, np.ndarray):
-            action = action.squeeze().item()
+            action = int(action.squeeze())
         obs, reward, terminated, truncated, infos = self.env.step(action)
         infos["env_domain"] = "DIAMBRA"
         return self._convert_obs(obs), reward, terminated or infos.get("env_done", False), truncated, infos

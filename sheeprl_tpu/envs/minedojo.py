@@ -187,8 +187,8 @@ class MineDojoWrapper(gym.Wrapper):
         # equip/place (flattened ids 16, 17 -> functional 5, 6) are only legal
         # when something is equipable; destroy (id 18 -> functional 7) when
         # something is destroyable.
-        masks["action_type"][5:7] *= np.any(equip_mask).item()
-        masks["action_type"][7] *= np.any(destroy_mask).item()
+        masks["action_type"][5:7] *= bool(np.any(equip_mask))
+        masks["action_type"][7] *= bool(np.any(destroy_mask))
         return {
             # the 12 movement/camera actions are always legal; functional ones
             # follow the simulator's mask
@@ -255,15 +255,15 @@ class MineDojoWrapper(gym.Wrapper):
             "x": float(obs["location_stats"]["pos"][0]),
             "y": float(obs["location_stats"]["pos"][1]),
             "z": float(obs["location_stats"]["pos"][2]),
-            "pitch": float(obs["location_stats"]["pitch"].item()),
-            "yaw": float(obs["location_stats"]["yaw"].item()),
+            "pitch": float(obs["location_stats"]["pitch"]),
+            "yaw": float(obs["location_stats"]["yaw"]),
         }
 
     def _life_stats(self, obs: Dict[str, Any]) -> Dict[str, float]:
         return {
-            "life": float(obs["life_stats"]["life"].item()),
-            "oxygen": float(obs["life_stats"]["oxygen"].item()),
-            "food": float(obs["life_stats"]["food"].item()),
+            "life": float(obs["life_stats"]["life"]),
+            "oxygen": float(obs["life_stats"]["oxygen"]),
+            "food": float(obs["life_stats"]["food"]),
         }
 
     # ------------------------------------------------------------ gym API
@@ -287,7 +287,7 @@ class MineDojoWrapper(gym.Wrapper):
                 "life_stats": self._life_stats(obs),
                 "location_stats": copy.deepcopy(self._pos),
                 "action": raw_action.tolist(),
-                "biomeid": float(obs["location_stats"]["biome_id"].item()),
+                "biomeid": float(obs["location_stats"]["biome_id"]),
             }
         )
         return self._convert_obs(obs), reward, done and not is_timelimit, done and is_timelimit, info
@@ -303,7 +303,7 @@ class MineDojoWrapper(gym.Wrapper):
         return self._convert_obs(obs), {
             "life_stats": self._life_stats(obs),
             "location_stats": copy.deepcopy(self._pos),
-            "biomeid": float(obs["location_stats"]["biome_id"].item()),
+            "biomeid": float(obs["location_stats"]["biome_id"]),
         }
 
     def render(self) -> Optional[np.ndarray]:

@@ -175,7 +175,7 @@ class MineRLWrapper(gym.Wrapper):
     # -------------------------------------------------- action conversion
     def _convert_actions(self, action: np.ndarray) -> Dict[str, Any]:
         converted = copy.deepcopy(NOOP)
-        converted.update(self.ACTIONS_MAP[action.item()])
+        converted.update(self.ACTIONS_MAP[int(action)])
         if self._sticky_attack:
             if converted["attack"]:
                 self._sticky_attack_counter = self._sticky_attack

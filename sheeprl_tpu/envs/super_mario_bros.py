@@ -49,7 +49,7 @@ class SuperMarioBrosWrapper(gym.Env):
 
     def step(self, action: Union[np.ndarray, int]) -> Tuple[Any, float, bool, bool, Dict[str, Any]]:
         if isinstance(action, np.ndarray):
-            action = action.squeeze().item()
+            action = int(action.squeeze())
         obs, reward, done, info = self._env.step(action)
         # The NES timer running out is a time limit, not a failure state.
         timed_out = bool(info.get("time", False))

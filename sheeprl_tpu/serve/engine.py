@@ -125,11 +125,11 @@ class InferenceEngine:
         self.max_models = int(max_models)
         self.max_sessions = int(max_sessions)
 
-        self._models: "OrderedDict[str, _HostedModel]" = OrderedDict()
-        self._queue: deque = deque()
+        self._models: "OrderedDict[str, _HostedModel]" = OrderedDict()  # graftlint: guarded-by(self._cv)
+        self._queue: deque = deque()  # graftlint: guarded-by(self._cv)
         self._cv = threading.Condition()
-        self._stop = False
-        self._drain_on_close = True
+        self._stop = False  # graftlint: guarded-by(self._cv)
+        self._drain_on_close = True  # graftlint: guarded-by(self._cv)
         self._thread: Optional[threading.Thread] = None
 
         # Registry-backed metrics: ``stats()`` and the server's ``/metrics``
@@ -141,8 +141,10 @@ class InferenceEngine:
         self._queue_depth_gauge = self.registry.gauge("serve/queue_depth")
         self._occupancy_gauge = self.registry.gauge("serve/batch_occupancy")
         # bucket -> [requests_served, batches] for mean-occupancy reporting.
-        self._occupancy: Dict[int, List[int]] = {}
-        self._ewma_service_s: Optional[float] = None
+        # Written by the dispatcher thread, cleared by reset_stats() from
+        # HTTP/bench threads — both sides must hold the condition's lock.
+        self._occupancy: Dict[int, List[int]] = {}  # graftlint: guarded-by(self._cv)
+        self._ewma_service_s: Optional[float] = None  # graftlint: guarded-by(self._cv)
         # Serve processes have no JaxEventMonitor; the module listeners still
         # mirror compile/retrace/cache traffic into the default registry so
         # ``/metrics`` shows the jax/* counters (warm-up compiles included).
@@ -526,12 +528,16 @@ class InferenceEngine:
                 model.sessions[req.session] = jax.tree_util.tree_map(lambda x: x[i], new_state)
 
         per_request = elapsed / len(live)
-        prev = self._ewma_service_s
-        self._ewma_service_s = per_request if prev is None else 0.2 * per_request + 0.8 * prev
+        with self._cv:
+            # reset_stats() clears the occupancy table from bench/HTTP threads
+            # mid-run; unlocked setdefault here would resurrect a dead bucket
+            # row and double-count against the post-reset window.
+            prev = self._ewma_service_s
+            self._ewma_service_s = per_request if prev is None else 0.2 * per_request + 0.8 * prev
+            occ = self._occupancy.setdefault(bucket, [0, 0])
+            occ[0] += len(live)
+            occ[1] += 1
         self._count("batches")
-        occ = self._occupancy.setdefault(bucket, [0, 0])
-        occ[0] += len(live)
-        occ[1] += 1
 
         # Causality: every request span is a child of ITS caller's trace (the
         # context captured at submit — contextvars don't reach this thread),

@@ -117,10 +117,10 @@ class FlightRecorder:
         # the only timebase processes share.
         self._perf_epoch = time.perf_counter()
         self._wall_epoch = time.time()
-        self._last_spill = 0.0
+        self._last_spill = 0.0  # graftlint: guarded-by(self._spill_lock)
         self._spill_lock = threading.Lock()
         self._dump_lock = threading.Lock()
-        self._last_dump = 0.0
+        self._last_dump = 0.0  # graftlint: guarded-by(self._dump_lock)
         self.dump_paths: List[str] = []
         self._log_handler: Optional[_FlightLogHandler] = None
 
@@ -405,9 +405,9 @@ def _merge_records(
 
 # ------------------------------------------------------- module singleton
 _lock = threading.Lock()
-_recorder: Optional[FlightRecorder] = None
-_prev_excepthook: Optional[Callable[..., None]] = None
-_prev_threading_hook: Optional[Callable[..., None]] = None
+_recorder: Optional[FlightRecorder] = None  # graftlint: guarded-by(_lock)
+_prev_excepthook: Optional[Callable[..., None]] = None  # graftlint: guarded-by(_lock)
+_prev_threading_hook: Optional[Callable[..., None]] = None  # graftlint: guarded-by(_lock)
 
 
 def _crash_excepthook(exc_type, exc, tb) -> None:  # pragma: no cover - exercised via direct call

@@ -70,7 +70,7 @@ class Counter:
     def __init__(self, name: str) -> None:
         self.name = name
         self._lock = threading.Lock()
-        self._value = 0.0
+        self._value = 0.0  # graftlint: guarded-by(self._lock)
 
     def inc(self, amount: float = 1.0) -> None:
         amount = float(amount)
@@ -99,7 +99,7 @@ class Gauge:
     def __init__(self, name: str) -> None:
         self.name = name
         self._lock = threading.Lock()
-        self._value = 0.0
+        self._value = 0.0  # graftlint: guarded-by(self._lock)
 
     def set(self, value: float) -> None:
         with self._lock:
@@ -128,9 +128,9 @@ class MetricsRegistry:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._counters: Dict[str, Counter] = {}
-        self._gauges: Dict[str, Gauge] = {}
-        self._histograms: Dict[str, Histogram] = {}
+        self._counters: Dict[str, Counter] = {}  # graftlint: guarded-by(self._lock)
+        self._gauges: Dict[str, Gauge] = {}  # graftlint: guarded-by(self._lock)
+        self._histograms: Dict[str, Histogram] = {}  # graftlint: guarded-by(self._lock)
 
     # ------------------------------------------------------------ accessors
     def _check_free(self, name: str, kind: str) -> None:
@@ -291,7 +291,7 @@ class MetricsExporter:
 
 # ----------------------------------------------------------------- default
 _default_lock = threading.Lock()
-_default: Optional[MetricsRegistry] = None
+_default: Optional[MetricsRegistry] = None  # graftlint: guarded-by(_default_lock)
 
 
 def default_registry() -> MetricsRegistry:

@@ -1,0 +1,17 @@
+"""Clean cross-module donation: the result is rebound over the donated name."""
+
+from gl009_clean.steps import train_step
+
+
+def run(state, batches):
+    for batch in batches:
+        state = train_step(state, batch)
+    return state
+
+
+def profiled(state, batch):
+    out = train_step(state, batch)
+    # Deliberate: this path feeds host-resident numpy arrays, which jax
+    # copies instead of donating, so the read-after is safe.
+    norm = state.sum()  # graftlint: disable=GL009
+    return out, norm

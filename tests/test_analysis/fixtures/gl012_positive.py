@@ -1,0 +1,30 @@
+"""True positives for GL012: host side effects reachable from a jit boundary.
+
+Neither helper is decorated; both are in the jit closure because
+`train_step` (jitted) calls `_inner_step`, which calls them.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def _log_shape(x):
+    print("tracing with", x.shape)  # <- GL012
+
+
+def _stamp(metrics):
+    metrics["wall"] = time.time()  # <- GL012
+    return metrics
+
+
+def _inner_step(params, batch):
+    _log_shape(batch)
+    loss = jnp.mean(batch)
+    return _stamp({"loss": loss})
+
+
+@jax.jit
+def train_step(params, batch):
+    return _inner_step(params, batch)

@@ -1,0 +1,26 @@
+"""True positives for GL013: jit-in-a-loop and stale closure capture."""
+
+import jax
+
+
+def retrace_forever(batches):
+    outs = []
+    for batch in batches:
+
+        @jax.jit
+        def scaled(x):  # <- GL013
+            return x * 2
+
+        outs.append(scaled(batch))
+    return outs
+
+
+def stale_capture(params):
+    scale = 1.0
+
+    @jax.jit
+    def apply(x):  # <- GL013
+        return x * scale
+
+    scale = 2.0  # silently ignored by the compiled executable
+    return apply(params), scale

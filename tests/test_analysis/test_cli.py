@@ -23,11 +23,14 @@ def test_clean_file_exits_zero(tmp_path, capsys):
     assert main([str(clean), "--no-baseline"]) == 0
 
 
-def test_repo_scan_with_baseline_exits_zero(capsys):
-    """Acceptance: `python -m sheeprl_tpu.analysis sheeprl_tpu/` is clean."""
+def test_repo_scan_is_clean_without_baseline(capsys):
+    """Acceptance: `python -m sheeprl_tpu.analysis sheeprl_tpu/` is clean,
+    with no baseline file in play."""
     package_dir = os.path.join(REPO_ROOT, "sheeprl_tpu")
     assert main([package_dir]) == 0
-    assert "0 finding(s)" in capsys.readouterr().out
+    out = capsys.readouterr().out
+    assert "0 finding(s)" in out
+    assert "baselined" not in out
 
 
 def test_json_output_parses(capsys):
@@ -58,6 +61,53 @@ def test_list_rules_names_all_five(capsys):
     out = capsys.readouterr().out
     for rule_id in ("GL001", "GL002", "GL003", "GL004", "GL005"):
         assert rule_id in out
+
+
+def test_sarif_format_repo_scan(capsys):
+    """Acceptance: `--format sarif` over the package emits parseable
+    SARIF 2.1.0 with the graftlint driver."""
+    package_dir = os.path.join(REPO_ROOT, "sheeprl_tpu")
+    assert main([package_dir, "--format", "sarif"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["version"] == "2.1.0"
+    assert payload["runs"][0]["tool"]["driver"]["name"] == "graftlint"
+
+
+def test_json_flag_conflicts_with_other_format():
+    assert main([FIXTURES, "--json", "--format", "sarif"]) == 2
+
+
+def test_changed_only_filters_reported_findings(tmp_path, monkeypatch, capsys):
+    """Analysis runs project-wide, but only findings in files changed vs the
+    ref are reported."""
+    import subprocess
+
+    def git(*argv):
+        subprocess.run(
+            ["git", "-c", "user.email=t@t", "-c", "user.name=t", *argv],
+            cwd=tmp_path,
+            check=True,
+            capture_output=True,
+        )
+
+    git("init", "-q")
+    (tmp_path / "clean.py").write_text("x = 1\n")
+    (tmp_path / "bad.py").write_text("from jax import shard_map\n")
+    git("add", ".")
+    git("commit", "-q", "-m", "seed")
+    (tmp_path / "clean.py").write_text("x = 2\n")
+    monkeypatch.chdir(tmp_path)
+    # bad.py is unchanged vs HEAD, so its GL003 finding is not reported...
+    assert main([".", "--no-baseline", "--changed-only", "HEAD"]) == 0
+    capsys.readouterr()
+    # ...but a full scan still fails on it.
+    assert main([".", "--no-baseline"]) == 1
+
+
+def test_changed_only_unresolvable_ref_reports_everything(capsys):
+    fixture = os.path.join(FIXTURES, "gl003_positive.py")
+    assert main([fixture, "--no-baseline", "--changed-only", "no-such-ref-xyz"]) == 1
+    assert "could not diff" in capsys.readouterr().err
 
 
 def test_write_baseline_then_clean(tmp_path, capsys):

@@ -1,9 +1,17 @@
-"""The JSON report is a stable interface: CI and tooling parse it."""
+"""The JSON and SARIF reports are stable interfaces: CI and tooling parse
+them (SARIF specifically feeds GitHub code-scanning annotators)."""
 
 import json
 
 from sheeprl_tpu.analysis import lint_source
-from sheeprl_tpu.analysis.reporter import JSON_SCHEMA_VERSION, render_json, render_text
+from sheeprl_tpu.analysis.reporter import (
+    JSON_SCHEMA_VERSION,
+    SARIF_SCHEMA,
+    SARIF_VERSION,
+    render_json,
+    render_sarif,
+    render_text,
+)
 
 _BAD = "from jax import shard_map\n"
 
@@ -65,3 +73,56 @@ def test_text_report_has_clickable_locations_and_summary():
 def test_syntax_error_becomes_gl000_not_a_crash():
     findings, _ = lint_source("def broken(:\n", path="broken.py")
     assert [f.rule for f in findings] == ["GL000"]
+
+
+# ------------------------------------------------------------------- SARIF
+def _sarif(source=_BAD):
+    findings, suppressed = lint_source(source, path="sample.py")
+    return json.loads(render_sarif(findings, files_scanned=1, suppressed=suppressed))
+
+
+def test_sarif_log_shape():
+    payload = _sarif()
+    assert payload["$schema"] == SARIF_SCHEMA
+    assert payload["version"] == SARIF_VERSION == "2.1.0"
+    assert len(payload["runs"]) == 1
+    run = payload["runs"][0]
+    assert run["columnKind"] == "utf16CodeUnits"
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "graftlint"
+    assert isinstance(driver["version"], str)
+
+
+def test_sarif_rule_table_is_complete_even_on_clean_scans():
+    """A clean run must still document what was checked."""
+    from sheeprl_tpu.analysis.registry import all_rules
+
+    run = _sarif("x = 1\n")["runs"][0]
+    assert run["results"] == []
+    ids = [r["id"] for r in run["tool"]["driver"]["rules"]]
+    assert ids == [r.id for r in all_rules()]
+    for rule in run["tool"]["driver"]["rules"]:
+        assert rule["fullDescription"]["text"]
+        assert rule["defaultConfiguration"]["level"] == "warning"
+
+
+def test_sarif_result_shape_and_rule_index():
+    run = _sarif()["runs"][0]
+    result = run["results"][0]
+    assert result["ruleId"] == "GL003"
+    assert result["level"] == "warning"
+    assert result["message"]["text"]
+    loc = result["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "sample.py"
+    region = loc["region"]
+    assert region["startLine"] == 1 and region["startColumn"] >= 1
+    assert region["snippet"]["text"] == "from jax import shard_map"
+    # ruleIndex must point back into the driver's rule table.
+    rules = run["tool"]["driver"]["rules"]
+    assert rules[result["ruleIndex"]]["id"] == "GL003"
+
+
+def test_sarif_run_properties_carry_scan_counters():
+    props = _sarif()["runs"][0]["properties"]
+    assert set(props) == {"filesScanned", "baselined", "suppressed"}
+    assert props["filesScanned"] == 1

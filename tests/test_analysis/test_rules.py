@@ -5,11 +5,26 @@ import os
 
 import pytest
 
-from sheeprl_tpu.analysis import lint_file
+from sheeprl_tpu.analysis import lint_file, lint_paths
 
 FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
 
-ALL_RULE_IDS = ("GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007", "GL008")
+# Single-file fixtures. GL009/GL011 are inherently multi-file (cross-module
+# donation, code-vs-YAML drift) and live in fixture *directories* below.
+ALL_RULE_IDS = (
+    "GL001",
+    "GL002",
+    "GL003",
+    "GL004",
+    "GL005",
+    "GL006",
+    "GL007",
+    "GL008",
+    "GL010",
+    "GL012",
+    "GL013",
+)
+DIR_RULE_IDS = ("GL009", "GL011")
 
 
 def _lint_fixture(name):
@@ -104,3 +119,58 @@ def test_gl006_needs_the_interact_import():
 def test_gl006_ignores_host_arrays_and_code_outside_the_loop():
     findings, _ = _lint_fixture("gl006_clean.py")
     assert findings == []
+
+
+# --------------------------------------------------------- directory fixtures
+def _lint_dir(name):
+    return lint_paths([os.path.join(FIXTURES, name)])
+
+
+def _annotated_lines(dirname, rule_id):
+    expected = {}
+    root = os.path.join(FIXTURES, dirname)
+    for base, _, names in os.walk(root):
+        for name in sorted(names):
+            if not name.endswith((".py", ".yaml")):
+                continue
+            path = os.path.join(base, name)
+            with open(path, "r", encoding="utf-8") as fh:
+                for lineno, line in enumerate(fh, start=1):
+                    if f"<- {rule_id}" in line:
+                        expected.setdefault(name, set()).add(lineno)
+    return expected
+
+
+@pytest.mark.parametrize("rule_id", DIR_RULE_IDS)
+def test_positive_dir_fixture_fires_on_annotated_lines(rule_id):
+    dirname = f"{rule_id.lower()}_positive"
+    findings, _, _ = _lint_dir(dirname)
+    flagged = {}
+    for f in findings:
+        if f.rule == rule_id:
+            flagged.setdefault(os.path.basename(f.path), set()).add(f.line)
+    expected = _annotated_lines(dirname, rule_id)
+    assert expected, f"{dirname} has no `<- {rule_id}` annotations"
+    for name, lines in expected.items():
+        missing = lines - flagged.get(name, set())
+        assert not missing, f"{dirname}/{name}: annotated lines not flagged: {sorted(missing)}"
+    assert {f.rule for f in findings} == {rule_id}, [f.format_text() for f in findings]
+
+
+@pytest.mark.parametrize("rule_id", DIR_RULE_IDS)
+def test_clean_dir_fixture_is_silent(rule_id):
+    findings, _, suppressed = _lint_dir(f"{rule_id.lower()}_clean")
+    assert findings == [], [f.format_text() for f in findings]
+    assert suppressed >= 1
+
+
+def test_gl009_does_not_double_report_with_gl005():
+    """Cross-module sites are GL009's; GL005 must stay quiet on them."""
+    findings, _, _ = _lint_dir("gl009_positive")
+    assert not any(f.rule == "GL005" for f in findings)
+
+
+def test_gl011_reports_both_drift_directions():
+    findings, _, _ = _lint_dir("gl011_positive")
+    by_ext = {os.path.splitext(f.path)[1] for f in findings if f.rule == "GL011"}
+    assert by_ext == {".py", ".yaml"}, "expected an unknown read AND a dead YAML key"
