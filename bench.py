@@ -1,5 +1,12 @@
 """Driver benchmark. Prints exactly ONE JSON line:
-{"metric", "value", "unit", "vs_baseline"}.
+{"metric", "value", "unit", "vs_baseline"} — guaranteed to be the LAST line
+on stdout with both streams flushed first (XLA's absl warnings are silenced
+via TF_CPP_MIN_LOG_LEVEL; harvest the final line starting with '{'). Every
+finished leg also appends a schema-versioned record (git sha, hardware
+fingerprint, goodput snapshot) to BENCH_HISTORY.jsonl — the durable bench
+trajectory behind `python -m sheeprl_tpu.telemetry perf` (see
+telemetry/bench_db.py; SHEEPRL_BENCH_NO_HISTORY=1 skips the append for
+smoke runs).
 
 Default workload: **DreamerV3** — the north-star metric (BASELINE.json) — on
 the reference benchmark recipe (configs/exp/dreamer_v3_benchmarks.yaml):
@@ -30,9 +37,12 @@ world-model/actor/critic training step and the per-step policy latency.
 Workloads:
 `python bench.py [dreamer_v3|dreamer_v3_devbuf|dreamer_v3_pipe|dreamer_v3_S|
 dreamer_v3_S_b32|dreamer_v3_S_b64|dreamer_v3_health|dreamer_v2|dreamer_v1|
-ppo|a2c|sac|sac_devbuf|sac_pipe|sac_resilience|sac_health|sac_flight|
-serve_sac|serve_sac_traced|ppo_anakin|sac_anakin|dreamer_v3_anakin|
-graftlint_repo]`. `graftlint_repo` is the static-analysis leg: whole-package
+dreamer_v3_goodput|ppo|a2c|sac|sac_devbuf|sac_pipe|sac_resilience|
+sac_health|sac_flight|sac_goodput|serve_sac|serve_sac_traced|ppo_anakin|
+sac_anakin|dreamer_v3_anakin|graftlint_repo]`. The `*_goodput` legs are the
+roofline-accounting A/B (telemetry/perf.py armed vs the plain row, <2%
+target) and embed the run's mfu / bandwidth-utilization /
+compute-infeed-host breakdown snapshot. `graftlint_repo` is the static-analysis leg: whole-package
 graftlint wall time vs the 10 s CI-gate budget (no jax import on that path). The `*_pipe` legs are the
 pipelined-interaction A/B (fabric.async_fetch, env.pipeline_slices —
 core/interact.py); every result embeds the interaction time split and
@@ -69,6 +79,12 @@ import os
 import sys
 import time
 
+# XLA's C++ logging (absl) writes warnings to stderr — e.g. the CPU AOT
+# loader's SIGILL feature-mismatch notes visible in BENCH_r05.json's tail —
+# and a `2>&1` harvest then interleaves them with the result line. Level 3
+# silences everything below FATAL; it must be in the environment before the
+# first jax import (here AND in the subprocess probes, which inherit it).
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 
 _PROBE_TTL_S = 300.0
 
@@ -374,13 +390,58 @@ def bench_sac_flight():
     # `sac` row. Acceptance target: within 2% of `sac` — a trace-context
     # child is two string formats, a span append one locked deque push, the
     # flight sink one GIL-atomic ring append, and worker spills rewrite one
-    # small file every few seconds off the step path.
+    # small file every few seconds off the step path. Goodput accounting is
+    # pinned OFF so this row keeps isolating the tracing cost (the goodput
+    # A/B is its own leg, sac_goodput).
     result = _timeboxed(
         "sac_flight_env_steps_per_sec", "sac_benchmarks", 65536, 65536 / 320.21,
         learning_starts=100, warmup_steps=1024, start_steps=4096,
-        extra=("fabric.player_sync=async", "telemetry.enabled=True"),
+        extra=("fabric.player_sync=async", "telemetry.enabled=True", "telemetry.perf.enabled=False"),
     )
     result["flight"] = {"tracing": True, "recorder": True}
+    return result
+
+
+def _goodput_snapshot():
+    """(summary, breakdown) from the most recent PerfAccountant publish in
+    this process — the long measured run's final log interval."""
+    from sheeprl_tpu.telemetry.perf import last_published
+
+    gauges = last_published()
+    if not gauges:
+        return None, None
+    summary = {
+        short: round(gauges[f"perf/{short}"], 6)
+        for short in ("mfu", "hbm_bw_util", "flops_per_s", "bytes_per_s", "train_steps_per_s")
+        if f"perf/{short}" in gauges
+    }
+    breakdown = {
+        lane: round(gauges[f"perf/step_time_breakdown_{lane}"], 4)
+        for lane in ("compute", "infeed", "host")
+        if f"perf/step_time_breakdown_{lane}" in gauges
+    }
+    return (summary or None), (breakdown or None)
+
+
+def bench_sac_goodput():
+    # A/B leg: roofline goodput accounting armed (telemetry/perf.py — cost
+    # specs noted per dispatch, lower/compile harvest + gauge publish at the
+    # log interval) on the same SAC workload and baseline as the plain `sac`
+    # row. Acceptance target: within 2% of `sac` — the dispatch-path cost is
+    # one locked dict increment per train call. metric.log_level=1 (vs the
+    # recipe's 0) so log_counters actually publishes; log_every stays at the
+    # recipe's 70000, so the only interval is the run-final one and the
+    # embedded snapshot summarizes the whole measured run.
+    result = _timeboxed(
+        "sac_goodput_env_steps_per_sec", "sac_benchmarks", 65536, 65536 / 320.21,
+        learning_starts=100, warmup_steps=1024, start_steps=4096,
+        extra=("fabric.player_sync=async", "telemetry.enabled=True", "metric.log_level=1"),
+    )
+    summary, breakdown = _goodput_snapshot()
+    if summary:
+        result["goodput"] = summary
+    if breakdown:
+        result["step_time_breakdown"] = breakdown
     return result
 
 
@@ -540,6 +601,7 @@ def _bench_dreamer(
     device_buffer: bool = False,
     pipelined: bool = False,
     health: bool = False,
+    goodput: bool = False,
 ):
     # Off-policy: async weight mirror (see bench_sac). Precision is passed
     # explicitly so the result JSON records the semantics the number was
@@ -562,6 +624,11 @@ def _bench_dreamer(
         # critic grad trees + the KL aux, sentinels on the host. <2% target.
         extra += ["health=on"]
         suffix = "_health"
+    if goodput:
+        # A/B leg (see bench_sac_goodput): roofline goodput accounting over
+        # the world-model/actor/critic train jits. <2% target.
+        extra += ["telemetry.enabled=True", "metric.log_level=1"]
+        suffix = "_goodput"
     result = _timeboxed(
         f"dreamer_v{version}{suffix}_env_steps_per_sec",
         f"dreamer_v{version}_benchmarks",
@@ -575,6 +642,12 @@ def _bench_dreamer(
         result["fused_train_steps"] = 8
     if health:
         result["health"] = {"probes": True, "sentinels": True}
+    if goodput:
+        summary, breakdown = _goodput_snapshot()
+        if summary:
+            result["goodput"] = summary
+        if breakdown:
+            result["step_time_breakdown"] = breakdown
     return result
 
 
@@ -789,18 +862,75 @@ def bench_graftlint_repo():
     }
 
 
+def _append_history(leg: str, result: dict) -> None:
+    """One schema-versioned record per finished leg into BENCH_HISTORY.jsonl
+    (telemetry/bench_db.py): git sha + dirty flag, hardware fingerprint,
+    value/unit, and the goodput/breakdown snapshot when the leg carried one.
+    SHEEPRL_BENCH_HISTORY overrides the path; SHEEPRL_BENCH_NO_HISTORY=1
+    skips the append (smoke runs with shrunk windows must not pollute the
+    regression baseline). bench_db is stdlib-only — safe on the jax-free
+    graftlint path too."""
+    if os.environ.get("SHEEPRL_BENCH_NO_HISTORY") == "1":
+        return
+    repo = os.path.dirname(os.path.abspath(__file__))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from sheeprl_tpu.telemetry import bench_db
+
+    device = str(result.get("device", ""))
+    if not device:
+        # Stamp the accelerator kind when a jax leg already paid the import;
+        # the jax-free graftlint leg must not pull jax in just for this.
+        jax_mod = sys.modules.get("jax")
+        if jax_mod is not None:
+            try:
+                device = jax_mod.devices()[0].device_kind
+            except Exception:
+                device = ""
+    record = bench_db.make_record(
+        leg,
+        float(result["value"]),
+        str(result.get("unit", "")),
+        backend=str(result.get("backend", "unknown")),
+        device=device,
+        extra={"vs_baseline": result.get("vs_baseline")},
+        goodput=result.get("goodput"),
+        breakdown=result.get("step_time_breakdown"),
+        root=repo,
+    )
+    path = bench_db.default_history_path(repo)
+    bench_db.append_record(path, record)
+    print(f"bench: appended {leg} record to {path}", file=sys.stderr)
+
+
+def _emit(leg: str, result: dict) -> None:
+    """The bench's output contract: append the history record, then print the
+    result as the LAST line on stdout — both streams flushed first, so a
+    combined `2>&1` capture can always recover the record as the final line
+    starting with '{' even when something (a library, a late absl warning)
+    wrote noise around it."""
+    try:
+        _append_history(leg, result)
+    except Exception as err:  # noqa: BLE001 - history is best-effort, the result line is the contract
+        print(f"bench: history append failed: {err}", file=sys.stderr)
+    sys.stderr.flush()
+    sys.stdout.flush()
+    sys.stdout.write(json.dumps(result) + "\n")
+    sys.stdout.flush()
+
+
 def main() -> None:
     which = sys.argv[1] if len(sys.argv) > 1 else "dreamer_v3"
     if which == "graftlint_repo":
         # Static-analysis leg: no accelerator probe, no jax, no registry.
-        print(json.dumps(bench_graftlint_repo()))
+        _emit(which, bench_graftlint_repo())
         return
     # PPO/A2C/SAC are the reference's 4-CPU workloads and pin
     # fabric.accelerator=cpu in their exp configs; select the CPU platform
     # outright so the accelerator plugin is never initialized for them.
     # Accelerator workloads probe the device first and fall back to CPU
     # (recorded in the output) rather than hang on a wedged plugin.
-    if which in ("ppo", "a2c", "sac", "sac_health", "sac_flight", "serve_sac", "serve_sac_traced"):
+    if which in ("ppo", "a2c", "sac", "sac_health", "sac_flight", "sac_goodput", "serve_sac", "serve_sac_traced"):
         platform = "cpu"
     elif os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
         platform = "cpu"  # already pinned: nothing to probe
@@ -825,6 +955,7 @@ def main() -> None:
         "dreamer_v3_devbuf": lambda: _bench_dreamer("3", 1589.30, device_buffer=True),
         "dreamer_v3_pipe": lambda: _bench_dreamer("3", 1589.30, pipelined=True),
         "dreamer_v3_health": lambda: _bench_dreamer("3", 1589.30, health=True),
+        "dreamer_v3_goodput": lambda: _bench_dreamer("3", 1589.30, goodput=True),
         "dreamer_v3_S": bench_dreamer_v3_S,
         "dreamer_v3_S_b32": lambda: bench_dreamer_v3_S(batch=32),
         "dreamer_v3_S_b64": lambda: bench_dreamer_v3_S(batch=64),
@@ -838,6 +969,7 @@ def main() -> None:
         "sac_resilience": bench_sac_resilience,
         "sac_health": bench_sac_health,
         "sac_flight": bench_sac_flight,
+        "sac_goodput": bench_sac_goodput,
         "serve_sac": bench_serve_sac,
         "serve_sac_traced": lambda: bench_serve_sac(traced=True),
         "ppo_anakin": bench_ppo_anakin,
@@ -845,7 +977,7 @@ def main() -> None:
         "dreamer_v3_anakin": bench_dreamer_v3_anakin,
     }[which]()
     result["backend"] = jax.default_backend()
-    print(json.dumps(result))
+    _emit(which, result)
 
 
 if __name__ == "__main__":

@@ -31,4 +31,11 @@ fi
 echo "== graftlint (whole package, zero findings, no baseline) =="
 python -m sheeprl_tpu.analysis --no-baseline sheeprl_tpu/ || rc=1
 
+# Performance-observatory gate: the goodput accountant and the bench store
+# sit on the hot dispatch path / the CI gate path — they hold zero findings
+# by name so a future package-wide policy change can't quietly exempt them.
+echo "== graftlint (performance observatory, zero findings) =="
+python -m sheeprl_tpu.analysis --no-baseline \
+    sheeprl_tpu/telemetry/perf.py sheeprl_tpu/telemetry/bench_db.py || rc=1
+
 exit "$rc"

@@ -21,7 +21,9 @@ fi
 failed=0
 for w in ppo a2c sac dreamer_v1 dreamer_v2 dreamer_v3 dreamer_v3_S; do
     echo "=== $w ===" >&2
-    line=$(python bench.py "$w" 2>"$errdir/$w.err" | tail -1)
+    # Harvest the last JSON line specifically (grep '^{'): even with stderr
+    # split off, a library printing to stdout must not corrupt the record.
+    line=$(python bench.py "$w" 2>"$errdir/$w.err" | grep '^{' | tail -1)
     if [ -n "$line" ]; then
         echo "$line" | tee -a "$out"
     else

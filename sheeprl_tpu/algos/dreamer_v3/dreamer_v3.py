@@ -716,6 +716,7 @@ def main(runtime, cfg: Dict[str, Any]):
     # round trip over a tunneled chip). Scalars only, so the pinned device
     # memory is negligible.
     train_timer = telemetry.step_timer("train", timer_key="Time/train_time")
+    perf = telemetry.perf
     keep_train_metrics = (
         aggregator is not None and not aggregator.disabled and cfg.metric.log_level > 0
     ) or health.enabled
@@ -756,6 +757,14 @@ def main(runtime, cfg: Dict[str, Any]):
                             cfg.algo.critic.per_rank_target_network_update_freq,
                             cfg.algo.critic.tau,
                         )
+                        # Goodput accounting BEFORE the dispatch: arg shape
+                        # specs must be captured while the buffers are alive
+                        # (the jit donates them).
+                        perf.note(
+                            f"train/fused_k{k}", fused_train_fn,
+                            (agent_state, opt_states, moments_state, ring.state, train_key, taus),
+                            steps=k,
+                        )
                         with train_timer.step(), watch(watchdog, "train_dispatch"):
                             agent_state, opt_states, moments_state, train_metrics, train_key = fused_train_fn(
                                 agent_state, opt_states, moments_state, ring.state,
@@ -788,10 +797,14 @@ def main(runtime, cfg: Dict[str, Any]):
                         else:
                             tau = 0.0
                         batch = batches[i]
+                        tau_arr = np.asarray(tau, np.float32)
+                        perf.note(
+                            "train/step", train_fn,
+                            (agent_state, opt_states, moments_state, batch, train_key, tau_arr),
+                        )
                         with train_timer.step(), watch(watchdog, "train_dispatch"):
                             agent_state, opt_states, moments_state, train_metrics, train_key = train_fn(
-                                agent_state, opt_states, moments_state, batch, train_key,
-                                np.asarray(tau, np.float32),
+                                agent_state, opt_states, moments_state, batch, train_key, tau_arr,
                             )
                         # Feed EVERY gradient step's losses toward the log
                         # (only sampling the last one under-reports the
@@ -822,7 +835,7 @@ def main(runtime, cfg: Dict[str, Any]):
         guard.advance(policy_step)
 
         trained_in_flight = False
-        with timer("Time/env_interaction_time"):
+        with timer("Time/env_interaction_time"), perf.infeed():
             if iter_num <= learning_starts and cfg.checkpoint.resume_from is None:
                 real_actions = actions = np.array(envs.action_space.sample())
                 if not is_continuous:

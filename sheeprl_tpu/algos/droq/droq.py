@@ -419,6 +419,7 @@ def main(runtime, cfg: Dict[str, Any]):
     # Coalesced loss fetch + interval bounding (telemetry/step_timer.py):
     # ONE block_until_ready + ONE device_get per log interval.
     train_timer = telemetry.step_timer("train", timer_key="Time/train_time")
+    perf = telemetry.perf
     keep_train_metrics = (aggregator is not None and not aggregator.disabled) or health.enabled
 
     # The iteration's gradient steps, factored out so the pipelined
@@ -445,6 +446,14 @@ def main(runtime, cfg: Dict[str, Any]):
                         # rides only on the LAST bucket.
                         k = 1 << (min(remaining, fused_train_steps).bit_length() - 1)
                         with_actor = remaining - k == 0
+                        # Goodput accounting BEFORE the dispatch: arg shape
+                        # specs must be captured while the buffers are alive
+                        # (the jit donates them).
+                        perf.note(
+                            f"train/fused_k{k}_a{int(with_actor)}", fused_train_fn,
+                            (agent_state, opt_states, ring.state, train_key, k, with_actor),
+                            steps=k,
+                        )
                         with train_timer.step(), watch(watchdog, "train_dispatch"):
                             agent_state, opt_states, train_metrics, train_key = fused_train_fn(
                                 agent_state, opt_states, ring.state, train_key, k, with_actor
@@ -481,6 +490,11 @@ def main(runtime, cfg: Dict[str, Any]):
                     for k, v in actor_sample.items()
                 }
                 with timer("Time/train_time"):
+                    perf.note(
+                        f"train/g{per_rank_gradient_steps}", train_fn,
+                        (agent_state, opt_states, critic_data, actor_data, train_key),
+                        steps=per_rank_gradient_steps,
+                    )
                     with train_timer.step(), watch(watchdog, "train_dispatch"):
                         agent_state, opt_states, train_metrics, train_key = train_fn(
                             agent_state, opt_states, critic_data, actor_data, train_key
@@ -502,7 +516,7 @@ def main(runtime, cfg: Dict[str, Any]):
         guard.advance(policy_step)
 
         trained_in_flight = False
-        with timer("Time/env_interaction_time"):
+        with timer("Time/env_interaction_time"), perf.infeed():
             if iter_num <= learning_starts:
                 actions = envs.action_space.sample()
                 next_obs, rewards, terminated, truncated, infos = envs.step(

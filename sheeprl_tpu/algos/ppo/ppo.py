@@ -369,6 +369,12 @@ def main(runtime, cfg: Dict[str, Any]):
     # Coalesced loss fetch + interval bounding (telemetry/step_timer.py):
     # ONE block_until_ready + ONE device_get per log interval.
     train_timer = telemetry.step_timer("train", timer_key="Time/train_time")
+    perf = telemetry.perf
+    # One train_fn call runs ALL epochs × minibatches in-graph; that many
+    # gradient steps per dispatch for the goodput steps/s gauge.
+    gradient_steps_per_update = int(cfg.algo.update_epochs) * max(
+        1, -(-int(cfg.algo.rollout_steps) * int(cfg.env.num_envs) // int(cfg.algo.per_rank_batch_size))
+    )
     keep_train_metrics = (aggregator is not None and not aggregator.disabled) or health.enabled
     step_data = {}
     next_obs = pipeline.stash_obs(envs.reset(seed=cfg.seed)[0])
@@ -381,7 +387,7 @@ def main(runtime, cfg: Dict[str, Any]):
         for _ in range(0, cfg.algo.rollout_steps):
             policy_step += cfg.env.num_envs * world_size
 
-            with timer("Time/env_interaction_time"):
+            with timer("Time/env_interaction_time"), perf.infeed():
                 # prepare_obs is pure numpy and the PRNG split + pixel
                 # normalization live inside player_step: the jitted call is
                 # the step's only device dispatch, and ONE (possibly async)
@@ -464,6 +470,15 @@ def main(runtime, cfg: Dict[str, Any]):
         with timer("Time/train_time"):
             # PRNG split runs inside the jit (an eager split on a remote
             # device blocks the host); coefs travel as numpy.
+            clip_arr = np.asarray(cfg.algo.clip_coef, np.float32)
+            ent_arr = np.asarray(cfg.algo.ent_coef, np.float32)
+            # Goodput accounting BEFORE the dispatch: arg shape specs must
+            # be captured while the buffers are alive (the jit donates them).
+            perf.note(
+                "train/update", train_fn,
+                (params, opt_state, data, jnp_next, train_key, clip_arr, ent_arr),
+                steps=gradient_steps_per_update,
+            )
             with train_timer.step(), watch(watchdog, "train_dispatch"):
                 params, opt_state, train_metrics, train_key = train_fn(
                     params,
@@ -471,8 +486,8 @@ def main(runtime, cfg: Dict[str, Any]):
                     data,
                     jnp_next,
                     train_key,
-                    np.asarray(cfg.algo.clip_coef, np.float32),
-                    np.asarray(cfg.algo.ent_coef, np.float32),
+                    clip_arr,
+                    ent_arr,
                 )
             # No sync here: the dispatch stays fully async — the StepTimer
             # queues the loss scalars device-side and bounds the interval

@@ -383,6 +383,7 @@ def main(runtime, cfg: Dict[str, Any]):
     # round trip over a tunneled chip). Scalars only, so the pinned device
     # memory is negligible.
     train_timer = telemetry.step_timer("train", timer_key="Time/train_time")
+    perf = telemetry.perf
     keep_train_metrics = (
         aggregator is not None and not aggregator.disabled and cfg.metric.log_level > 0
     ) or health.enabled
@@ -410,10 +411,17 @@ def main(runtime, cfg: Dict[str, Any]):
                         # Power-of-two buckets bound the fused graphs to
                         # log2(fused_train_steps) variants.
                         k = 1 << (min(remaining, fused_train_steps).bit_length() - 1)
+                        taus = np.full(k, tau_eff, np.float32)
+                        # Goodput accounting BEFORE the dispatch: arg shape
+                        # specs must be captured while the buffers are alive
+                        # (the jit donates them).
+                        perf.note(
+                            f"train/fused_k{k}", fused_train_fn,
+                            (agent_state, opt_states, ring.state, train_key, taus), steps=k,
+                        )
                         with train_timer.step(), watch(watchdog, "train_dispatch"):
                             agent_state, opt_states, train_metrics, train_key = fused_train_fn(
-                                agent_state, opt_states, ring.state, train_key,
-                                np.full(k, tau_eff, np.float32),
+                                agent_state, opt_states, ring.state, train_key, taus,
                             )
                         train_timer.pend(
                             agent_state["actor"], train_metrics if keep_train_metrics else None
@@ -438,13 +446,19 @@ def main(runtime, cfg: Dict[str, Any]):
                     do_ema = iter_num % target_freq_iters == 0
                     # tau as numpy (an eager jnp.asarray would dispatch);
                     # the PRNG split happens inside the jit.
+                    tau_arr = np.asarray(agent.tau if do_ema else 0.0, np.float32)
+                    perf.note(
+                        f"train/g{per_rank_gradient_steps}", train_fn,
+                        (agent_state, opt_states, data, train_key, tau_arr),
+                        steps=per_rank_gradient_steps,
+                    )
                     with train_timer.step(), watch(watchdog, "train_dispatch"):
                         agent_state, opt_states, train_metrics, train_key = train_fn(
                             agent_state,
                             opt_states,
                             data,
                             train_key,
-                            np.asarray(agent.tau if do_ema else 0.0, np.float32),
+                            tau_arr,
                         )
                     # No sync here: the dispatch stays fully async — the
                     # StepTimer queues the loss scalars device-side and
@@ -463,7 +477,7 @@ def main(runtime, cfg: Dict[str, Any]):
         guard.advance(policy_step)
 
         trained_in_flight = False
-        with timer("Time/env_interaction_time"):
+        with timer("Time/env_interaction_time"), perf.infeed():
             if iter_num <= learning_starts:
                 actions = envs.action_space.sample()
                 next_obs, rewards, terminated, truncated, infos = envs.step(

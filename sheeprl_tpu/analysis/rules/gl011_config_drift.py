@@ -102,6 +102,18 @@ def _chain(node: ast.AST) -> Optional[Tuple[str, List[str]]]:
             return None
 
 
+def _alias_value(node: ast.AST) -> ast.AST:
+    """Unwrap the two blessed alias-with-fallback idioms so the chain under
+    them still registers: ``<chain> or {}`` (absent group -> empty dict) and
+    ``<chain> if <cond> else <default>`` (duck-typed cfg probe). Only the
+    primary branch aliases; the fallback produces no reads anyway."""
+    if isinstance(node, ast.BoolOp) and isinstance(node.op, ast.Or) and node.values:
+        return node.values[0]
+    if isinstance(node, ast.IfExp):
+        return node.body
+    return node
+
+
 class _Read:
     __slots__ = ("path", "node", "flaggable", "is_write")
 
@@ -218,21 +230,38 @@ class ConfigDriftRule(ProjectRule):
                 yield node
 
     def _scope_reads(self, scope: ast.AST) -> List[_Read]:
-        # One forward pass for single-level aliases (`algo_cfg = cfg.algo`),
-        # then a full pass extracting dotted reads from roots and aliases.
+        # One forward pass for aliases — single-level (`algo_cfg = cfg.algo`)
+        # and chained (`perf = tele.get("perf") or {}` after
+        # `tele = cfg.telemetry` resolves to `telemetry.perf`, so reads like
+        # `perf.get("enabled")` track the exact `telemetry.perf.enabled`
+        # leaf) — then a full pass extracting dotted reads from roots and
+        # aliases. Source order stands in for control flow: an alias only
+        # covers reads after its (first) definition, same approximation the
+        # read pass already makes.
         aliases: Dict[str, str] = {}
-        for node in walk_scope(scope):
-            if isinstance(node, ast.Assign) and len(node.targets) == 1:
-                target = node.targets[0]
-                chain = _chain(node.value)
-                if (
-                    isinstance(target, ast.Name)
-                    and chain is not None
-                    and chain[0] in _ROOT_NAMES
-                    and chain[1]
-                    and _DYNAMIC not in chain[1]
-                ):
-                    aliases[target.id] = ".".join(chain[1])
+        # walk_scope yields in stack (reverse-source) order; chained aliases
+        # need `tele = cfg.telemetry` registered before `perf = tele.get(...)`,
+        # so process assignments in source position order.
+        assigns = [
+            node
+            for node in walk_scope(scope)
+            if isinstance(node, ast.Assign) and len(node.targets) == 1
+        ]
+        assigns.sort(key=lambda node: (node.lineno, node.col_offset))
+        for node in assigns:
+            target = node.targets[0]
+            chain = _chain(_alias_value(node.value))
+            if not isinstance(target, ast.Name) or chain is None:
+                continue
+            root_name, segs = chain
+            if segs and segs[-1] in _DICT_METHODS:
+                segs = segs[:-1]
+            if not segs or _DYNAMIC in segs:
+                continue
+            if root_name in _ROOT_NAMES:
+                aliases[target.id] = ".".join(segs)
+            elif root_name in aliases and root_name != target.id:
+                aliases[target.id] = aliases[root_name] + "." + ".".join(segs)
         reads: List[_Read] = []
         for node in walk_scope(scope):
             if not isinstance(node, (ast.Attribute, ast.Subscript, ast.Call)):

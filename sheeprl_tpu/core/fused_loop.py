@@ -316,6 +316,7 @@ def ppo_fused_main(runtime, cfg: Dict[str, Any]):
     ep_len = jnp.zeros((E,), jnp.int32)
 
     train_timer = telemetry.step_timer("train", timer_key="Time/train_time")
+    perf = telemetry.perf
     keep_train_metrics = (aggregator is not None and not aggregator.disabled) or health.enabled
     pending_eps: List[Dict[str, Any]] = []
     tracer = tracer_mod.current()
@@ -326,6 +327,15 @@ def ppo_fused_main(runtime, cfg: Dict[str, Any]):
         policy_step += policy_steps_per_iter
 
         with timer("Time/train_time"):
+            clip_arr = np.asarray(cfg.algo.clip_coef, np.float32)
+            ent_arr = np.asarray(cfg.algo.ent_coef, np.float32)
+            # Goodput accounting BEFORE the dispatch (the superstep donates
+            # its carry): the whole rollout+train program is one key.
+            perf.note(
+                "rollout/superstep", superstep,
+                (params, opt_state, env_state, obs, ep_ret, ep_len, loop_key, clip_arr, ent_arr),
+                steps=1,
+            )
             with tracer.span("fused/superstep", "train"), train_timer.step(), watch(
                 watchdog, "train_dispatch"
             ):
@@ -333,8 +343,7 @@ def ppo_fused_main(runtime, cfg: Dict[str, Any]):
                     params, opt_state, env_state, obs, ep_ret, ep_len, ep_info, train_metrics, loop_key,
                 ) = superstep(
                     params, opt_state, env_state, obs, ep_ret, ep_len, loop_key,
-                    np.asarray(cfg.algo.clip_coef, np.float32),
-                    np.asarray(cfg.algo.ent_coef, np.float32),
+                    clip_arr, ent_arr,
                 )
             train_timer.pend(params, train_metrics if keep_train_metrics else None)
         pending_eps.append(ep_info)
@@ -627,6 +636,7 @@ def sac_fused_main(runtime, cfg: Dict[str, Any]):
     cumulative_per_rank_gradient_steps = 0
     dispatch_throttle = DispatchThrottle()
     train_timer = telemetry.step_timer("train", timer_key="Time/train_time")
+    perf = telemetry.perf
     keep_train_metrics = (
         aggregator is not None and not aggregator.disabled and cfg.metric.log_level > 0
     ) or health.enabled
@@ -648,12 +658,20 @@ def sac_fused_main(runtime, cfg: Dict[str, Any]):
         policy_step += chunk * policy_steps_per_iter
 
         with timer("Time/env_interaction_time" if random_phase else "Time/train_time"):
+            rollout_fn = _rollout_fn(chunk, random_phase)
+            # Goodput accounting BEFORE the dispatch (the rollout jit donates
+            # its carry).
+            perf.note(
+                f"rollout/c{chunk}_r{int(random_phase)}", rollout_fn,
+                (agent_state["actor"], ring_state, env_state, obs, ep_ret, ep_len, rollout_key),
+                steps=0,
+            )
             with tracer.span("fused/superstep", "train"), train_timer.step(), watch(
                 watchdog, "train_dispatch"
             ):
-                env_state, obs, ep_ret, ep_len, ring_state, ep_info, rollout_key = _rollout_fn(
-                    chunk, random_phase
-                )(agent_state["actor"], ring_state, env_state, obs, ep_ret, ep_len, rollout_key)
+                env_state, obs, ep_ret, ep_len, ring_state, ep_info, rollout_key = rollout_fn(
+                    agent_state["actor"], ring_state, env_state, obs, ep_ret, ep_len, rollout_key
+                )
             train_timer.pend(ep_info["done"], None)
         pending_eps.append(ep_info)
         ring.adopt_state(ring_state, chunk)
@@ -676,12 +694,17 @@ def sac_fused_main(runtime, cfg: Dict[str, Any]):
                     offset = 0
                     while remaining > 0:
                         k = 1 << (min(remaining, fused_train_steps).bit_length() - 1)
+                        taus_k = taus_full[offset:offset + k]
+                        perf.note(
+                            f"train/fused_k{k}", fused_train_fn,
+                            (agent_state, opt_states, ring_state, train_key, taus_k),
+                            steps=k,
+                        )
                         with tracer.span("fused/train", "train"), train_timer.step(), watch(
                             watchdog, "train_dispatch"
                         ):
                             agent_state, opt_states, train_metrics, train_key = fused_train_fn(
-                                agent_state, opt_states, ring_state, train_key,
-                                taus_full[offset:offset + k],
+                                agent_state, opt_states, ring_state, train_key, taus_k,
                             )
                         train_timer.pend(
                             agent_state["actor"], train_metrics if keep_train_metrics else None
@@ -1020,6 +1043,7 @@ def dreamer_v3_fused_main(runtime, cfg: Dict[str, Any]):
     cumulative_per_rank_gradient_steps = 0
     dispatch_throttle = DispatchThrottle()
     train_timer = telemetry.step_timer("train", timer_key="Time/train_time")
+    perf = telemetry.perf
     keep_train_metrics = (
         aggregator is not None and not aggregator.disabled and cfg.metric.log_level > 0
     ) or health.enabled
@@ -1042,13 +1066,22 @@ def dreamer_v3_fused_main(runtime, cfg: Dict[str, Any]):
         policy_step += chunk * policy_steps_per_iter
 
         with timer("Time/env_interaction_time" if random_phase else "Time/train_time"):
+            rollout_fn = _rollout_fn(chunk, random_phase)
+            # Goodput accounting BEFORE the dispatch (the rollout jit donates
+            # its carry).
+            perf.note(
+                f"rollout/c{chunk}_r{int(random_phase)}", rollout_fn,
+                (agent_state["world_model"], agent_state["actor"], player_state,
+                 env_state, obs, prev, ep_ret, ep_len, ring_state, rollout_key),
+                steps=0,
+            )
             with tracer.span("fused/superstep", "train"), train_timer.step(), watch(
                 watchdog, "train_dispatch"
             ):
                 (
                     env_state, obs, player_state, prev, ep_ret, ep_len, ring_state, ep_info,
                     rows_written, rollout_key,
-                ) = _rollout_fn(chunk, random_phase)(
+                ) = rollout_fn(
                     agent_state["world_model"], agent_state["actor"], player_state,
                     env_state, obs, prev, ep_ret, ep_len, ring_state, rollout_key,
                 )
@@ -1074,6 +1107,11 @@ def dreamer_v3_fused_main(runtime, cfg: Dict[str, Any]):
                             k,
                             cfg.algo.critic.per_rank_target_network_update_freq,
                             cfg.algo.critic.tau,
+                        )
+                        perf.note(
+                            f"train/fused_k{k}", fused_train_fn,
+                            (agent_state, opt_states, moments_state, ring_state, train_key, taus),
+                            steps=k,
                         )
                         with tracer.span("fused/train", "train"), train_timer.step(), watch(
                             watchdog, "train_dispatch"

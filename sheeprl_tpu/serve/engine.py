@@ -114,6 +114,7 @@ class InferenceEngine:
         max_sessions: int = 256,
         autostart: bool = True,
         registry: Optional[MetricsRegistry] = None,
+        goodput: bool = True,
     ) -> None:
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -140,6 +141,11 @@ class InferenceEngine:
         self._counters = {key: self.registry.counter(f"serve/{key}") for key in COUNTER_KEYS}
         self._queue_depth_gauge = self.registry.gauge("serve/queue_depth")
         self._occupancy_gauge = self.registry.gauge("serve/batch_occupancy")
+        # Roofline goodput accounting over the serve jits: cost specs noted at
+        # warm-up/dispatch, published into this engine's registry by stats().
+        from sheeprl_tpu.telemetry.perf import PerfAccountant
+
+        self.perf = PerfAccountant(enabled=bool(goodput), registry=self.registry)
         # bucket -> [requests_served, batches] for mean-occupancy reporting.
         # Written by the dispatcher thread, cleared by reset_stats() from
         # HTTP/bench threads — both sides must hold the condition's lock.
@@ -242,6 +248,12 @@ class InferenceEngine:
                 obs = model.adapter.pack_rows([], bucket)
                 seeds = np.zeros((bucket,), np.uint32)
                 state = self._stack_sessions(model, [model.dummy_session] * bucket) if model.adapter.stateful else None
+                # steps=0: warm-up captures the cost specs without crediting
+                # served work; live dispatches count via _dispatch_batch.
+                self.perf.note(
+                    f"serve/{mode}_b{bucket}", model.applies[mode],
+                    (model.adapter.params, obs, seeds, state), steps=0,
+                )
                 model.applies[mode](model.adapter.params, obs, seeds, state)
         tracer_mod.current().add_span(
             "serve/warmup",
@@ -507,6 +519,12 @@ class InferenceEngine:
             rows.extend([model.dummy_session] * (bucket - len(live)))
             state = self._stack_sessions(model, rows)
 
+        # Goodput accounting BEFORE the apply (stateful adapters donate the
+        # session state): one key per (mode, bucket) program variant.
+        self.perf.note(
+            f"serve/{mode}_b{bucket}", model.applies[mode],
+            (model.adapter.params, obs, seeds, state), steps=len(live),
+        )
         start = time.perf_counter()
         try:
             actions, new_state = model.applies[mode](model.adapter.params, obs, seeds, state)
@@ -523,6 +541,9 @@ class InferenceEngine:
         elapsed = time.perf_counter() - start
         device_s = t_apply - start  # dispatch + (sync backends) execute
         harvest_s = elapsed - device_s  # device_get: where async backends block
+        # Apply + harvest is the batch's device-bound share for the goodput
+        # breakdown (the engine carries no StepTimer).
+        self.perf.add_compute(elapsed)
         if model.adapter.stateful:
             for i, req in enumerate(live):
                 model.sessions[req.session] = jax.tree_util.tree_map(lambda x: x[i], new_state)
@@ -617,6 +638,9 @@ class InferenceEngine:
                 counter.reset()
 
     def stats(self) -> Dict[str, Any]:
+        # Publish the goodput interval into the engine registry so a stats
+        # poll and a /metrics scrape report the same perf/* gauges.
+        goodput = self.perf.publish()
         occupancy = {
             str(bucket): {
                 "batches": int(batches),
@@ -632,4 +656,5 @@ class InferenceEngine:
             "occupancy": occupancy,
             "models": sorted(self._models),
             "buckets": list(self.buckets),
+            "goodput": goodput,
         }

@@ -1,0 +1,294 @@
+"""Persistent bench history: schema-versioned records + regression statistics.
+
+BENCH_r0N.json files were one-off snapshots — useful the day they were taken,
+silent about trajectory. This module gives bench.py a durable spine:
+
+- :func:`make_record` builds a schema-versioned record for one bench leg
+  (git sha + dirty flag, hardware fingerprint, value/unit/direction, optional
+  step-time breakdown and goodput snapshot);
+- :func:`append_record` appends it to ``BENCH_HISTORY.jsonl`` atomically —
+  a single ``O_APPEND`` write under ``flock``, safe when run_all_benches.sh
+  legs land concurrently;
+- :func:`compare` is the noise-aware regression test behind
+  ``python -m sheeprl_tpu.telemetry perf``: median of the baseline window
+  vs median of HEAD reps, flagged only when the relative change exceeds the
+  threshold AND the HEAD median falls outside a bootstrapped CI of the
+  baseline median — so two identical re-runs never trip the gate, while a
+  genuine 2x slowdown always does.
+
+Stdlib-only on purpose: the regression CLI must run on machines (CI gate
+steps, laptops) where importing jax is slow or impossible.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import platform
+import random
+import socket
+import subprocess
+import time
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "HISTORY_FILENAME",
+    "git_stamp",
+    "host_fingerprint",
+    "make_record",
+    "append_record",
+    "load_history",
+    "baseline_stats",
+    "compare",
+    "default_history_path",
+]
+
+SCHEMA_VERSION = 1
+HISTORY_FILENAME = "BENCH_HISTORY.jsonl"
+
+#: Units where a smaller value is better; anything else is higher-better
+#: (throughputs: sps, steps/s, files/s, req/s ...).
+_LOWER_BETTER_UNITS = ("second", "seconds", "s", "ms", "latency_ms", "latency_s")
+
+
+def default_history_path(root: Optional[str] = None) -> str:
+    """``$SHEEPRL_BENCH_HISTORY`` if set, else ``<root>/BENCH_HISTORY.jsonl``
+    (root defaults to the repo checkout containing this file)."""
+    env = os.environ.get("SHEEPRL_BENCH_HISTORY")
+    if env:
+        return env
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(root, HISTORY_FILENAME)
+
+
+# ------------------------------------------------------------------ stamping
+def git_stamp(root: Optional[str] = None) -> Dict[str, Any]:
+    """``{"sha", "dirty"}`` of the checkout at ``root`` (cwd default); both
+    degrade gracefully (sha ``"unknown"``) outside a git work tree."""
+    cwd = root or os.getcwd()
+    try:
+        sha = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=cwd, capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or "unknown"
+    except Exception:
+        sha = "unknown"
+    dirty = False
+    try:
+        status = subprocess.run(
+            ["git", "status", "--porcelain"],
+            cwd=cwd, capture_output=True, text=True, timeout=10,
+        )
+        dirty = status.returncode == 0 and bool(status.stdout.strip())
+    except Exception:
+        pass
+    return {"sha": sha, "dirty": dirty}
+
+
+def host_fingerprint() -> Dict[str, Any]:
+    """Hardware/host identity coarse enough to be stable across runs on the
+    same box, fine enough to separate baselines from different machines."""
+    return {
+        "hostname": socket.gethostname(),
+        "machine": platform.machine(),
+        "system": platform.system(),
+        "cpu_count": os.cpu_count() or 0,
+        "python": platform.python_version(),
+    }
+
+
+def unit_direction(unit: str) -> str:
+    """``"lower"`` for time-like units, ``"higher"`` otherwise."""
+    return "lower" if unit.lower() in _LOWER_BETTER_UNITS else "higher"
+
+
+def make_record(
+    leg: str,
+    value: float,
+    unit: str,
+    *,
+    backend: str = "unknown",
+    device: str = "",
+    extra: Optional[Dict[str, Any]] = None,
+    goodput: Optional[Dict[str, float]] = None,
+    breakdown: Optional[Dict[str, float]] = None,
+    root: Optional[str] = None,
+    direction: Optional[str] = None,
+) -> Dict[str, Any]:
+    """One schema-versioned history record for a finished bench leg."""
+    record: Dict[str, Any] = {
+        "schema": SCHEMA_VERSION,
+        "time": time.time(),
+        "leg": leg,
+        "value": float(value),
+        "unit": unit,
+        "direction": direction or unit_direction(unit),
+        "backend": backend,
+        "device": device,
+        "git": git_stamp(root),
+        "host": host_fingerprint(),
+    }
+    if breakdown:
+        record["breakdown"] = {k: float(v) for k, v in breakdown.items()}
+    if goodput:
+        record["goodput"] = {k: float(v) for k, v in goodput.items()}
+    if extra:
+        record["extra"] = extra
+    return record
+
+
+# ------------------------------------------------------------------- storage
+def append_record(path: str, record: Dict[str, Any]) -> None:
+    """Atomic JSONL append: the full line is a single ``os.write`` on an
+    ``O_APPEND`` descriptor under an exclusive ``flock``, so concurrent bench
+    legs never interleave bytes and readers never see a torn line."""
+    line = json.dumps(record, sort_keys=True) + "\n"
+    data = line.encode("utf-8")
+    parent = os.path.dirname(os.path.abspath(path))
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    try:
+        try:
+            import fcntl
+
+            fcntl.flock(fd, fcntl.LOCK_EX)
+        except (ImportError, OSError):
+            pass  # non-POSIX: O_APPEND single-write is still line-atomic
+        os.write(fd, data)
+    finally:
+        os.close(fd)
+
+
+def load_history(path: str) -> List[Dict[str, Any]]:
+    """All parseable records, file order. Torn/foreign lines are skipped —
+    a corrupt tail must not brick the regression gate."""
+    records: List[Dict[str, Any]] = []
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if isinstance(rec, dict) and "leg" in rec and "value" in rec:
+                    records.append(rec)
+    except FileNotFoundError:
+        pass
+    return records
+
+
+def legs_in(records: Iterable[Dict[str, Any]]) -> List[str]:
+    seen: Dict[str, None] = {}
+    for rec in records:
+        seen.setdefault(str(rec.get("leg")), None)
+    return list(seen)
+
+
+# ---------------------------------------------------------------- statistics
+def _median(values: Sequence[float]) -> float:
+    s = sorted(values)
+    n = len(s)
+    if n == 0:
+        return 0.0
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def bootstrap_ci(
+    values: Sequence[float],
+    *,
+    confidence: float = 0.99,
+    resamples: int = 2000,
+    seed: int = 0,
+) -> Tuple[float, float]:
+    """Percentile-bootstrap CI of the median. Deterministic (seeded): the
+    gate must give the same verdict on the same data every time. With a
+    single sample the CI collapses to the point — identical re-runs of a
+    noiseless leg then compare equal and pass."""
+    vals = [float(v) for v in values]
+    if not vals:
+        return (0.0, 0.0)
+    if len(vals) == 1:
+        return (vals[0], vals[0])
+    rng = random.Random(seed)
+    n = len(vals)
+    medians = sorted(_median([vals[rng.randrange(n)] for _ in range(n)]) for _ in range(resamples))
+    alpha = (1.0 - confidence) / 2.0
+    lo = medians[max(0, min(resamples - 1, int(alpha * resamples)))]
+    hi = medians[max(0, min(resamples - 1, int((1.0 - alpha) * resamples) - 1))]
+    return (lo, hi)
+
+
+def baseline_stats(
+    records: Sequence[Dict[str, Any]],
+    *,
+    window: int = 10,
+    confidence: float = 0.99,
+) -> Optional[Dict[str, Any]]:
+    """Median + bootstrap CI over the last ``window`` records of one leg."""
+    if not records:
+        return None
+    tail = records[-window:]
+    values = [float(r["value"]) for r in tail]
+    lo, hi = bootstrap_ci(values, confidence=confidence)
+    return {
+        "median": _median(values),
+        "ci_low": lo,
+        "ci_high": hi,
+        "n": len(values),
+        "unit": str(tail[-1].get("unit", "")),
+        "direction": str(tail[-1].get("direction", "higher")),
+    }
+
+
+def compare(
+    baseline: Sequence[Dict[str, Any]],
+    head: Sequence[Dict[str, Any]],
+    *,
+    threshold: float = 0.10,
+    window: int = 10,
+    confidence: float = 0.99,
+) -> Optional[Dict[str, Any]]:
+    """Noise-aware verdict for one leg: HEAD median vs baseline median.
+
+    A regression needs BOTH (i) relative change worse than ``threshold`` in
+    the leg's bad direction and (ii) the HEAD median outside the bootstrapped
+    CI of the baseline median. Identical data trivially satisfies neither; a
+    2x slowdown satisfies both for any sane threshold. Returns None when
+    either side has no records.
+    """
+    if not baseline or not head:
+        return None
+    stats = baseline_stats(baseline, window=window, confidence=confidence)
+    assert stats is not None
+    head_vals = [float(r["value"]) for r in head]
+    head_median = _median(head_vals)
+    base_median = stats["median"]
+    direction = stats["direction"]
+    if base_median == 0.0:
+        rel = 0.0
+    elif direction == "lower":
+        rel = (head_median - base_median) / abs(base_median)
+    else:
+        rel = (base_median - head_median) / abs(base_median)
+    outside_ci = head_median < stats["ci_low"] or head_median > stats["ci_high"]
+    regressed = rel > threshold and outside_ci
+    improved = rel < -threshold and outside_ci
+    return {
+        "baseline_median": base_median,
+        "baseline_ci": (stats["ci_low"], stats["ci_high"]),
+        "baseline_n": stats["n"],
+        "head_median": head_median,
+        "head_n": len(head_vals),
+        "unit": stats["unit"],
+        "direction": direction,
+        "rel_change_worse": rel,
+        "regressed": regressed,
+        "improved": improved,
+    }

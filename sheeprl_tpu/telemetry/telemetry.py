@@ -61,6 +61,10 @@ class Telemetry:
         flight_capacity: int = 4096,
         flight_spill_interval_s: float = 5.0,
         flight_min_dump_interval_s: float = 30.0,
+        perf_enabled: Optional[bool] = None,
+        perf_probe: bool = True,
+        perf_peak_flops: Optional[float] = None,
+        perf_peak_hbm_gbps: Optional[float] = None,
     ) -> None:
         self.enabled = bool(enabled)
         self.chrome_trace = bool(chrome_trace)
@@ -81,6 +85,15 @@ class Telemetry:
             start_step=profiler_start_step,
             stop_step=profiler_stop_step,
             port=profiler_port,
+        )
+        # Goodput accounting follows `enabled` unless the perf group pins it.
+        from sheeprl_tpu.telemetry.perf import PerfAccountant
+
+        self._perf = PerfAccountant(
+            enabled=self.enabled if perf_enabled is None else bool(perf_enabled),
+            probe=bool(perf_probe),
+            peak_flops=perf_peak_flops,
+            peak_hbm_gbps=perf_peak_hbm_gbps,
         )
         self._step_timers: Dict[str, StepTimer] = {}
         self._log_dir: Optional[str] = None
@@ -110,7 +123,13 @@ class Telemetry:
             return cls(enabled=False)
         prof = tele.get("profiler") or {}
         fl = tele.get("flight") or {}
+        perf = tele.get("perf") or {}
+        perf_enabled = perf.get("enabled")
         return cls(
+            perf_enabled=None if perf_enabled is None else bool(perf_enabled),
+            perf_probe=bool(perf.get("probe", True)),
+            perf_peak_flops=perf.get("peak_flops"),
+            perf_peak_hbm_gbps=perf.get("peak_hbm_gbps"),
             flight_enabled=bool(fl.get("enabled", True)),
             flight_capacity=int(fl.get("capacity", 4096)),
             flight_spill_interval_s=float(fl.get("spill_interval_s", 5.0)),
@@ -159,6 +178,8 @@ class Telemetry:
         if self._jsonl_path() is not None:
             import jax
 
+            from sheeprl_tpu.telemetry import bench_db
+
             self._append_jsonl(
                 {
                     "type": "meta",
@@ -168,6 +189,15 @@ class Telemetry:
                     "profiler_window": [self._profiler.start_step, self._profiler.stop_step],
                     "trace_id": self._trace_root.trace_id if self._trace_root else None,
                     "pid": os.getpid(),
+                    # Provenance stamps: which code on which hardware produced
+                    # this run — the same identity bench history records carry.
+                    # Stamp the PACKAGE checkout, not the run cwd: runs launch
+                    # from throwaway dirs outside the repo.
+                    "git": bench_db.git_stamp(
+                        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+                    ),
+                    "host": bench_db.host_fingerprint(),
+                    "device": getattr(jax.devices()[0], "device_kind", ""),
                 },
                 mode="w",
             )
@@ -274,6 +304,13 @@ class Telemetry:
             self._tracer.count("device_get_bytes", nbytes)
         return out
 
+    @property
+    def perf(self) -> Any:
+        """The run's goodput accountant (a safe no-op when disabled):
+        ``perf.note(key, fn, args)`` before each jit dispatch,
+        ``with perf.infeed():`` around env interaction / data infeed."""
+        return self._perf
+
     def step_timer(self, name: str = "train", timer_key: Optional[str] = None) -> StepTimer:
         st = self._step_timers.get(name)
         if st is None:
@@ -317,6 +354,10 @@ class Telemetry:
         after the fact."""
         if not self.enabled:
             return {}
+        # Publish goodput first: the gauges go through the tracer, so the
+        # counters snapshot below (and hence this interval's JSONL record,
+        # logger export, and /metrics mirror) carries perf/mfu and friends.
+        self._perf.publish(self._step_timers.get("train"), self._tracer)
         counters = self.counters()
         now = time.perf_counter()
         rates = self._interval_rates(counters, now)

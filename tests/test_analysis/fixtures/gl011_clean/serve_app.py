@@ -1,5 +1,8 @@
 """Every read resolves; the one unread YAML key carries a justified
-suppression (`reserved_slot` is kept for parity with an upstream config)."""
+suppression (`reserved_slot` is kept for parity with an upstream config).
+The telemetry block demonstrates the chained-alias idioms the rule
+unwraps: `<chain> if cond else None` and `<chain> or {}` both register the
+alias, so the nested `telemetry.perf.*` leaves are tracked precisely."""
 
 
 def main(cfg):
@@ -7,4 +10,8 @@ def main(cfg):
     tag = cfg.run_name
     lr = cfg.algo.lr
     mom = cfg.algo.get("momentum", 0.9)
-    return total, tag, lr, mom
+    tele = cfg.get("telemetry") if hasattr(cfg, "get") else None
+    perf = tele.get("perf") or {}
+    armed = perf.get("enabled")
+    probing = perf.get("probe", True)
+    return total, tag, lr, mom, armed, probing

@@ -1,7 +1,12 @@
 """Reads against the sibling configs/ tree; one read drifted.
 
 `stale_knob` in configs/config.yaml has no read at all — the dead-key
-direction of GL011 reports it at the YAML line.
+direction of GL011 reports it at the YAML line. The telemetry block below
+exercises the CHAINED alias model (`tele = cfg.telemetry` then
+`perf = tele.get("perf") or {}`): reads through the second-level alias
+resolve to exact leaves, so a drifted key inside the nested group flags
+even though every read is spelled through `.get(...)` fallbacks — the
+pre-chaining model skipped such reads wholesale.
 """
 
 
@@ -13,4 +18,8 @@ def main(cfg):
     decay = cfg.algo.weight_decay  # <- GL011
     every = cfg.checkpoint.every
     keep = cfg.checkpoint.keep_last
-    return tag, steps, lr, mom, decay, every, keep
+    tele = cfg.telemetry
+    perf = tele.get("perf") or {}
+    armed = perf.get("enabled")
+    window = perf.get("harvest_window", 16)  # <- GL011
+    return tag, steps, lr, mom, decay, every, keep, armed, window

@@ -174,3 +174,27 @@ def test_gl011_reports_both_drift_directions():
     findings, _, _ = _lint_dir("gl011_positive")
     by_ext = {os.path.splitext(f.path)[1] for f in findings if f.rule == "GL011"}
     assert by_ext == {".py", ".yaml"}, "expected an unknown read AND a dead YAML key"
+
+
+def test_gl011_chained_alias_resolves_nested_groups():
+    """`perf = tele.get("perf") or {}` after `tele = cfg.telemetry` makes
+    `perf.get("harvest_window")` track `telemetry.perf.harvest_window` —
+    the drifted nested read must flag under its FULL dotted path, and the
+    resolving reads through the same chain must stay silent."""
+    findings, _, _ = _lint_dir("gl011_positive")
+    messages = [f.message for f in findings if f.rule == "GL011"]
+    assert any("telemetry.perf.harvest_window" in m for m in messages)
+    assert not any("telemetry.perf.enabled" in m for m in messages)
+
+
+def test_gl011_knows_the_telemetry_perf_keys():
+    """The live repo's config model carries the performance-observatory
+    group: every `telemetry.perf.*` key the Telemetry facade reads must
+    resolve, so goodput-accounting configs can never silently drift."""
+    import sheeprl_tpu
+    from sheeprl_tpu.analysis.configmodel import ConfigModel
+
+    root = os.path.join(os.path.dirname(sheeprl_tpu.__file__), "configs")
+    model = ConfigModel.load(root)
+    for key in ("enabled", "probe", "peak_flops", "peak_hbm_gbps"):
+        assert model.resolves(f"telemetry.perf.{key}"), key

@@ -1,0 +1,266 @@
+"""Bench history store + regression gate (telemetry/bench_db.py and the
+`python -m sheeprl_tpu.telemetry perf` CLI): record schema, atomic append
+under concurrent writers, noise-aware compare semantics, and the acceptance
+contract — identical re-runs pass the gate, a synthetic 2x slowdown fails it
+with the regressing leg named."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from sheeprl_tpu.telemetry import bench_db
+from sheeprl_tpu.telemetry.__main__ import main as telemetry_main
+
+pytestmark = pytest.mark.telemetry
+
+
+# -------------------------------------------------------------------- records
+class TestRecords:
+    def test_make_record_schema(self):
+        rec = bench_db.make_record(
+            "sac", 320.5, "env-steps/sec", backend="cpu",
+            breakdown={"compute": 0.6, "infeed": 0.3, "host": 0.1},
+            goodput={"mfu": 0.12},
+            extra={"vs_baseline": 1.01},
+        )
+        assert rec["schema"] == bench_db.SCHEMA_VERSION
+        assert rec["leg"] == "sac"
+        assert rec["value"] == pytest.approx(320.5)
+        assert rec["direction"] == "higher"
+        assert set(rec["git"]) == {"sha", "dirty"}
+        # This repo IS a git checkout: the stamp must carry a real sha.
+        assert len(rec["git"]["sha"]) == 40
+        assert rec["host"]["hostname"]
+        assert rec["host"]["cpu_count"] >= 1
+        assert rec["breakdown"]["compute"] == pytest.approx(0.6)
+        assert rec["goodput"]["mfu"] == pytest.approx(0.12)
+        assert json.loads(json.dumps(rec)) == rec  # JSONL-serializable
+
+    def test_direction_inference(self):
+        assert bench_db.unit_direction("env-steps/sec") == "higher"
+        assert bench_db.unit_direction("req/s") == "higher"
+        assert bench_db.unit_direction("seconds") == "lower"
+        assert bench_db.unit_direction("s") == "lower"
+        rec = bench_db.make_record("lint", 5.4, "seconds")
+        assert rec["direction"] == "lower"
+        assert bench_db.make_record("x", 1.0, "s", direction="higher")["direction"] == "higher"
+
+    def test_git_stamp_degrades_outside_a_worktree(self, tmp_path):
+        stamp = bench_db.git_stamp(str(tmp_path))
+        assert stamp["sha"] == "unknown"
+
+    def test_default_history_path_env_override(self, monkeypatch, tmp_path):
+        override = str(tmp_path / "custom.jsonl")
+        monkeypatch.setenv("SHEEPRL_BENCH_HISTORY", override)
+        assert bench_db.default_history_path() == override
+        monkeypatch.delenv("SHEEPRL_BENCH_HISTORY")
+        assert bench_db.default_history_path().endswith(bench_db.HISTORY_FILENAME)
+
+
+# -------------------------------------------------------------------- storage
+class TestAtomicAppend:
+    def test_round_trip(self, tmp_path):
+        path = str(tmp_path / "hist.jsonl")
+        for i in range(3):
+            bench_db.append_record(path, bench_db.make_record("sac", 100.0 + i, "env-steps/sec"))
+        records = bench_db.load_history(path)
+        assert [r["value"] for r in records] == [100.0, 101.0, 102.0]
+
+    def test_concurrent_writers_never_tear_a_line(self, tmp_path):
+        # The satellite contract: run_all_benches legs may append
+        # concurrently; every line must stay parseable and none may be lost.
+        path = str(tmp_path / "hist.jsonl")
+        writers, per_writer = 8, 50
+
+        def worker(wid):
+            for i in range(per_writer):
+                rec = bench_db.make_record(
+                    f"leg{wid}", float(i), "env-steps/sec",
+                    extra={"pad": "x" * 512},  # widen the window for interleaving
+                )
+                bench_db.append_record(path, rec)
+
+        threads = [threading.Thread(target=worker, args=(w,)) for w in range(writers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        raw = open(path).read().splitlines()
+        assert len(raw) == writers * per_writer
+        records = [json.loads(line) for line in raw]  # raises on any torn line
+        for wid in range(writers):
+            mine = [r for r in records if r["leg"] == f"leg{wid}"]
+            assert sorted(r["value"] for r in mine) == [float(i) for i in range(per_writer)]
+
+    def test_concurrent_processes_never_tear_a_line(self, tmp_path):
+        path = str(tmp_path / "hist.jsonl")
+        script = (
+            "import sys; from sheeprl_tpu.telemetry import bench_db\n"
+            "path, wid = sys.argv[1], sys.argv[2]\n"
+            "for i in range(25):\n"
+            "    bench_db.append_record(path, bench_db.make_record(\n"
+            "        f'p{wid}', float(i), 'env-steps/sec', extra={'pad': 'x' * 512}))\n"
+        )
+        procs = [
+            subprocess.Popen([sys.executable, "-c", script, path, str(w)], cwd=os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(bench_db.__file__)))))
+            for w in range(4)
+        ]
+        for p in procs:
+            assert p.wait(timeout=120) == 0
+        raw = open(path).read().splitlines()
+        assert len(raw) == 4 * 25
+        for line in raw:
+            json.loads(line)
+
+    def test_load_skips_torn_and_foreign_lines(self, tmp_path):
+        path = str(tmp_path / "hist.jsonl")
+        bench_db.append_record(path, bench_db.make_record("sac", 1.0, "env-steps/sec"))
+        with open(path, "a") as fh:
+            fh.write("not json at all\n")
+            fh.write('{"no_leg_key": true}\n')
+            fh.write('{"leg": "sac", "value": 2.0')  # torn tail: no newline, no close
+        records = bench_db.load_history(path)
+        assert [r["value"] for r in records] == [1.0]
+        assert bench_db.load_history(str(tmp_path / "missing.jsonl")) == []
+
+
+# ----------------------------------------------------------------- statistics
+def _recs(leg, values, sha="a" * 40, unit="env-steps/sec"):
+    return [
+        {
+            "schema": 1, "leg": leg, "value": float(v), "unit": unit,
+            "direction": bench_db.unit_direction(unit),
+            "git": {"sha": sha, "dirty": False},
+        }
+        for v in values
+    ]
+
+
+class TestCompare:
+    def test_identical_reruns_are_not_a_regression(self):
+        baseline = _recs("sac", [100.0] * 8)
+        head = _recs("sac", [100.0, 100.0], sha="b" * 40)
+        verdict = bench_db.compare(baseline, head)
+        assert verdict is not None
+        assert not verdict["regressed"]
+        assert not verdict["improved"]
+
+    def test_noise_inside_ci_is_not_a_regression(self):
+        baseline = _recs("sac", [98.0, 101.0, 99.5, 100.5, 100.0, 99.0, 101.5, 100.2])
+        head = _recs("sac", [99.0, 100.4], sha="b" * 40)
+        verdict = bench_db.compare(baseline, head)
+        assert not verdict["regressed"]
+
+    def test_two_x_slowdown_is_a_regression(self):
+        baseline = _recs("sac", [98.0, 101.0, 99.5, 100.5, 100.0, 99.0, 101.5, 100.2])
+        head = _recs("sac", [50.0, 49.5], sha="b" * 40)
+        verdict = bench_db.compare(baseline, head)
+        assert verdict["regressed"]
+        assert verdict["rel_change_worse"] == pytest.approx(0.5, abs=0.02)
+
+    def test_direction_flips_for_lower_better_units(self):
+        baseline = _recs("lint", [5.0] * 6, unit="seconds")
+        slower = bench_db.compare(baseline, _recs("lint", [10.0], sha="b" * 40, unit="seconds"))
+        faster = bench_db.compare(baseline, _recs("lint", [2.5], sha="b" * 40, unit="seconds"))
+        assert slower["regressed"] and not slower["improved"]
+        assert faster["improved"] and not faster["regressed"]
+
+    def test_bootstrap_is_deterministic(self):
+        values = [98.0, 101.0, 99.5, 100.5, 100.0, 103.0, 95.5, 100.2]
+        assert bench_db.bootstrap_ci(values) == bench_db.bootstrap_ci(values)
+        lo, hi = bench_db.bootstrap_ci(values)
+        assert lo <= bench_db.baseline_stats(_recs("x", values))["median"] <= hi
+
+    def test_empty_sides_return_none(self):
+        assert bench_db.compare([], _recs("x", [1.0])) is None
+        assert bench_db.compare(_recs("x", [1.0]), []) is None
+
+
+# ----------------------------------------------------------------------- CLI
+def _write_history(path, *groups):
+    for leg, values, sha in groups:
+        for rec in _recs(leg, values, sha=sha):
+            bench_db.append_record(path, rec)
+
+
+class TestPerfCli:
+    """Acceptance: `perf --check` passes on two identical re-runs of a leg,
+    fails (naming the leg) on a synthetic 2x slowdown."""
+
+    def test_check_passes_on_identical_reruns(self, tmp_path, capsys):
+        path = str(tmp_path / "hist.jsonl")
+        _write_history(path, ("sac", [100.0] * 6, "a" * 40), ("sac", [100.0, 100.0], "b" * 40))
+        rc = telemetry_main(["perf", path, "--check"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "no regressions" in out
+        assert "sac" in out
+
+    def test_check_fails_and_names_the_regressing_leg(self, tmp_path, capsys):
+        path = str(tmp_path / "hist.jsonl")
+        _write_history(
+            path,
+            ("sac", [100.0] * 6, "a" * 40),
+            ("ppo", [200.0] * 6, "a" * 40),
+            ("sac", [50.0, 50.0], "b" * 40),  # synthetic 2x slowdown at HEAD
+            ("ppo", [200.0, 200.0], "b" * 40),
+        )
+        rc = telemetry_main(["perf", path, "--check"])
+        assert rc == 1
+        captured = capsys.readouterr()
+        assert "sac" in captured.err
+        assert "regression in 1 leg(s)" in captured.err
+        assert "REGRESSED" in captured.out
+        assert "ppo" not in captured.err
+
+    def test_warn_only_downgrades_to_exit_zero(self, tmp_path, capsys):
+        path = str(tmp_path / "hist.jsonl")
+        _write_history(path, ("sac", [100.0] * 6, "a" * 40), ("sac", [50.0], "b" * 40))
+        rc = telemetry_main(["perf", path, "--check", "--warn-only"])
+        assert rc == 0
+        assert "WARNING" in capsys.readouterr().out
+
+    def test_head_runs_override_splits_by_count(self, tmp_path, capsys):
+        # One sha throughout (e.g. repeated local runs): --head-runs forces
+        # the split where the newest-sha heuristic would see one group.
+        path = str(tmp_path / "hist.jsonl")
+        _write_history(path, ("sac", [100.0] * 6 + [50.0, 50.0], "a" * 40))
+        assert telemetry_main(["perf", path, "--check", "--head-runs", "2"]) == 1
+        assert "sac" in capsys.readouterr().err
+
+    def test_leg_filter_restricts_the_gate(self, tmp_path, capsys):
+        path = str(tmp_path / "hist.jsonl")
+        _write_history(
+            path,
+            ("sac", [100.0] * 6, "a" * 40),
+            ("sac", [50.0], "b" * 40),
+            ("ppo", [200.0] * 6, "a" * 40),
+            ("ppo", [200.0], "b" * 40),
+        )
+        assert telemetry_main(["perf", path, "--check", "--leg", "ppo"]) == 0
+        capsys.readouterr()
+        assert telemetry_main(["perf", path, "--check", "--leg", "sac"]) == 1
+        capsys.readouterr()
+
+    def test_missing_history_fails_closed_under_check(self, tmp_path, capsys):
+        path = str(tmp_path / "nope.jsonl")
+        assert telemetry_main(["perf", path, "--check"]) == 1
+        assert telemetry_main(["perf", path, "--check", "--warn-only"]) == 0
+        assert telemetry_main(["perf", path]) == 0
+        capsys.readouterr()
+
+    def test_cli_subprocess_contract(self, tmp_path):
+        # The real CI invocation: a subprocess, no jax import required.
+        path = str(tmp_path / "hist.jsonl")
+        _write_history(path, ("sac", [100.0] * 6, "a" * 40), ("sac", [100.0], "b" * 40))
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(bench_db.__file__))))
+        proc = subprocess.run(
+            [sys.executable, "-m", "sheeprl_tpu.telemetry", "perf", path, "--check"],
+            capture_output=True, text=True, timeout=120, cwd=repo,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "no regressions" in proc.stdout

@@ -81,6 +81,24 @@ def test_percentile_clamped_to_observed_range():
     assert h.percentile(100.0) == pytest.approx(0.005)
 
 
+def test_all_samples_in_one_bucket_interpolate_within_it():
+    # Percentile edge: when EVERY sample lands in a single bucket, the
+    # interpolated quantiles must stay inside the observed [min, max] of that
+    # bucket — never a neighboring bucket edge, never outside the data.
+    h = Histogram()
+    samples = [0.00100, 0.00101, 0.00102, 0.00103]  # one sqrt2 bucket wide
+    for v in samples:
+        h.record(v)
+    for q in (0.0, 25.0, 50.0, 95.0, 99.0, 100.0):
+        est = h.percentile(q)
+        assert min(samples) <= est <= max(samples), (q, est)
+    assert h.percentile(0.0) == pytest.approx(min(samples))
+    assert h.percentile(100.0) == pytest.approx(max(samples))
+    # Monotone in q even inside one bucket.
+    qs = [h.percentile(q) for q in (10.0, 30.0, 50.0, 70.0, 90.0)]
+    assert qs == sorted(qs)
+
+
 def test_overflow_bucket_reports_observed_max():
     h = Histogram(bounds=[0.001, 0.01])
     h.record(5.0)   # far past the last bound
