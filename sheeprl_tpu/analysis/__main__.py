@@ -70,6 +70,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="comma-separated rule IDs to run (default: all)",
     )
     parser.add_argument("--list-rules", action="store_true", help="print the rule table and exit")
+    parser.add_argument(
+        "--explain",
+        metavar="GLnnn",
+        default=None,
+        help="print one rule's full card (what it catches, the hazard shape, "
+        "how to suppress) and exit",
+    )
     return parser
 
 
@@ -79,6 +86,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.list_rules:
         for rule in all_rules():
             print(f"{rule.id}  {rule.name}: {rule.rationale}")
+        return 0
+
+    if args.explain:
+        wanted = args.explain.strip().upper()
+        by_id = {r.id: r for r in all_rules()}
+        if wanted not in by_id:
+            known = ", ".join(sorted(by_id))
+            print(f"graftlint: unknown rule {args.explain!r} (known: {known})", file=sys.stderr)
+            return 2
+        print(by_id[wanted].explain())
         return 0
 
     if args.json and args.format not in (None, "json"):

@@ -33,6 +33,7 @@ _TRACING_CALLABLES = {
 
 _JIT_PATHS = {"jax.jit", "jax.pmap"}
 _PARTIAL_PATHS = {"functools.partial"}
+_MISS = object()  # memo sentinel: None is a valid cached resolution
 
 
 class ImportResolver(ast.NodeVisitor):
@@ -40,6 +41,11 @@ class ImportResolver(ast.NodeVisitor):
 
     def __init__(self) -> None:
         self.aliases: Dict[str, str] = {}
+        # id(node) -> dotted path. Every rule resolves the same Name/Attribute
+        # chains; the memo keeps the 18-rule scan inside the CI time budget.
+        # Safe because aliases are fixed before any resolve() call and the
+        # tree outlives the context (id() keys stay unique).
+        self._memo: Dict[int, Optional[str]] = {}
 
     def visit_Import(self, node: ast.Import) -> None:
         for alias in node.names:
@@ -58,15 +64,23 @@ class ImportResolver(ast.NodeVisitor):
 
     def resolve(self, node: ast.AST) -> Optional[str]:
         """Dotted path for a Name/Attribute chain, or None if unresolvable."""
+        key = id(node)
+        hit = self._memo.get(key, _MISS)
+        if hit is not _MISS:
+            return hit
         parts: List[str] = []
-        while isinstance(node, ast.Attribute):
-            parts.append(node.attr)
-            node = node.value
-        if not isinstance(node, ast.Name):
+        probe = node
+        while isinstance(probe, ast.Attribute):
+            parts.append(probe.attr)
+            probe = probe.value
+        if not isinstance(probe, ast.Name):
+            self._memo[key] = None
             return None
-        base = self.aliases.get(node.id, node.id)
+        base = self.aliases.get(probe.id, probe.id)
         parts.append(base)
-        return ".".join(reversed(parts))
+        path = ".".join(reversed(parts))
+        self._memo[key] = path
+        return path
 
 
 @dataclass
@@ -78,6 +92,10 @@ class JitFunction:
     static_argnames: Set[str] = field(default_factory=set)
     static_argnums: Tuple[int, ...] = ()
     donate_argnums: Tuple[int, ...] = ()
+    # Raw AST of the jit call's `in_shardings=` keyword (None when absent).
+    # Consumed by the mesh model (GL018); kept as AST because PartitionSpec
+    # resolution needs the project-wide constant table, not just this file.
+    in_shardings: Optional[ast.AST] = None
 
     @property
     def name(self) -> str:
@@ -145,6 +163,7 @@ def parse_jit_call(call: ast.Call, resolver: ImportResolver) -> Optional[JitFunc
     meta.static_argnums = _const_ints(keywords.get("static_argnums"))
     meta.static_argnames = _const_strs(keywords.get("static_argnames"))
     meta.donate_argnums = _const_ints(keywords.get("donate_argnums"))
+    meta.in_shardings = keywords.get("in_shardings")
     return meta
 
 

@@ -1,0 +1,667 @@
+"""Static model of the project's device meshes and named axes — the
+sharding-aware sibling of :mod:`sheeprl_tpu.analysis.configmodel`.
+
+The Sebulba scale-out pushes `pjit`/`shard_map`/collectives across many
+modules, and sharding bugs are exactly the class that compiles fine on one
+CPU device and deadlocks — or silently resharding-thrashes — on an 8-chip
+mesh. What makes them statically catchable is that the whole discipline
+hangs off *names*: mesh axes are declared once (``Mesh(devs, ("data",
+"model"))``), referenced everywhere (``P(DATA_AXIS)``, ``lax.psum(x,
+"data")``), and nothing in Python ties the reference to the declaration.
+This module builds that tie:
+
+* **axis declarations** — every ``Mesh(...)``/``jax.make_mesh(...)`` literal
+  in the scanned program contributes its axis-name tuple, with string
+  constants resolved through module-level assignments (``DATA_AXIS =
+  "data"`` in ``core/mesh.py``) across imports, so ``Mesh(arr, (DATA_AXIS,
+  MODEL_AXIS))`` declares ``{"data", "model"}`` project-wide;
+* **axis token resolution** — an expression resolves to an axis *name* when
+  it is a string literal or a (possibly imported) module-level string
+  constant. A function parameter or computed value resolves to
+  :data:`DYNAMIC`: the rules deliberately stay silent on dynamic axes
+  (``ring_attention(..., axis_name=...)`` is checked at its call sites, not
+  inside the generic body);
+* **PartitionSpec parsing** — ``P(...)``/``PartitionSpec(...)`` calls (and
+  ``NamedSharding(mesh, P(...))`` wrappers) become tuples of
+  ``None | str | tuple[str, ...] | DYNAMIC`` entries that GL014/GL017/GL018
+  compare structurally;
+* **collective classification** — which ``jax.lax.*`` calls are collectives
+  and where their ``axis_name`` argument lives;
+* **binding sites** — ``shard_map``/``pmap``/``vmap(axis_name=...)`` call
+  sites with their resolved body symbol, the substrate for GL015's
+  "is this collective's axis bound on the jit-closure path" query.
+
+One :class:`MeshModel` is built per scan and cached on
+``AnalysisContext.caches["meshmodel"]``.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Set, Tuple, Union
+
+from sheeprl_tpu.analysis.project import AnalysisContext, ModuleInfo, Symbol
+
+
+class _Dynamic:
+    """Sentinel: an axis/spec entry that is real but not statically known."""
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return "DYNAMIC"
+
+
+DYNAMIC = _Dynamic()
+
+SpecEntry = Union[None, str, Tuple[str, ...], _Dynamic]
+Spec = Tuple[SpecEntry, ...]
+
+# Call paths that construct a mesh with an axis-name tuple.
+_MESH_CTOR_PATHS = {
+    "jax.sharding.Mesh",
+    "jax.experimental.mesh_utils.Mesh",  # defensive: not a real home, cheap
+    "jax.make_mesh",
+    "jax.experimental.mesh_utils.create_device_mesh",  # names come via kwarg
+}
+# PartitionSpec spellings (the repo imports `PartitionSpec as P`).
+_SPEC_PATHS = {"jax.sharding.PartitionSpec", "jax.experimental.pjit.PartitionSpec"}
+_NAMED_SHARDING_PATHS = {"jax.sharding.NamedSharding"}
+
+# shard_map's homes across the pinned jax range (GL003 documents the churn).
+_SHARD_MAP_PATHS = {
+    "jax.experimental.shard_map.shard_map",
+    "jax.shard_map",
+    "shard_map",
+}
+
+# collective dotted path -> index of the positional axis-name argument.
+COLLECTIVE_AXIS_ARG = {
+    "jax.lax.psum": 1,
+    "jax.lax.pmean": 1,
+    "jax.lax.pmax": 1,
+    "jax.lax.pmin": 1,
+    "jax.lax.psum_scatter": 1,
+    "jax.lax.all_gather": 1,
+    "jax.lax.all_to_all": 1,
+    "jax.lax.ppermute": 1,
+    "jax.lax.pshuffle": 1,
+    "jax.lax.axis_index": 0,
+    "jax.lax.axis_size": 0,
+}
+# Collectives that REDUCE/combine over the axis (vs merely query it): the
+# GL015 dual ("bound but never reduced over") only counts these.
+REDUCING_COLLECTIVES = {
+    "jax.lax.psum",
+    "jax.lax.pmean",
+    "jax.lax.pmax",
+    "jax.lax.pmin",
+    "jax.lax.psum_scatter",
+    "jax.lax.all_gather",
+    "jax.lax.all_to_all",
+    "jax.lax.ppermute",
+    "jax.lax.pshuffle",
+}
+
+_PARTIAL_PATHS = {"functools.partial"}
+
+
+@dataclass(frozen=True)
+class AxisDecl:
+    """One axis name contributed by one mesh-construction site."""
+
+    name: str
+    path: str  # module display path
+    line: int
+
+
+@dataclass
+class BindingSite:
+    """A shard_map/pmap/vmap call that binds axis names over a body."""
+
+    kind: str  # "shard_map" | "pmap" | "vmap"
+    call: ast.Call
+    info: ModuleInfo
+    axes: Set[str] = field(default_factory=set)  # statically-known bound axes
+    dynamic: bool = False  # True when some bound axis is not resolvable
+    body: Optional[Symbol] = None  # resolved body symbol, if any
+    partial_kwargs: Set[str] = field(default_factory=set)  # names bound by partial
+    in_specs: Optional[List[Optional[Spec]]] = None  # shard_map only
+
+
+class MeshModel:
+    """Project-wide mesh/axis view. Build once per scan via :func:`mesh_model`."""
+
+    def __init__(self, actx: AnalysisContext) -> None:
+        self.actx = actx
+        # (module name, const name) -> string value, for cross-module axis
+        # constants; tuples of strings land in _tuple_consts.
+        self._str_consts: Dict[Tuple[str, str], str] = {}
+        self._tuple_consts: Dict[Tuple[str, str], Tuple[str, ...]] = {}
+        self.declarations: List[AxisDecl] = []
+        # id(Call) -> dotted path. Rules resolve the same calls over and over;
+        # one shared memo keeps the 18-rule scan inside the CI time budget.
+        self._call_paths: Dict[int, Optional[str]] = {}
+        # Per-module rosters filled by the single binding_sites() walk, so
+        # GL014 never needs its own project-wide ast.walk.
+        self._spec_calls: Dict[str, List[ast.Call]] = {}
+        self._collective_calls: Dict[str, List[Tuple[ast.Call, str]]] = {}
+        self._bound_axes: Optional[Dict[object, Tuple[Set[str], bool]]] = None
+        self._collective_axes: Optional[Dict[object, Tuple[Set[str], bool]]] = None
+        # One project-wide walk feeds everything below (_scan).
+        self._scanned = False
+        self._transform_calls: List[Tuple[ast.Call, str, ModuleInfo]] = []
+        self._collect_constants()
+        self._bindings: Optional[List[BindingSite]] = None
+
+    # ------------------------------------------------------------ resolution
+    def call_path(self, call: ast.Call, info: ModuleInfo) -> Optional[str]:
+        """Memoized ``resolver.resolve(call.func)`` (trees outlive the scan,
+        so id() keys are stable)."""
+        key = id(call)
+        if key not in self._call_paths:
+            self._call_paths[key] = info.ctx.resolver.resolve(call.func)
+        return self._call_paths[key]
+
+    # ------------------------------------------------------------- constants
+    def _collect_constants(self) -> None:
+        for info in self.actx.modules:
+            for stmt in info.ctx.tree.body:
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                value = stmt.value
+                names = [t.id for t in stmt.targets if isinstance(t, ast.Name)]
+                if not names:
+                    continue
+                if isinstance(value, ast.Constant) and isinstance(value.value, str):
+                    for name in names:
+                        self._str_consts[(info.name, name)] = value.value
+        # Tuples may reference the string constants, so resolve them second.
+        for info in self.actx.modules:
+            for stmt in info.ctx.tree.body:
+                if not isinstance(stmt, ast.Assign) or not isinstance(
+                    stmt.value, (ast.Tuple, ast.List)
+                ):
+                    continue
+                names = [t.id for t in stmt.targets if isinstance(t, ast.Name)]
+                if not names:
+                    continue
+                elts = [self.resolve_axis_token(e, info) for e in stmt.value.elts]
+                if all(isinstance(e, str) for e in elts):
+                    for name in names:
+                        self._tuple_consts[(info.name, name)] = tuple(elts)  # type: ignore[arg-type]
+
+    def _lookup_dotted(self, dotted: str) -> Optional[str]:
+        """``pkg.mod.CONST`` -> its string value, if scanned."""
+        if "." not in dotted:
+            return None
+        module, attr = dotted.rsplit(".", 1)
+        if module in self.actx.by_name:
+            return self._str_consts.get((module, attr))
+        return None
+
+    def resolve_axis_token(self, node: ast.AST, info: ModuleInfo):
+        """Resolve one expression to an axis name.
+
+        Returns the string, ``None`` for a literal ``None``, or
+        :data:`DYNAMIC` when the value exists but is not statically known
+        (parameters, attribute reads on objects, arithmetic, ...).
+        """
+        if isinstance(node, ast.Constant):
+            if node.value is None:
+                return None
+            if isinstance(node.value, str):
+                return node.value
+            return DYNAMIC
+        if isinstance(node, ast.Name):
+            direct = self._str_consts.get((info.name, node.id))
+            if direct is not None:
+                return direct
+            dotted = info.ctx.resolver.aliases.get(node.id)
+            if dotted:
+                via_import = self._lookup_dotted(dotted)
+                if via_import is not None:
+                    return via_import
+            return DYNAMIC
+        if isinstance(node, ast.Attribute):
+            dotted = info.ctx.resolver.resolve(node)
+            if dotted:
+                via_import = self._lookup_dotted(dotted)
+                if via_import is not None:
+                    return via_import
+            return DYNAMIC
+        return DYNAMIC
+
+    def resolve_axis_tuple(self, node: ast.AST, info: ModuleInfo):
+        """Resolve a tuple/list of axis names (mesh ``axis_names`` argument).
+
+        Returns a tuple of strings, or ``None`` when any element is not
+        statically resolvable."""
+        if isinstance(node, ast.Name):
+            direct = self._tuple_consts.get((info.name, node.id))
+            if direct is not None:
+                return direct
+            single = self.resolve_axis_token(node, info)
+            return (single,) if isinstance(single, str) else None
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return (node.value,)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out: List[str] = []
+            for elt in node.elts:
+                token = self.resolve_axis_token(elt, info)
+                if not isinstance(token, str):
+                    return None
+                out.append(token)
+            return tuple(out)
+        return None
+
+    # ----------------------------------------------------------------- scan
+    def _scan(self) -> None:
+        """ONE ast.walk over every module, bucketing every relevant call:
+        mesh constructors (-> declarations), spec calls, collectives, and
+        transform sites. Everything downstream reads the buckets — the
+        18-rule pack must not multiply whole-project walks."""
+        if self._scanned:
+            return
+        self._scanned = True
+        for info in self.actx.modules:
+            specs = self._spec_calls.setdefault(info.name, [])
+            collectives = self._collective_calls.setdefault(info.name, [])
+            for node in ast.walk(info.ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                path = self.call_path(node, info)
+                if path is None:
+                    continue
+                if path in _SPEC_PATHS:
+                    specs.append(node)
+                elif path in COLLECTIVE_AXIS_ARG:
+                    collectives.append((node, path))
+                elif path in _MESH_CTOR_PATHS:
+                    self._add_mesh_declaration(node, info)
+                elif (
+                    path in _SHARD_MAP_PATHS
+                    or path.endswith(".shard_map")
+                    or path in ("jax.pmap", "jax.vmap")
+                ):
+                    self._transform_calls.append((node, path, info))
+
+    def _add_mesh_declaration(self, node: ast.Call, info: ModuleInfo) -> None:
+        names_node: Optional[ast.AST] = None
+        for kw in node.keywords:
+            if kw.arg in ("axis_names", "axis_name"):
+                names_node = kw.value
+        if names_node is None and len(node.args) >= 2:
+            names_node = node.args[1]
+        if names_node is None:
+            return
+        axes = self.resolve_axis_tuple(names_node, info)
+        if not axes:
+            return
+        for axis in axes:
+            self.declarations.append(
+                AxisDecl(name=axis, path=info.path, line=node.lineno)
+            )
+
+    def declared_axes(self) -> Set[str]:
+        self._scan()
+        return {d.name for d in self.declarations}
+
+    # ------------------------------------------------------------------ specs
+    def is_spec_call(self, call: ast.Call, info: ModuleInfo) -> bool:
+        return self.call_path(call, info) in _SPEC_PATHS
+
+    def spec_calls(self, info: ModuleInfo) -> List[ast.Call]:
+        """Every P()/PartitionSpec() call in the module (from the shared
+        project walk)."""
+        self._scan()
+        return self._spec_calls.get(info.name, [])
+
+    def collective_calls(self, info: ModuleInfo) -> List[Tuple[ast.Call, str]]:
+        """Every (collective call, dotted path) in the module."""
+        self._scan()
+        return self._collective_calls.get(info.name, [])
+
+    def parse_spec(self, node: ast.AST, info: ModuleInfo) -> Optional[Spec]:
+        """``P(...)``/``PartitionSpec(...)``/``NamedSharding(mesh, P(...))``
+        (directly or through a local/module-level alias) -> entry tuple, or
+        None when `node` is not a spec construction."""
+        node = self._deref_spec_alias(node, info)
+        if not isinstance(node, ast.Call):
+            return None
+        path = info.ctx.resolver.resolve(node.func)
+        if path in _NAMED_SHARDING_PATHS:
+            if len(node.args) >= 2:
+                return self.parse_spec(node.args[1], info)
+            for kw in node.keywords:
+                if kw.arg == "spec":
+                    return self.parse_spec(kw.value, info)
+            return None
+        if path not in _SPEC_PATHS:
+            return None
+        entries: List[SpecEntry] = []
+        for arg in node.args:
+            if isinstance(arg, (ast.Tuple, ast.List)):
+                multi = self.resolve_axis_tuple(arg, info)
+                entries.append(multi if multi is not None else DYNAMIC)
+                continue
+            entries.append(self.resolve_axis_token(arg, info))
+        return tuple(entries)
+
+    def _deref_spec_alias(self, node: ast.AST, info: ModuleInfo) -> ast.AST:
+        """Follow ``name = NamedSharding(...)`` / ``name = P(...)`` chains one
+        hop through module-level and enclosing-scope assignments."""
+        if not isinstance(node, ast.Name):
+            return node
+        for stmt in ast.walk(info.ctx.tree):
+            if not isinstance(stmt, ast.Assign) or not isinstance(stmt.value, ast.Call):
+                continue
+            if any(isinstance(t, ast.Name) and t.id == node.id for t in stmt.targets):
+                path = info.ctx.resolver.resolve(stmt.value.func)
+                if path in _SPEC_PATHS | _NAMED_SHARDING_PATHS:
+                    return stmt.value
+        return node
+
+    # ------------------------------------------------------------ collectives
+    def collective_axis(self, call: ast.Call, info: ModuleInfo):
+        """(dotted path, resolved axis token) when `call` is a collective,
+        else None. The token is a str, DYNAMIC, or None (malformed call)."""
+        path = self.call_path(call, info)
+        if path not in COLLECTIVE_AXIS_ARG:
+            return None
+        axis_node: Optional[ast.AST] = None
+        for kw in call.keywords:
+            if kw.arg in ("axis_name", "axis"):
+                axis_node = kw.value
+        if axis_node is None:
+            idx = COLLECTIVE_AXIS_ARG[path]
+            if idx < len(call.args):
+                axis_node = call.args[idx]
+        if axis_node is None:
+            return (path, None)
+        token = self.resolve_axis_token(axis_node, info)
+        if token is None:
+            token = DYNAMIC  # a literal None axis is jax's business, not ours
+        return (path, token)
+
+    # --------------------------------------------------------------- bindings
+    def _resolve_body(
+        self, arg: ast.AST, info: ModuleInfo
+    ) -> Tuple[Optional[Symbol], Set[str]]:
+        """Resolve a transform's function argument to its Symbol. Unwraps
+        ``functools.partial(fn, ...)`` and returns the keyword names the
+        partial binds (they no longer consume positional in_specs slots)."""
+        partial_kwargs: Set[str] = set()
+        if isinstance(arg, ast.Call):
+            path = info.ctx.resolver.resolve(arg.func)
+            if path in _PARTIAL_PATHS and arg.args:
+                partial_kwargs = {kw.arg for kw in arg.keywords if kw.arg}
+                arg = arg.args[0]
+            else:
+                return None, partial_kwargs
+        if isinstance(arg, ast.Name):
+            qual = info.top_level.get(arg.id)
+            if qual is not None:
+                return info.symbols.get(qual), partial_kwargs
+            # nested def in any scanned scope of this module
+            for sym in info.symbols.values():
+                if sym.key.qualname.endswith(f"<locals>.{arg.id}"):
+                    return sym, partial_kwargs
+            dotted = info.ctx.resolver.aliases.get(arg.id)
+            if dotted:
+                return self.actx.resolve_path(dotted), partial_kwargs
+            return None, partial_kwargs
+        if isinstance(arg, ast.Attribute):
+            dotted = info.ctx.resolver.resolve(arg)
+            if dotted:
+                return self.actx.resolve_path(dotted), partial_kwargs
+        return None, partial_kwargs
+
+    def binding_sites(self) -> List[BindingSite]:
+        """Every shard_map/pmap/vmap call that binds one or more axis names."""
+        if self._bindings is not None:
+            return self._bindings
+        out: List[BindingSite] = []
+        declared = self.declared_axes()  # triggers _scan()
+        for node, path, info in self._transform_calls:
+            site: Optional[BindingSite] = None
+            if path in _SHARD_MAP_PATHS or path.endswith(".shard_map"):
+                site = self._shard_map_site(node, info, declared)
+            else:
+                kind = "pmap" if path.endswith("pmap") else "vmap"
+                site = self._axis_name_site(node, info, kind)
+            if site is not None:
+                body, partial_kwargs = (None, set())
+                if node.args:
+                    body, partial_kwargs = self._resolve_body(node.args[0], info)
+                site.body = body
+                site.partial_kwargs = partial_kwargs
+                out.append(site)
+        self._bindings = out
+        return out
+
+    def _shard_map_site(
+        self, node: ast.Call, info: ModuleInfo, declared: Set[str]
+    ) -> BindingSite:
+        """shard_map binds every axis of its mesh. The mesh argument is a
+        runtime object, so the static approximation is: the axes named in the
+        site's in/out specs, plus every project-declared mesh axis (a
+        shard_map over *some* declared mesh binds them; the per-axis
+        refinement belongs to GL014's unknown-axis check, not here)."""
+        site = BindingSite(kind="shard_map", call=node, info=info)
+        site.axes |= declared
+        specs: List[Optional[Spec]] = []
+        for kw in node.keywords:
+            if kw.arg not in ("in_specs", "out_specs"):
+                continue
+            spec_nodes: List[ast.AST]
+            if isinstance(kw.value, (ast.Tuple, ast.List)):
+                spec_nodes = list(kw.value.elts)
+            else:
+                spec_nodes = [kw.value]
+            parsed = [self.parse_spec(sn, info) for sn in spec_nodes]
+            if kw.arg == "in_specs":
+                specs = parsed
+            for spec in parsed:
+                if spec is None:
+                    site.dynamic = True
+                    continue
+                for entry in spec:
+                    if isinstance(entry, str):
+                        site.axes.add(entry)
+                    elif isinstance(entry, tuple):
+                        site.axes.update(entry)
+                    elif entry is DYNAMIC:
+                        site.dynamic = True
+        site.in_specs = specs
+        return site
+
+    def _axis_name_site(
+        self, node: ast.Call, info: ModuleInfo, kind: str
+    ) -> Optional[BindingSite]:
+        axis_node = None
+        for kw in node.keywords:
+            if kw.arg == "axis_name":
+                axis_node = kw.value
+        if axis_node is None:
+            if kind == "vmap":
+                return None  # a plain vmap binds nothing
+            # pmap's default axis name is implementation-private; treat the
+            # site as a dynamic binder so GL015 stays quiet under it.
+            site = BindingSite(kind=kind, call=node, info=info, dynamic=True)
+            return site
+        site = BindingSite(kind=kind, call=node, info=info)
+        token = self.resolve_axis_token(axis_node, info)
+        if isinstance(token, str):
+            site.axes.add(token)
+        else:
+            site.dynamic = True
+        return site
+
+    # ------------------------------------------------------- closure helpers
+    def bound_axes_by_symbol(self) -> Dict[object, Tuple[Set[str], bool]]:
+        """SymbolKey -> (axes bound on some path to this function, any-dynamic
+        flag). Propagated from binding sites through call edges AND lexical
+        nesting (a nested def traces with its enclosing body)."""
+        if self._bound_axes is not None:
+            return self._bound_axes
+        bound: Dict[object, Tuple[Set[str], bool]] = {}
+
+        def absorb(key, axes: Set[str], dynamic: bool) -> bool:
+            cur_axes, cur_dyn = bound.get(key, (set(), False))
+            new_axes, new_dyn = cur_axes | axes, cur_dyn or dynamic
+            if new_axes != cur_axes or new_dyn != cur_dyn:
+                bound[key] = (new_axes, new_dyn)
+                return True
+            return False
+
+        frontier: List[object] = []
+        for site in self.binding_sites():
+            if site.body is not None:
+                if absorb(site.body.key, site.axes, site.dynamic):
+                    frontier.append(site.body.key)
+        edges = self.actx.call_edges()
+        # Lexical nesting: qualname prefix relation within a module.
+        nested: Dict[object, List[object]] = {}
+        for info in self.actx.modules:
+            for sym in info.symbols.values():
+                if ".<locals>." in sym.key.qualname:
+                    outer_q = sym.key.qualname.rsplit(".<locals>.", 1)[0]
+                    outer = info.symbols.get(outer_q)
+                    if outer is not None:
+                        nested.setdefault(outer.key, []).append(sym.key)
+        while frontier:
+            current = frontier.pop()
+            axes, dynamic = bound[current]
+            targets = [callee for callee, _ in edges.get(current, ())]
+            targets.extend(nested.get(current, ()))
+            for key in targets:
+                if absorb(key, axes, dynamic):
+                    frontier.append(key)
+        self._bound_axes = bound
+        return bound
+
+    def collective_axes_by_symbol(self) -> Dict[object, Tuple[Set[str], bool]]:
+        """SymbolKey -> (axes this function transitively reduces over, any-
+        dynamic-collective flag). The reverse closure of
+        :meth:`bound_axes_by_symbol`, used by GL015's dual and GL016.
+
+        The direct pass reads the scanned collective roster and attributes
+        each call to its innermost enclosing function — no re-walk of every
+        function scope."""
+        if self._collective_axes is not None:
+            return self._collective_axes
+        direct: Dict[object, Tuple[Set[str], bool]] = {}
+        self._sym_collectives: Dict[object, List[Tuple[ast.Call, str, object]]] = {}
+        for info in self.actx.modules:
+            for node, path in self.collective_calls(info):
+                sym = self.enclosing_symbol(node, info)
+                if sym is None:
+                    continue  # module-level collective: no symbol to charge
+                hit = self.collective_axis(node, info)
+                if hit is None:
+                    continue
+                _, token = hit
+                self._sym_collectives.setdefault(sym.key, []).append((node, path, token))
+                if path not in REDUCING_COLLECTIVES:
+                    continue
+                axes, dynamic = direct.get(sym.key, (set(), False))
+                if isinstance(token, str):
+                    axes.add(token)
+                else:
+                    dynamic = True
+                direct[sym.key] = (axes, dynamic)
+        # Propagate callee axes up to callers to a fixed point.
+        edges = self.actx.call_edges()
+        changed = True
+        closure = {k: (set(v[0]), v[1]) for k, v in direct.items()}
+        while changed:
+            changed = False
+            for caller, callees in edges.items():
+                cur_axes, cur_dyn = closure.get(caller, (set(), False))
+                new_axes, new_dyn = set(cur_axes), cur_dyn
+                for callee, _ in callees:
+                    axes, dyn = closure.get(callee, (set(), False))
+                    new_axes |= axes
+                    new_dyn = new_dyn or dyn
+                if new_axes != cur_axes or new_dyn != cur_dyn:
+                    closure[caller] = (new_axes, new_dyn)
+                    changed = True
+        self._collective_axes = closure
+        return closure
+
+    def enclosing_symbol(self, node: ast.AST, info: ModuleInfo) -> Optional[Symbol]:
+        """Innermost function symbol of `info` whose span contains `node`."""
+        best: Optional[Symbol] = None
+        best_start = -1
+        lineno = getattr(node, "lineno", None)
+        if lineno is None:
+            return None
+        for sym in info.symbols.values():
+            start = getattr(sym.node, "lineno", None)
+            end = getattr(sym.node, "end_lineno", None)
+            if start is None or end is None or not start <= lineno <= end:
+                continue
+            if start > best_start:
+                best, best_start = sym, start
+        return best
+
+    def symbol_collectives(self, key) -> List[Tuple[ast.Call, str, object]]:
+        """(call, path, token) collective hits inside one function — the
+        per-call view of the closure's direct pass, recorded so GL015 does
+        not re-walk every function scope."""
+        self.collective_axes_by_symbol()
+        return self._sym_collectives.get(key, [])
+
+
+def iter_scope_calls(info: ModuleInfo, sym_node: ast.AST) -> Iterator[ast.Call]:
+    from sheeprl_tpu.analysis.dataflow import walk_scope
+
+    for node in walk_scope(sym_node):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def normalize_spec(spec: Spec) -> Spec:
+    """Strip trailing Nones: ``P("data")`` and ``P("data", None)`` shard
+    identically."""
+    out = list(spec)
+    while out and out[-1] is None:
+        out.pop()
+    return tuple(out)
+
+
+def spec_is_static(spec: Optional[Spec]) -> bool:
+    return spec is not None and all(e is not DYNAMIC for e in spec)
+
+
+def spec_axes(spec: Optional[Spec]) -> Set[str]:
+    axes: Set[str] = set()
+    for entry in spec or ():
+        if isinstance(entry, str):
+            axes.add(entry)
+        elif isinstance(entry, tuple):
+            axes.update(entry)
+    return axes
+
+
+def format_spec(spec: Spec) -> str:
+    parts = []
+    for entry in spec:
+        if entry is None:
+            parts.append("None")
+        elif isinstance(entry, str):
+            parts.append(f"'{entry}'")
+        elif isinstance(entry, tuple):
+            parts.append("(" + ", ".join(f"'{e}'" for e in entry) + ")")
+        else:
+            parts.append("?")
+    return "P(" + ", ".join(parts) + ")"
+
+
+def mesh_model(actx: AnalysisContext) -> MeshModel:
+    """The per-scan cached MeshModel (rules share one instance)."""
+    model = actx.caches.get("meshmodel")
+    if not isinstance(model, MeshModel):
+        model = MeshModel(actx)
+        actx.caches["meshmodel"] = model
+    return model

@@ -13,15 +13,34 @@ RULES: Dict[str, "Rule"] = {}
 
 
 class Rule:
-    """Base class. Subclasses set `id`, `name`, `rationale` and implement
-    `check(ctx)`, reporting through `ctx.report(self.id, node, message)`."""
+    """Base class. Subclasses set `id`, `name`, `rationale` (one sentence:
+    why the pattern is a hazard) and `hazard` (a minimal code shape that
+    trips the rule), and implement `check(ctx)`, reporting through
+    `ctx.report(self.id, node, message)`."""
 
     id: str = ""
     name: str = ""
     rationale: str = ""
+    hazard: str = ""
 
     def check(self, ctx: LintContext) -> None:
         raise NotImplementedError
+
+    def explain(self) -> str:
+        """The `--explain GLnnn` card: what the rule catches, why it bites,
+        the shape that trips it, and how to suppress a deliberate use. Also
+        embedded as the SARIF rule help text, so CI annotations carry it."""
+        lines = [f"{self.id} ({self.name})", "", self.rationale.strip()]
+        hazard = self.hazard.strip("\n")
+        if hazard:
+            lines += ["", "Hazard shape:", ""]
+            lines += [f"    {ln}" for ln in hazard.splitlines()]
+        lines += [
+            "",
+            f"Suppress a deliberate use with `# graftlint: disable={self.id}`"
+            " on the reported line.",
+        ]
+        return "\n".join(lines)
 
 
 class ProjectRule(Rule):

@@ -82,6 +82,7 @@ def render_sarif(
             "name": r.name,
             "shortDescription": {"text": r.name},
             "fullDescription": {"text": r.rationale},
+            "help": {"text": r.explain()},
             "defaultConfiguration": {"level": "warning"},
         }
         for r in rules

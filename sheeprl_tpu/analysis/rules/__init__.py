@@ -14,4 +14,9 @@ from sheeprl_tpu.analysis.rules import (  # noqa: F401
     gl011_config_drift,
     gl012_in_jit_impurity,
     gl013_stale_closure,
+    gl014_unknown_axis,
+    gl015_unbound_collective,
+    gl016_divergent_branch,
+    gl017_key_shard_discipline,
+    gl018_resharding_thrash,
 )

@@ -50,6 +50,10 @@ class KeyReuseRule(Rule):
         "The same PRNG key fed to two jax.random consumers produces identical "
         "randomness; split or fold_in before reusing."
     )
+    hazard = (
+        "noise = jax.random.normal(key, shape)\n"
+        "mask = jax.random.bernoulli(key, 0.5, shape)  # same key: correlated"
+    )
 
     def check(self, ctx: LintContext) -> None:
         self._ctx = ctx

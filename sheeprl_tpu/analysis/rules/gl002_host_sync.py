@@ -44,6 +44,11 @@ class HostSyncRule(Rule):
         "Host<->device transfers inside traced code break tracing; per-step "
         "transfers in host loops serialize the device pipeline."
     )
+    hazard = (
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    return float(x.mean())  # device->host sync inside the trace"
+    )
 
     def check(self, ctx: LintContext) -> None:
         jit_nodes = self._check_jit_bodies(ctx)

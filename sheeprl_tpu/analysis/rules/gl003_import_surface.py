@@ -77,6 +77,10 @@ class ImportSurfaceRule(Rule):
         "Importing a name absent from the pinned minimum jax fails at import "
         "time and breaks test collection."
     )
+    hazard = (
+        "from jax.experimental.shard_map import shard_map  # moved across\n"
+        "# the pinned jax range: guard with try/except and a fallback"
+    )
 
     def check(self, ctx: LintContext) -> None:
         for node in ast.walk(ctx.tree):

@@ -81,6 +81,12 @@ class RecompileRule(Rule):
         "Python branching on traced values and unhashable static arguments "
         "either fail at trace time or recompile on every call."
     )
+    hazard = (
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    if x.sum() > 0:  # Python branch on a tracer\n"
+        "        ..."
+    )
 
     def check(self, ctx: LintContext) -> None:
         self._check_traced_branching(ctx)

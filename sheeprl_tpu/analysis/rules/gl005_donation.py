@@ -61,6 +61,10 @@ class DonationRule(Rule):
         "Buffers donated to a jitted call are invalidated at dispatch; "
         "reading one afterwards crashes on device backends."
     )
+    hazard = (
+        "new_state = train_step(state)  # jit(..., donate_argnums=(0,))\n"
+        "log(state.params)              # donated buffer read after dispatch"
+    )
 
     def check(self, ctx: LintContext) -> None:
         donating = {

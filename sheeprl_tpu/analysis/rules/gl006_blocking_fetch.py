@@ -102,6 +102,11 @@ class BlockingFetchRule(Rule):
         "blocks the host where InteractionPipeline.fetch would let the "
         "transfer overlap env stepping."
     )
+    hazard = (
+        "for step in range(total_steps):\n"
+        "    action = np.asarray(policy(obs))  # sync fetch stalls the loop\n"
+        "    obs, reward, done, info = envs.step(action)"
+    )
 
     def check(self, ctx: LintContext) -> None:
         if not _imports_interact(ctx.tree):

@@ -105,6 +105,11 @@ class NonAtomicPersistence(Rule):
         "atomic rename; delete-then-write or in-place final writes leave a "
         "kill-window where no valid snapshot exists on disk."
     )
+    hazard = (
+        "path.unlink()                 # old snapshot gone\n"
+        "with open(path, 'wb') as f:   # crash here -> no snapshot at all\n"
+        "    f.write(blob)"
+    )
 
     def check(self, ctx: LintContext) -> None:
         if not _PATH_SCOPE_RE.search(ctx.path.replace("\\", "/")):

@@ -98,6 +98,11 @@ class SpanLeakOnException(Rule):
         "on exceptions, losing the span and corrupting causality for every "
         "later span in the thread. Use `with tracer.span(...)`."
     )
+    hazard = (
+        "span = tracer.span('train').__enter__()\n"
+        "train_step(state)        # raises -> __exit__ never runs, span leaks\n"
+        "span.__exit__(None, None, None)"
+    )
 
     def check(self, ctx: LintContext) -> None:
         for scope in _scope_bodies(ctx.tree):

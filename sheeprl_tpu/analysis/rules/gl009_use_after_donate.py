@@ -48,6 +48,11 @@ class CrossModuleDonationRule(ProjectRule):
         "A buffer donated to an imported jitted callable is invalidated at "
         "dispatch; reading it afterwards crashes on device backends."
     )
+    hazard = (
+        "from algo.step import train_step   # jit(..., donate_argnums=(0,))\n"
+        "new_state = train_step(state)\n"
+        "metrics = summarize(state)         # cross-module use-after-donate"
+    )
 
     def check_project(self, actx: AnalysisContext) -> None:
         donating = actx.donating_callables()

@@ -114,6 +114,11 @@ class LockDisciplineRule(ProjectRule):
         "mutated with the owning lock held (`with <lock>:`); unlocked "
         "mutation from a second thread is a silent lost update."
     )
+    hazard = (
+        "self._queue = []  # graftlint: guarded-by(self._lock)\n"
+        "...\n"
+        "self._queue.append(item)  # mutation without `with self._lock:`"
+    )
 
     def check_project(self, actx: AnalysisContext) -> None:
         for info in actx.modules:

@@ -133,6 +133,10 @@ class ConfigDriftRule(ProjectRule):
         "configs/ tree, and every YAML leaf must be reachable by some read "
         "or interpolation; both drift directions ship runtime surprises."
     )
+    hazard = (
+        "lr = cfg.algo.learing_rate  # typo: no such key in configs/ ->\n"
+        "# AttributeError at startup on the one machine that hits this path"
+    )
 
     def check_project(self, actx: AnalysisContext) -> None:
         for root, modules in sorted(actx.modules_by_config_root().items()):

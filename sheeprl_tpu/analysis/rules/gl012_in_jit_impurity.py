@@ -71,6 +71,11 @@ class InJitImpurityRule(ProjectRule):
         "any function reachable from a jit boundary execute once at trace "
         "time instead of per step."
     )
+    hazard = (
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    t0 = time.time()  # runs ONCE, at trace time, then never again"
+    )
 
     def check_project(self, actx: AnalysisContext) -> None:
         closure = actx.jit_closure()

@@ -56,6 +56,11 @@ class StaleClosureRule(ProjectRule):
         "whose captured free variable is rebound after the definition bakes "
         "the stale value into the trace silently."
     )
+    hazard = (
+        "scale = 1.0\n"
+        "step = jax.jit(lambda x: x * scale)\n"
+        "scale = 0.5            # rebound after jit: trace still uses 1.0"
+    )
 
     def check_project(self, actx: AnalysisContext) -> None:
         for info in actx.modules:

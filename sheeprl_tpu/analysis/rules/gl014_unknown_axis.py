@@ -1,0 +1,113 @@
+"""GL014: unknown or inconsistently-spelled mesh axis name.
+
+A ``PartitionSpec``/``NamedSharding``/``in_shardings`` entry and a
+collective's ``axis_name`` are plain strings; nothing ties them to the axis
+tuple a ``Mesh(...)`` actually declares. A typo (``P("dat")``) or a stale
+spelling after an axis rename compiles fine on one CPU device — sharding
+annotations over a 1-device mesh are no-ops — and only explodes (or worse,
+silently replicates instead of sharding) once the 8-chip mesh exists. The
+Sebulba scale-out multiplies spec-declaring sites across modules, so the
+name discipline must be machine-checked, not reviewed.
+
+Analysis (project-wide, on the :mod:`~sheeprl_tpu.analysis.meshmodel`): the
+declared-axis universe is the union of every ``Mesh``/``make_mesh`` literal's
+axis tuple, with module-level string constants (``DATA_AXIS = "data"``)
+resolved across imports — so ``core/mesh.py``'s ``build_mesh`` declares
+``{"data", "model"}`` for the whole program. Every statically-resolvable
+axis reference is then checked against it:
+
+* ``P(...)``/``PartitionSpec(...)`` entries (``NamedSharding``,
+  ``in_specs``/``out_specs``, ``in_shardings`` all funnel through these);
+* collective ``axis_name`` strings — here ``vmap``/``pmap``
+  ``axis_name=...`` bindings extend the universe, because those bind
+  *virtual* axes that legitimately never appear in any mesh.
+
+A near-miss (case/underscore-insensitive match against a declared axis)
+reports the canonical spelling; dynamic axis values (parameters, computed
+names — ``ring_attention``'s ``axis_name`` argument) are skipped: the rule
+only judges names it can fully resolve. If the program declares no mesh at
+all the rule is silent — there is nothing to validate against.
+"""
+
+from __future__ import annotations
+
+from typing import Set
+
+from sheeprl_tpu.analysis.meshmodel import mesh_model
+from sheeprl_tpu.analysis.project import AnalysisContext
+from sheeprl_tpu.analysis.registry import ProjectRule, register_rule
+
+
+def _canonical(name: str) -> str:
+    return name.replace("_", "").replace("-", "").lower()
+
+
+@register_rule
+class UnknownAxisRule(ProjectRule):
+    id = "GL014"
+    name = "unknown-mesh-axis"
+    rationale = (
+        "A PartitionSpec or collective names a mesh axis no reachable mesh "
+        "declares (or spells it inconsistently); on a real mesh that is an "
+        "error or a silent full replication."
+    )
+    hazard = (
+        'mesh = Mesh(devices, ("data", "model"))\n'
+        'spec = P(None, "dat")            # typo: no mesh declares "dat"\n'
+        'out = jax.lax.psum(x, "Data")    # inconsistent spelling of "data"'
+    )
+
+    def check_project(self, actx: AnalysisContext) -> None:
+        model = mesh_model(actx)
+        declared = model.declared_axes()
+        if not declared:
+            return
+        virtual: Set[str] = set()
+        for site in model.binding_sites():
+            if site.kind in ("vmap", "pmap"):
+                virtual |= site.axes
+        for info in actx.modules:
+            for node in model.spec_calls(info):
+                spec = model.parse_spec(node, info)
+                if spec is None:
+                    continue
+                for axis in sorted(
+                    a for a in _spec_strings(spec) if a not in declared
+                ):
+                    self._report(info, node, axis, declared, kind="PartitionSpec")
+            for node, path in model.collective_calls(info):
+                hit = model.collective_axis(node, info)
+                if hit is None:
+                    continue
+                _, token = hit
+                if isinstance(token, str) and token not in declared | virtual:
+                    self._report(
+                        info, node, token, declared | virtual, kind=path.rsplit(".", 1)[1]
+                    )
+
+    def _report(self, info, node: ast.AST, axis: str, known: Set[str], kind: str) -> None:
+        near = [k for k in sorted(known) if _canonical(k) == _canonical(axis)]
+        if near:
+            detail = (
+                f"axis `{axis}` in {kind} is spelled inconsistently: the mesh "
+                f"declares `{near[0]}` — use the exported axis constant "
+                "(core.mesh.DATA_AXIS / MODEL_AXIS) instead of a literal"
+            )
+        else:
+            declared_list = ", ".join(f"`{k}`" for k in sorted(known))
+            detail = (
+                f"axis `{axis}` in {kind} is not declared by any mesh in the "
+                f"program (known axes: {declared_list}); a typo here silently "
+                "replicates instead of sharding"
+            )
+        info.ctx.report(self.id, node, detail)
+
+
+def _spec_strings(spec) -> Set[str]:
+    out: Set[str] = set()
+    for entry in spec:
+        if isinstance(entry, str):
+            out.add(entry)
+        elif isinstance(entry, tuple):
+            out.update(entry)
+    return out

@@ -1,0 +1,122 @@
+"""GL015: collective over an axis no enclosing transform binds — and the
+dual, an axis bound with intent to reduce that nothing ever reduces over.
+
+``lax.psum(x, "data")`` is only legal while a ``shard_map``/``pmap``/
+``vmap(axis_name=...)`` with that axis is on the trace stack. The classic
+latent bug: a helper computes per-shard metrics with a psum, works for
+months because its only caller wraps it in ``shard_map`` — then a new
+caller jits it directly and the program dies with ``unbound axis name`` at
+trace time (or, during a refactor toward shard_map, the collective sat
+there all along and only fires when the wrapping lands). The lexical check
+is useless for the same reason GL012's was: the collective lives three
+calls below the transform. This rule walks the project call graph.
+
+Analysis (project-wide, on the :mod:`~sheeprl_tpu.analysis.meshmodel`):
+
+* **binding closure** — every ``shard_map``/``pmap``/``vmap(axis_name=)``
+  site contributes its statically-known bound axes to its resolved body
+  symbol, then the axes propagate through call edges and lexical nesting
+  (a nested def traces with its enclosing body). ``shard_map`` binds its
+  spec axes plus every project-declared mesh axis (the mesh object itself
+  is runtime data; per-name validation is GL014's job).
+* **flag** — a collective whose ``axis_name`` resolves to a static string
+  that is (a) declared *somewhere* (unknown names are GL014 territory —
+  the two rules partition the hazard) and (b) not in the enclosing
+  function's bound-axis set, with no dynamic binder on the path. Dynamic
+  axis arguments (parameters) are skipped.
+* **dual** — a ``pmap``/``vmap`` site with an explicit ``axis_name=`` whose
+  resolved body never (transitively) performs a reducing collective over
+  that axis: the explicit binding declares intent to reduce, and its
+  absence means per-shard params/metrics silently diverge instead of
+  failing. Reported at the binding site.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Set
+
+from sheeprl_tpu.analysis.meshmodel import mesh_model
+from sheeprl_tpu.analysis.project import AnalysisContext
+from sheeprl_tpu.analysis.registry import ProjectRule, register_rule
+
+
+@register_rule
+class UnboundCollectiveRule(ProjectRule):
+    id = "GL015"
+    name = "unbound-collective"
+    rationale = (
+        "A lax collective references an axis_name that no shard_map/pmap/"
+        "vmap(axis_name=) binds on any path to it (trace-time failure once "
+        "wrapped), or an axis is bound for reduction that nothing reduces "
+        "over (silent per-shard divergence)."
+    )
+    hazard = (
+        "@jax.jit\n"
+        "def train_step(grads):\n"
+        '    return jax.lax.pmean(grads, "data")  # no shard_map on any path'
+    )
+
+    def check_project(self, actx: AnalysisContext) -> None:
+        model = mesh_model(actx)
+        bound = model.bound_axes_by_symbol()
+        declared = model.declared_axes()
+        binder_axes: Set[str] = set(declared)
+        any_dynamic_binder = False
+        for site in model.binding_sites():
+            binder_axes |= site.axes
+            if site.dynamic and site.body is None:
+                # a binder we could not attach to a body could bind anything
+                any_dynamic_binder = True
+        self._flag_unbound(actx, model, bound, binder_axes, declared, any_dynamic_binder)
+        self._flag_never_reduced(actx, model)
+
+    # ------------------------------------------------------ unbound direction
+    def _flag_unbound(
+        self, actx, model, bound, binder_axes: Set[str], declared: Set[str],
+        any_dynamic_binder: bool,
+    ) -> None:
+        for info, sym in actx.iter_functions():
+            axes, dynamic = bound.get(sym.key, (set(), False))
+            if dynamic or any_dynamic_binder:
+                continue  # some binder on the path is statically opaque
+            for node, path, token in model.symbol_collectives(sym.key):
+                if not isinstance(token, str) or token in axes:
+                    continue
+                if declared and token not in binder_axes:
+                    continue  # unknown axis: GL014 reports it, not us
+                fn = path.rsplit(".", 1)[1]
+                info.ctx.report(
+                    self.id,
+                    node,
+                    f"`{fn}(..., '{token}')` inside `{sym.key.qualname}` but no "
+                    f"shard_map/pmap/vmap binds axis `{token}` on any path to "
+                    "it — this traces only under a transform that carries the "
+                    "axis and raises `unbound axis name` everywhere else",
+                )
+
+    # ------------------------------------------------------------------ dual
+    def _flag_never_reduced(self, actx, model) -> None:
+        reduced = model.collective_axes_by_symbol()
+        for site in model.binding_sites():
+            if site.kind not in ("pmap", "vmap") or site.dynamic:
+                continue
+            if site.body is None or not site.axes:
+                continue
+            used, dynamic_use = reduced.get(site.body.key, (set(), False))
+            if dynamic_use:
+                continue  # a dynamic-axis collective may well target ours
+            missing = sorted(site.axes - used)
+            for axis in missing:
+                site.info.ctx.report(
+                    self.id,
+                    site.call,
+                    f"{site.kind} binds axis `{axis}` over "
+                    f"`{_body_name(site.body)}` but nothing on the body's call "
+                    "path reduces over it (psum/pmean/all_gather/...); "
+                    "per-replica params and metrics will silently diverge — "
+                    "reduce over the axis or drop the binding",
+                )
+
+
+def _body_name(sym) -> Optional[str]:
+    return sym.key.qualname

@@ -1,0 +1,172 @@
+"""GL016: host-side branch on device data guarding a collective — the
+static shape of a multi-host deadlock.
+
+Collectives are rendezvous points: every participating process must issue
+the same collective in the same order. Host-side control flow that decides
+*whether* to call into collective-bearing code based on a value fetched
+from the device (``device_get``, ``.item()``) is exactly how hosts come to
+disagree — per-host replicas of "the same" array differ by one late infeed
+batch or one non-deterministic reduction, host 3 skips the all-reduce the
+other 7 are blocked in, and the job hangs with no traceback until the
+barrier timeout. On one host the same code runs fine forever, which is why
+the shape has to be caught statically before the Sebulba scale-out makes
+it real.
+
+Analysis (project-wide): a function *performs collectives* when its body
+(or any callee, transitively) issues a reducing ``lax`` collective or
+enters a ``shard_map``. In every **host-side** function (outside the
+project jit closure — in-jit branching is GL004's domain), the rule tracks
+names assigned from a device fetch (``jax.device_get``,
+``jax.block_until_ready``, an ``.item()`` call) and flags an ``if``/
+``while`` whose test reads a fetched value (or fetches inline) when the
+guarded suite calls into collective-performing code. Values routed through
+``checkify`` are the sanctioned escape (its errors are host-uniform by
+construction) and do not taint.
+
+The fix is to make the decision either data-parallel (``lax.cond`` inside
+the traced region, where every shard branches identically) or host-uniform
+(config, step counters, a value all-reduced *before* fetching).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Set
+
+from sheeprl_tpu.analysis.dataflow import walk_scope
+from sheeprl_tpu.analysis.meshmodel import mesh_model
+from sheeprl_tpu.analysis.project import AnalysisContext, ModuleInfo
+from sheeprl_tpu.analysis.registry import ProjectRule, register_rule
+
+_FETCH_PATHS = {"jax.device_get", "jax.block_until_ready"}
+
+
+@register_rule
+class DivergentBranchRule(ProjectRule):
+    id = "GL016"
+    name = "divergent-branch-hazard"
+    rationale = (
+        "Host-side if/while on a device-fetched value deciding whether "
+        "collective-bearing code runs: hosts can disagree on the fetched "
+        "value, some skip the rendezvous, and the mesh deadlocks."
+    )
+    hazard = (
+        "loss_now = float(jax.device_get(loss))\n"
+        "if loss_now > threshold:      # hosts may disagree here\n"
+        "    sync_params(state)        # ...and this psums across the mesh"
+    )
+
+    def check_project(self, actx: AnalysisContext) -> None:
+        model = mesh_model(actx)
+        self._model = model
+        collective_syms = self._collective_performers(actx, model)
+        if not collective_syms:
+            return
+        jit_closure = actx.jit_closure()
+        for info, sym in actx.iter_functions():
+            if sym.key in jit_closure:
+                continue  # traced code: branching there is GL004's problem
+            self._check_scope(actx, info, sym.node, collective_syms, enclosing=sym)
+        for info in actx.modules:
+            self._check_scope(actx, info, info.ctx.tree, collective_syms, enclosing=None)
+
+    # ------------------------------------------------- collective reachability
+    def _collective_performers(self, actx, model) -> Set[object]:
+        """Symbols whose execution (transitively) issues a collective or
+        enters a shard_map."""
+        direct: Set[object] = set()
+        for key, (axes, dynamic) in model.collective_axes_by_symbol().items():
+            if axes or dynamic:
+                direct.add(key)
+        for site in model.binding_sites():
+            if site.kind != "shard_map":
+                continue
+            sym = model.enclosing_symbol(site.call, site.info)
+            if sym is not None:
+                direct.add(sym.key)
+        # collective_axes_by_symbol already propagated lax collectives up the
+        # call graph; do the same for the shard_map entries.
+        edges = actx.call_edges()
+        changed = True
+        while changed:
+            changed = False
+            for caller, callees in edges.items():
+                if caller in direct:
+                    continue
+                if any(callee in direct for callee, _ in callees):
+                    direct.add(caller)
+                    changed = True
+        return direct
+
+    # --------------------------------------------------------------- per-scope
+    def _check_scope(
+        self, actx, info: ModuleInfo, scope: ast.AST, collective_syms, enclosing
+    ) -> None:
+        # One pass: fetch-tainted names and branch statements together (the
+        # check is flow-insensitive, so collection order does not matter).
+        fetched: Set[str] = set()
+        branches = []
+        for node in walk_scope(scope):
+            if isinstance(node, ast.Assign):
+                if self._contains_fetch(info, node.value):
+                    for target in node.targets:
+                        for name in ast.walk(target):
+                            if isinstance(name, ast.Name):
+                                fetched.add(name.id)
+            elif isinstance(node, (ast.If, ast.While)):
+                branches.append(node)
+        for node in branches:
+            if not self._test_is_fetched(info, node.test, fetched):
+                continue
+            target = self._guarded_collective_call(
+                actx, info, node, collective_syms, enclosing
+            )
+            if target is None:
+                continue
+            kind = "if" if isinstance(node, ast.If) else "while"
+            info.ctx.report(
+                self.id,
+                node,
+                f"host-side `{kind}` on a device-fetched value guards a call "
+                f"to `{target}`, which performs collectives: hosts can "
+                "disagree on the fetched value and deadlock the mesh — make "
+                "the decision data-parallel (lax.cond) or host-uniform "
+                "(config/step counter/pre-reduced scalar)",
+            )
+
+    def _contains_fetch(self, info: ModuleInfo, expr: ast.AST) -> bool:
+        tainted = False
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            path = self._model.call_path(node, info)
+            if path and "checkify" in path:
+                return False  # sanctioned, host-uniform by construction
+            if path in _FETCH_PATHS:
+                tainted = True
+            elif isinstance(node.func, ast.Attribute) and node.func.attr == "item":
+                tainted = True
+        return tainted
+
+    def _test_is_fetched(self, info: ModuleInfo, test: ast.AST, fetched: Set[str]) -> bool:
+        if self._contains_fetch(info, test):
+            return True
+        return any(
+            isinstance(n, ast.Name) and n.id in fetched and isinstance(n.ctx, ast.Load)
+            for n in ast.walk(test)
+        )
+
+    def _guarded_collective_call(
+        self, actx, info: ModuleInfo, stmt, collective_syms, enclosing
+    ):
+        """Qualname of the first collective-performing callee invoked inside
+        the guarded suite(s), or None."""
+        for suite in (stmt.body, stmt.orelse):
+            for inner in suite:
+                for node in walk_scope(inner):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    callee = actx.resolve_call(info, node, enclosing=enclosing)
+                    if callee is not None and callee.key in collective_syms:
+                        return callee.key.qualname
+        return None

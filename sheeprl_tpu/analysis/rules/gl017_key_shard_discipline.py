@@ -1,0 +1,119 @@
+"""GL017: one un-split PRNG key reaching a data-sharded computation.
+
+The actor-replica fan-out samples actions and exploration noise *per
+shard*. If the key argument of a ``shard_map``'d body arrives replicated
+(``in_specs`` entry ``P()``) and the body consumes it without first
+deriving a per-shard stream, every replica draws **identical** randomness:
+N actor replicas explore in lockstep, DroQ's dropout masks repeat across
+the data axis, and the extra replicas add batch size but no sample
+diversity. Nothing raises — on the 1-device CI mesh the program is even
+bit-identical to the correct one. This is GL001's "same key, two
+consumers" hazard lifted across the shard dimension, and it needs the spec
+model to see it.
+
+Analysis (project-wide, on the :mod:`~sheeprl_tpu.analysis.meshmodel`):
+for every ``shard_map`` call site with a resolvable body and static
+``in_specs``, positional parameters are matched to their spec entries
+(``functools.partial``-bound keywords don't consume spec slots). A
+key-like parameter (name matching ``key``/``rng``, GL001's convention)
+whose spec is fully replicated is then traced into the body: if the body
+(or a nested def) consumes it through a ``jax.random.*`` consumer while
+never touching ``lax.axis_index`` — the ingredient of every per-shard
+derivation (``fold_in(key, axis_index(axis))``) — the site is flagged.
+Sharded key specs (a pre-split key batch) and bodies that fold the shard
+index in are the two sanctioned shapes.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Optional
+
+from sheeprl_tpu.analysis.meshmodel import DYNAMIC, mesh_model, spec_axes
+from sheeprl_tpu.analysis.project import AnalysisContext
+from sheeprl_tpu.analysis.registry import ProjectRule, register_rule
+
+_KEYLIKE = re.compile(r"(key|rng)", re.IGNORECASE)
+
+# jax.random.* that derive rather than consume (mirrors GL001).
+_NON_CONSUMING = {"fold_in", "PRNGKey", "key", "clone", "wrap_key_data", "key_data", "key_impl", "split"}
+
+
+@register_rule
+class KeyShardDisciplineRule(ProjectRule):
+    id = "GL017"
+    name = "unsplit-key-per-shard"
+    rationale = (
+        "A replicated (un-split) PRNG key consumed inside a data-sharded "
+        "shard_map body makes every shard draw identical randomness — "
+        "replicas explore in lockstep and add no sample diversity."
+    )
+    hazard = (
+        "fn = shard_map(body, mesh=mesh,\n"
+        '               in_specs=(P(), P("data")), out_specs=P("data"))\n'
+        "# body(key, x): jax.random.normal(key, ...) with no\n"
+        "# fold_in(key, lax.axis_index(...)) — all shards sample alike"
+    )
+
+    def check_project(self, actx: AnalysisContext) -> None:
+        model = mesh_model(actx)
+        for site in model.binding_sites():
+            if site.kind != "shard_map" or site.body is None or not site.in_specs:
+                continue
+            params = self._positional_params(site)
+            for idx, spec in enumerate(site.in_specs):
+                if idx >= len(params):
+                    break
+                pname = params[idx]
+                if not _KEYLIKE.search(pname):
+                    continue
+                if spec is None or any(e is DYNAMIC for e in spec):
+                    continue
+                if spec_axes(spec):
+                    continue  # sharded key batch: pre-split, fine
+                hazard = self._body_consumes_raw(site, pname)
+                if hazard is None:
+                    continue
+                site.info.ctx.report(
+                    self.id,
+                    site.call,
+                    f"shard_map passes key-like `{pname}` replicated (in_specs "
+                    f"P()) and the body `{site.body.key.qualname}` consumes it "
+                    f"via jax.random.{hazard} without folding in "
+                    "lax.axis_index — every shard draws identical randomness; "
+                    "fold_in(key, axis_index(axis)) or shard a pre-split key "
+                    "batch",
+                )
+
+    def _positional_params(self, site) -> list:
+        args = site.body.node.args
+        names = [a.arg for a in args.posonlyargs + args.args]
+        return [n for n in names if n not in site.partial_kwargs]
+
+    def _body_consumes_raw(self, site, pname: str) -> Optional[str]:
+        """Name of the consuming jax.random fn when the body uses the key
+        with no axis_index derivation anywhere in its scope (nested defs
+        included — a fold_in in a helper closure still rescues)."""
+        resolver = site.info.ctx.resolver
+        consumer: Optional[str] = None
+        for node in ast.walk(site.body.node):
+            if not isinstance(node, ast.Call):
+                continue
+            path = resolver.resolve(node.func)
+            if not path:
+                continue
+            if path == "jax.lax.axis_index" or path.endswith(".axis_index"):
+                return None  # per-shard derivation present; sanctioned
+            if not path.startswith("jax.random."):
+                continue
+            fn = path.rsplit(".", 1)[1]
+            if fn in _NON_CONSUMING:
+                continue
+            reads_key = any(
+                isinstance(a, ast.Name) and a.id == pname
+                for a in list(node.args) + [kw.value for kw in node.keywords]
+            )
+            if reads_key and consumer is None:
+                consumer = fn
+        return consumer

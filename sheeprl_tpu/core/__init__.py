@@ -1,4 +1,5 @@
 from sheeprl_tpu.core.mesh import (
+    AXIS_NAMES,
     DATA_AXIS,
     MODEL_AXIS,
     batch_sharding,
@@ -13,6 +14,7 @@ from sheeprl_tpu.core.prng import KeySequence, make_streams, seed_everything
 from sheeprl_tpu.core.runtime import Runtime, get_single_device_runtime
 
 __all__ = [
+    "AXIS_NAMES",
     "DATA_AXIS",
     "MODEL_AXIS",
     "batch_sharding",

@@ -22,6 +22,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+# The canonical axis vocabulary, in mesh order. Everything that names an axis
+# (PartitionSpecs, collectives, shard_map specs) must spell it through these
+# constants — graftlint GL014 flags strays, and build_mesh refuses a mesh
+# whose axis_names drift from this tuple.
+AXIS_NAMES = (DATA_AXIS, MODEL_AXIS)
 
 
 def build_mesh(
@@ -47,7 +52,13 @@ def build_mesh(
         )
     used = devices[: data_axis_size * model_axis_size]
     arr = np.asarray(used).reshape(data_axis_size, model_axis_size)
-    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))
+    mesh = Mesh(arr, AXIS_NAMES)
+    assert tuple(mesh.axis_names) == AXIS_NAMES, (
+        f"mesh axis names {mesh.axis_names} drifted from the canonical "
+        f"vocabulary {AXIS_NAMES}; every sharding annotation in the repo "
+        "spells axes through core.mesh constants"
+    )
+    return mesh
 
 
 def split_player_trainer(mesh: Mesh, player_mode: str = "mesh", params: Any = None) -> tuple:

@@ -1,0 +1,10 @@
+"""Canonical axis declarations: exported constants plus the one mesh."""
+
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def build_mesh(devices):
+    return Mesh(devices, (DATA_AXIS, MODEL_AXIS))

@@ -1,0 +1,13 @@
+"""Spec and collective sites: one typo'd axis, one inconsistent spelling."""
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from gl014_positive.axes import DATA_AXIS
+
+BATCH_SPEC = P(DATA_AXIS)  # resolves through the imported constant: fine
+STALE_SPEC = P(None, "dat")  # <- GL014
+
+
+def mean_over_replicas(x):
+    return jax.lax.pmean(x, "Data")  # <- GL014

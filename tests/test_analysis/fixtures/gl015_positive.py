@@ -1,0 +1,18 @@
+"""GL015 positives: an unbound collective and a bound-but-never-reduced axis."""
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P  # noqa: F401
+
+mesh = Mesh(None, ("data",))
+
+
+@jax.jit
+def sync_grads(grads):
+    return jax.lax.pmean(grads, "data")  # <- GL015
+
+
+def scale(x):
+    return x * 2.0
+
+
+batched_scale = jax.vmap(scale, axis_name="batch")  # <- GL015

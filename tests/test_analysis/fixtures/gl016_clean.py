@@ -1,0 +1,33 @@
+"""GL016 clean: collective gating is host-uniform (step counter), fetched
+values only guard local work, and the one deliberate gate is suppressed."""
+
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(None, ("data",))
+
+
+def all_reduce(state):
+    fn = shard_map(lambda x: x, mesh=mesh, in_specs=(P("data"),), out_specs=P("data"))
+    return fn(state)
+
+
+def train_loop(state, step, sync_every):
+    if step % sync_every == 0:  # host-uniform counter: every host agrees
+        state = all_reduce(state)
+    return state
+
+
+def log_maybe(logger, loss):
+    loss_now = float(jax.device_get(loss))
+    if loss_now > 100.0:  # fetched, but guards no collective
+        logger.warning("loss spike: %s", loss_now)
+    return loss_now
+
+
+def force_sync(state, flag):
+    # Single-host debug path; hosts cannot disagree by construction.
+    if jax.device_get(flag):  # graftlint: disable=GL016
+        state = all_reduce(state)
+    return state

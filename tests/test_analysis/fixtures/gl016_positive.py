@@ -1,0 +1,27 @@
+"""GL016 positives: host branches on device-fetched values guarding
+collective-performing code (the multi-host deadlock shape)."""
+
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(None, ("data",))
+
+
+def all_reduce(state):
+    fn = shard_map(lambda x: x, mesh=mesh, in_specs=(P("data"),), out_specs=P("data"))
+    return fn(state)
+
+
+def train_gate(state, loss):
+    loss_now = float(jax.device_get(loss))
+    if loss_now > 100.0:  # <- GL016
+        state = all_reduce(state)
+    return state
+
+
+def eval_gate(state, metric):
+    score = metric.item()
+    if score < 0.0:  # <- GL016
+        state = all_reduce(state)
+    return state

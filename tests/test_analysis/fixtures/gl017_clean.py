@@ -1,0 +1,33 @@
+"""GL017 clean: the two sanctioned key-discipline shapes (fold_in the shard
+index, or shard a pre-split key batch), plus one suppressed lockstep use."""
+
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(None, ("data",))
+
+
+def sample(key, x):
+    shard_key = jax.random.fold_in(key, jax.lax.axis_index("data"))
+    return x + jax.random.normal(shard_key, x.shape)
+
+
+sampler = shard_map(sample, mesh=mesh, in_specs=(P(), P("data")), out_specs=P("data"))
+
+
+def sample_batch(keys, x):
+    return x + jax.random.normal(keys[0], x.shape)
+
+
+batch_sampler = shard_map(
+    sample_batch, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P("data")
+)
+
+
+def lockstep(key, x):
+    # Deliberately identical noise per shard (shared exploration schedule).
+    return x + jax.random.normal(key, x.shape)
+
+
+locked = shard_map(lockstep, mesh=mesh, in_specs=(P(), P("data")), out_specs=P("data"))  # graftlint: disable=GL017

@@ -1,0 +1,15 @@
+"""GL017 positive: a replicated (un-split) PRNG key consumed raw inside a
+data-sharded shard_map body — every shard draws identical randomness."""
+
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(None, ("data",))
+
+
+def sample(key, x):
+    return x + jax.random.normal(key, x.shape)
+
+
+sampler = shard_map(sample, mesh=mesh, in_specs=(P(), P("data")), out_specs=P("data"))  # <- GL017

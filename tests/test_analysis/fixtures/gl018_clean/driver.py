@@ -1,0 +1,17 @@
+"""Producers that agree with the consumer's declared layout; the one
+intentional reshard (a layout migration step) is suppressed."""
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from gl018_clean.pipeline import mesh, train_step
+
+
+def run(batch):
+    batch = jax.device_put(batch, NamedSharding(mesh, P("data")))
+    return train_step(batch)
+
+
+def run_migrating(batch):
+    batch = jax.device_put(batch, NamedSharding(mesh, P("model")))
+    return train_step(batch)  # graftlint: disable=GL018

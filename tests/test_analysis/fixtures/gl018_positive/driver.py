@@ -1,0 +1,11 @@
+"""Producer placing the batch under a sharding the consumer disagrees with."""
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from gl018_positive.pipeline import mesh, train_step
+
+
+def run(batch):
+    batch = jax.device_put(batch, NamedSharding(mesh, P("data")))
+    return train_step(batch)  # <- GL018

@@ -1,0 +1,13 @@
+"""A jit train step that declares its input layout via in_shardings."""
+
+from functools import partial
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(None, ("data", "model"))
+
+
+@partial(jax.jit, in_shardings=(P("model"),))
+def train_step(batch):
+    return batch * 2.0

@@ -63,6 +63,36 @@ def test_list_rules_names_all_five(capsys):
         assert rule_id in out
 
 
+def test_list_rules_names_the_shardlint_pack(capsys):
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in ("GL014", "GL015", "GL016", "GL017", "GL018"):
+        assert rule_id in out
+
+
+@pytest.mark.parametrize(
+    "rule_id", [f"GL{n:03d}" for n in range(1, 19)]
+)
+def test_explain_prints_a_card_for_every_rule(rule_id, capsys):
+    """Every registered rule explains itself: id+name header, the rationale,
+    a hazard shape, and the suppression recipe."""
+    assert main(["--explain", rule_id]) == 0
+    out = capsys.readouterr().out
+    assert out.startswith(rule_id)
+    assert "Hazard shape:" in out, f"{rule_id} has no hazard example"
+    assert f"graftlint: disable={rule_id}" in out
+
+
+def test_explain_is_case_insensitive(capsys):
+    assert main(["--explain", "gl015"]) == 0
+    assert capsys.readouterr().out.startswith("GL015")
+
+
+def test_explain_unknown_rule_is_usage_error(capsys):
+    assert main(["--explain", "GL999"]) == 2
+    assert "unknown rule" in capsys.readouterr().err
+
+
 def test_sarif_format_repo_scan(capsys):
     """Acceptance: `--format sarif` over the package emits parseable
     SARIF 2.1.0 with the graftlint driver."""

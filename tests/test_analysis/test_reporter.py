@@ -106,6 +106,17 @@ def test_sarif_rule_table_is_complete_even_on_clean_scans():
         assert rule["defaultConfiguration"]["level"] == "warning"
 
 
+def test_sarif_rule_table_carries_explain_cards():
+    """Code-scanning UIs surface `help.text`; every rule ships its full
+    explain card (rationale + hazard shape + suppression recipe) there."""
+    run = _sarif("x = 1\n")["runs"][0]
+    for rule in run["tool"]["driver"]["rules"]:
+        help_text = rule["help"]["text"]
+        assert help_text.startswith(rule["id"])
+        assert "Hazard shape:" in help_text
+        assert f"graftlint: disable={rule['id']}" in help_text
+
+
 def test_sarif_result_shape_and_rule_index():
     run = _sarif()["runs"][0]
     result = run["results"][0]

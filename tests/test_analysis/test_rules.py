@@ -10,7 +10,9 @@ from sheeprl_tpu.analysis import lint_file, lint_paths
 FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
 
 # Single-file fixtures. GL009/GL011 are inherently multi-file (cross-module
-# donation, code-vs-YAML drift) and live in fixture *directories* below.
+# donation, code-vs-YAML drift) and live in fixture *directories* below, as
+# do GL014 (axis constants resolved across imports) and GL018 (producer and
+# consumer modules disagreeing on a sharding).
 ALL_RULE_IDS = (
     "GL001",
     "GL002",
@@ -23,8 +25,11 @@ ALL_RULE_IDS = (
     "GL010",
     "GL012",
     "GL013",
+    "GL015",
+    "GL016",
+    "GL017",
 )
-DIR_RULE_IDS = ("GL009", "GL011")
+DIR_RULE_IDS = ("GL009", "GL011", "GL014", "GL018")
 
 
 def _lint_fixture(name):

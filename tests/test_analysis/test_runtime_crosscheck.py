@@ -1,0 +1,141 @@
+"""Shardlint verdicts cross-checked against runtime reality.
+
+Each source string below is fed to the analyzer AND executed on the
+virtual 8-device CPU platform (tests/conftest.py forces
+``--xla_force_host_platform_device_count=8``). The rule's prediction must
+match what actually happens:
+
+- GL015 (unbound collective): the analyzer flags it, and tracing the same
+  program raises the unbound-axis ``NameError``; the shard_map-bound twin
+  is silent AND computes the cross-shard mean.
+- GL017 (un-split key): the analyzer flags it, and running the same
+  program produces *identical* randomness on every shard; the
+  ``fold_in(axis_index)`` twin is silent AND the shards diverge.
+
+This pins the static rules to observed device semantics, so a rule can
+never drift into flagging healthy programs (or blessing broken ones)
+without this file failing.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.analysis import lint_source
+
+DEVICES = jax.devices()
+
+pytestmark = pytest.mark.skipif(
+    len(DEVICES) < 2,
+    reason="needs the virtual multi-device CPU platform from tests/conftest.py",
+)
+
+# The exec namespace supplies DEVICES (a real device array is meaningless to
+# the analyzer, which only reads the axis-names literal).
+GL015_UNBOUND_SRC = """\
+import jax
+from jax.sharding import Mesh
+
+mesh = Mesh(DEVICES, ("data",))
+
+
+@jax.jit
+def sync_grads(grads):
+    return jax.lax.pmean(grads, "data")
+"""
+
+GL015_BOUND_SRC = """\
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(DEVICES, ("data",))
+
+
+def mean_grads(grads):
+    return jax.lax.pmean(grads, "data")
+
+
+sync_grads = shard_map(mean_grads, mesh=mesh, in_specs=(P("data"),), out_specs=P("data"))
+"""
+
+GL017_LOCKSTEP_SRC = """\
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(DEVICES, ("data",))
+
+
+def sample(key, x):
+    return x + jax.random.normal(key, x.shape)
+
+
+sampler = shard_map(sample, mesh=mesh, in_specs=(P(), P("data")), out_specs=P("data"))
+"""
+
+GL017_FOLDED_SRC = """\
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+mesh = Mesh(DEVICES, ("data",))
+
+
+def sample(key, x):
+    key = jax.random.fold_in(key, jax.lax.axis_index("data"))
+    return x + jax.random.normal(key, x.shape)
+
+
+sampler = shard_map(sample, mesh=mesh, in_specs=(P(), P("data")), out_specs=P("data"))
+"""
+
+
+def _verdict(src):
+    findings, _ = lint_source(src, path="crosscheck.py")
+    return sorted({f.rule for f in findings})
+
+
+def _execute(src):
+    namespace = {"DEVICES": np.array(DEVICES)}
+    exec(compile(src, "crosscheck.py", "exec"), namespace)
+    return namespace
+
+
+def _shard_rows(fn):
+    n = len(DEVICES)
+    out = np.asarray(fn(jax.random.PRNGKey(0), jnp.zeros((n, 3))))
+    return [out[i] for i in range(n)]
+
+
+def test_gl015_flagged_program_fails_at_trace_time():
+    assert _verdict(GL015_UNBOUND_SRC) == ["GL015"]
+    ns = _execute(GL015_UNBOUND_SRC)
+    with pytest.raises(NameError, match="unbound axis name"):
+        ns["sync_grads"](jnp.ones(len(DEVICES)))
+
+
+def test_gl015_silent_program_reduces_across_shards():
+    assert _verdict(GL015_BOUND_SRC) == []
+    ns = _execute(GL015_BOUND_SRC)
+    grads = jnp.arange(float(len(DEVICES)))
+    result = np.asarray(ns["sync_grads"](grads))
+    assert np.allclose(result, float(np.mean(np.arange(len(DEVICES)))))
+
+
+def test_gl017_flagged_program_samples_in_lockstep():
+    """The hazard GL017 names is real: a replicated, un-split key makes
+    every shard draw the SAME noise."""
+    assert _verdict(GL017_LOCKSTEP_SRC) == ["GL017"]
+    rows = _shard_rows(_execute(GL017_LOCKSTEP_SRC)["sampler"])
+    assert all(np.allclose(rows[0], row) for row in rows[1:])
+
+
+def test_gl017_silent_program_samples_divergently():
+    """fold_in(axis_index(...)) is the sanctioned fix, and it works: shards
+    draw distinct noise, and the analyzer stays quiet."""
+    assert _verdict(GL017_FOLDED_SRC) == []
+    rows = _shard_rows(_execute(GL017_FOLDED_SRC)["sampler"])
+    assert not any(np.allclose(rows[0], row) for row in rows[1:])

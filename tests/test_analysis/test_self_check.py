@@ -18,7 +18,18 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__fi
 PACKAGE_DIR = os.path.join(REPO_ROOT, "sheeprl_tpu")
 BASELINE_PATH = os.path.join(REPO_ROOT, BASELINE_FILENAME)
 
-PROJECT_RULE_IDS = ("GL009", "GL010", "GL011", "GL012", "GL013")
+PROJECT_RULE_IDS = (
+    "GL009",
+    "GL010",
+    "GL011",
+    "GL012",
+    "GL013",
+    "GL014",
+    "GL015",
+    "GL016",
+    "GL017",
+    "GL018",
+)
 
 
 @pytest.fixture(scope="module")
@@ -49,6 +60,30 @@ def test_baseline_stays_retired():
 
 @pytest.mark.graftlint
 def test_project_rules_clean_on_live_repo(scan):
-    """GL009-GL013 specifically report nothing on the live package."""
+    """GL009-GL018 specifically report nothing on the live package."""
     offenders = [f for f in scan if f.rule in PROJECT_RULE_IDS]
     assert offenders == [], "\n".join(f.format_text() for f in offenders)
+
+
+@pytest.mark.graftlint
+def test_shardlint_pack_landed_at_zero():
+    """The mesh/collective pack (GL014-GL018) landed with zero findings AND
+    zero suppressions on the live package: the scale-out rules must start
+    from a clean slate, with nothing grandfathered behind a disable."""
+    from sheeprl_tpu.analysis.registry import all_rules
+
+    pack = {"GL014", "GL015", "GL016", "GL017", "GL018"}
+    assert pack <= {r.id for r in all_rules()}
+    findings, _, _ = lint_paths([PACKAGE_DIR], root=REPO_ROOT, rules=sorted(pack))
+    assert findings == [], "\n".join(f.format_text() for f in findings)
+    for base, _, names in os.walk(PACKAGE_DIR):
+        for name in names:
+            if not name.endswith(".py"):
+                continue
+            with open(os.path.join(base, name), "r", encoding="utf-8") as fh:
+                text = fh.read()
+            for rule_id in sorted(pack):
+                assert f"disable={rule_id}" not in text, (
+                    f"{name} suppresses {rule_id}; the pack must land "
+                    "suppression-free"
+                )

@@ -6,7 +6,9 @@ import numpy as np
 import pytest
 
 from sheeprl_tpu.core import (
+    AXIS_NAMES,
     DATA_AXIS,
+    MODEL_AXIS,
     Runtime,
     build_mesh,
     get_single_device_runtime,
@@ -25,9 +27,16 @@ def test_build_mesh_shapes():
     assert mesh.shape[DATA_AXIS] == 8
     mesh2 = build_mesh(model_axis_size=2)
     assert mesh2.shape[DATA_AXIS] == 4
-    assert mesh2.shape["model"] == 2
+    assert mesh2.shape[MODEL_AXIS] == 2
     with pytest.raises(ValueError):
         build_mesh(model_axis_size=3)
+
+
+def test_mesh_axis_names_match_the_canonical_vocabulary():
+    """AXIS_NAMES is the single spelling authority (graftlint GL014 enforces
+    it statically; build_mesh asserts it at runtime)."""
+    assert AXIS_NAMES == (DATA_AXIS, MODEL_AXIS) == ("data", "model")
+    assert tuple(build_mesh().axis_names) == AXIS_NAMES
 
 
 def test_shard_batch_places_shards():
