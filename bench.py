@@ -38,8 +38,11 @@ Workloads:
 `python bench.py [dreamer_v3|dreamer_v3_devbuf|dreamer_v3_pipe|dreamer_v3_S|
 dreamer_v3_S_b32|dreamer_v3_S_b64|dreamer_v3_health|dreamer_v2|dreamer_v1|
 dreamer_v3_goodput|ppo|a2c|sac|sac_devbuf|sac_pipe|sac_resilience|
-sac_health|sac_flight|sac_goodput|serve_sac|serve_sac_traced|ppo_anakin|
-sac_anakin|dreamer_v3_anakin|graftlint_repo]`. The `*_goodput` legs are the
+sac_health|sac_flight|sac_goodput|sac_mesh8|serve_sac|serve_sac_traced|
+ppo_anakin|sac_anakin|dreamer_v3_anakin|graftlint_repo]`. `sac_mesh8` is the
+per-shard goodput leg: SAC on a virtual 8-device CPU mesh, headline value =
+perf/shard_imbalance (max/mean per-shard flops, lower-better) with the full
+per-shard MFU map in the history record's `shards` field. The `*_goodput` legs are the
 roofline-accounting A/B (telemetry/perf.py armed vs the plain row, <2%
 target) and embed the run's mfu / bandwidth-utilization /
 compute-infeed-host breakdown snapshot. `graftlint_repo` is the static-analysis leg: whole-package
@@ -443,6 +446,61 @@ def bench_sac_goodput():
     if breakdown:
         result["step_time_breakdown"] = breakdown
     return result
+
+
+def bench_sac_mesh8():
+    """Per-shard goodput leg on the virtual 8-device CPU mesh (main() injects
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 before the jax import).
+    One telemetry-armed SAC run with the batch sharded over data=8; the
+    headline value is the perf/shard_imbalance gauge (max/mean per-shard
+    flops, 1.0 = perfectly even, direction=lower — the quantity `perf
+    --check` gates so a layout change that skews one shard trips CI), with
+    the full per-shard MFU map embedded via the record's `shards` field and
+    throughput demoted to context. SHEEPRL_MESH_BENCH_STEPS shrinks the run
+    for the CI smoke leg."""
+    from sheeprl_tpu.cli import check_configs
+    from sheeprl_tpu.config.loader import compose
+    from sheeprl_tpu.telemetry.perf import last_published
+
+    steps = int(os.environ.get("SHEEPRL_MESH_BENCH_STEPS", "2048"))
+    overrides = [
+        "exp=sac_benchmarks",
+        "fabric.devices=8",
+        "fabric.player_sync=async",
+        "telemetry.enabled=True",
+        "metric.log_level=1",
+        "algo.learning_starts=100",
+        f"algo.total_steps={steps}",
+        "checkpoint.every=0",
+        "checkpoint.save_last=False",
+    ]
+    cfg = compose("config", overrides)
+    check_configs(cfg)
+    t0 = time.perf_counter()
+    _run_silent(cfg)
+    wall = time.perf_counter() - t0
+    gauges = last_published() or {}
+    prefix = "perf/shard/"
+    shards = {
+        name[len(prefix) : -len("/mfu")]: round(float(v), 8)
+        for name, v in gauges.items()
+        if name.startswith(prefix) and name.endswith("/mfu")
+    }
+    imbalance = float(gauges.get("perf/shard_imbalance", 1.0))
+    return {
+        "metric": "sac_mesh8_shard_imbalance",
+        "value": round(imbalance, 4),
+        "unit": "max_over_mean",
+        # max/mean is not a time unit, so bench_db would default this leg to
+        # higher-better; pin the direction or the gate points backwards.
+        "direction": "lower",
+        "vs_baseline": round(1.0 / max(imbalance, 1e-9), 3),
+        "shards": shards,
+        "devices": 8,
+        "env_steps": steps,
+        "env_steps_per_sec": round(steps / max(wall, 1e-9), 2),
+        "aggregate_mfu": round(float(gauges.get("perf/mfu", 0.0)), 8),
+    }
 
 
 def bench_serve_sac(traced: bool = False):
@@ -897,6 +955,8 @@ def _append_history(leg: str, result: dict) -> None:
         goodput=result.get("goodput"),
         breakdown=result.get("step_time_breakdown"),
         root=repo,
+        direction=result.get("direction"),
+        shards=result.get("shards"),
     )
     path = bench_db.default_history_path(repo)
     bench_db.append_record(path, record)
@@ -930,7 +990,14 @@ def main() -> None:
     # outright so the accelerator plugin is never initialized for them.
     # Accelerator workloads probe the device first and fall back to CPU
     # (recorded in the output) rather than hang on a wedged plugin.
-    if which in ("ppo", "a2c", "sac", "sac_health", "sac_flight", "sac_goodput", "serve_sac", "serve_sac_traced"):
+    if which == "sac_mesh8":
+        # The virtual 8-device mesh leg: the flag must be in the environment
+        # before the first jax import or the CPU backend initializes with one
+        # device and the mesh build fails.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    if which in ("ppo", "a2c", "sac", "sac_health", "sac_flight", "sac_goodput", "sac_mesh8", "serve_sac", "serve_sac_traced"):
         platform = "cpu"
     elif os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
         platform = "cpu"  # already pinned: nothing to probe
@@ -970,6 +1037,7 @@ def main() -> None:
         "sac_health": bench_sac_health,
         "sac_flight": bench_sac_flight,
         "sac_goodput": bench_sac_goodput,
+        "sac_mesh8": bench_sac_mesh8,
         "serve_sac": bench_serve_sac,
         "serve_sac_traced": lambda: bench_serve_sac(traced=True),
         "ppo_anakin": bench_ppo_anakin,

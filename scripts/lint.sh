@@ -36,6 +36,7 @@ python -m sheeprl_tpu.analysis --no-baseline sheeprl_tpu/ || rc=1
 # by name so a future package-wide policy change can't quietly exempt them.
 echo "== graftlint (performance observatory, zero findings) =="
 python -m sheeprl_tpu.analysis --no-baseline \
-    sheeprl_tpu/telemetry/perf.py sheeprl_tpu/telemetry/bench_db.py || rc=1
+    sheeprl_tpu/telemetry/perf.py sheeprl_tpu/telemetry/bench_db.py \
+    sheeprl_tpu/telemetry/mesh_obs.py || rc=1
 
 exit "$rc"

@@ -124,6 +124,10 @@ def main(runtime, cfg: Dict[str, Any]):
     # axis it shards wide dense stacks tensor-parallel over the trainers.
     params = mesh_lib.shard_wide_params(params, trainer_mesh)
     opt_state = mesh_lib.shard_wide_params(opt_state, trainer_mesh)
+    # Per-shard goodput over the TRAINER partition + the topology/layout
+    # records behind `python -m sheeprl_tpu.telemetry mesh`.
+    telemetry.set_mesh(trainer_mesh)
+    telemetry.record_param_layouts(params)
     # Trainer->player weight broadcast as a packed single-transfer mirror
     # (core/player.py). On-policy: always fresh — the next rollout must see
     # the post-update weights, exactly like the reference's blocking
@@ -221,6 +225,7 @@ def main(runtime, cfg: Dict[str, Any]):
     # Coalesced loss fetch + interval bounding (telemetry/step_timer.py):
     # ONE block_until_ready + ONE device_get per log interval.
     train_timer = telemetry.step_timer("train", timer_key="Time/train_time")
+    perf = telemetry.perf
     keep_train_metrics = (aggregator is not None and not aggregator.disabled) or health.enabled
     step_data = {}
     next_obs = envs.reset(seed=cfg.seed)[0]
@@ -233,7 +238,7 @@ def main(runtime, cfg: Dict[str, Any]):
         for _ in range(0, cfg.algo.rollout_steps):
             policy_step += cfg.env.num_envs
 
-            with timer("Time/env_interaction_time"):
+            with timer("Time/env_interaction_time"), perf.infeed():
                 with jax.default_device(player_device):
                     # prepare_obs is numpy; PRNG split + normalization run
                     # inside the jit — one dispatch, one host fetch per step.
@@ -309,22 +314,31 @@ def main(runtime, cfg: Dict[str, Any]):
         # sharded over the trainer mesh (the reference permutes + splits +
         # scatter_object_list, ppo_decoupled.py:295-300; the in-jit epoch
         # permutation already randomizes minibatch membership).
-        flat = {
-            k: jax.device_put(
-                np.asarray(v).reshape(-1, *np.asarray(v).shape[2:]), batch_sharding
-            )
-            for k, v in local_data.items()
-        }
+        # Accounted scatter (core/mesh.put_sharded): H2D bytes land on the
+        # transfer ledger; a layout mismatch would tick reshard_events.
+        flat = mesh_lib.put_sharded(
+            {k: np.asarray(v).reshape(-1, *np.asarray(v).shape[2:]) for k, v in local_data.items()},
+            batch_sharding,
+        )
 
         with timer("Time/train_time"):
+            clip_arr = np.asarray(cfg.algo.clip_coef, np.float32)
+            ent_arr = np.asarray(cfg.algo.ent_coef, np.float32)
+            # Goodput accounting BEFORE the dispatch: arg shape specs must be
+            # captured while the buffers are alive (the jit donates them).
+            perf.note(
+                "train/update", train_fn,
+                (params, opt_state, flat, train_key, clip_arr, ent_arr),
+                steps=float(cfg.algo.update_epochs),
+            )
             with train_timer.step():
                 params, opt_state, train_metrics, train_key = train_fn(
                     params,
                     opt_state,
                     flat,
                     train_key,
-                    np.asarray(cfg.algo.clip_coef, np.float32),
-                    np.asarray(cfg.algo.ent_coef, np.float32),
+                    clip_arr,
+                    ent_arr,
                 )
             # The broadcast back: the player's next rollout waits on this copy.
             params_mirror.push(params)

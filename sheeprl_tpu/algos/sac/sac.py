@@ -256,6 +256,10 @@ def main(runtime, cfg: Dict[str, Any]):
                 opt_states[name] = restore_opt_state(opt_states[name], state_ckpt[ckpt_key])
     agent_state = runtime.shard_params(agent_state)
     opt_states = runtime.shard_params(opt_states)
+    # Arm per-shard goodput accounting and record the topology + param
+    # layouts for the `telemetry mesh` inspector, now that both exist.
+    telemetry.set_mesh(mesh)
+    telemetry.record_param_layouts(agent_state)
 
     if runtime.is_global_zero:
         save_configs(cfg, log_dir)

@@ -137,6 +137,11 @@ def main(runtime, cfg: Dict[str, Any]):
     # axis it shards wide dense stacks tensor-parallel over the trainers.
     agent_state = mesh_lib.shard_wide_params(agent_state, trainer_mesh)
     opt_states = mesh_lib.shard_wide_params(opt_states, trainer_mesh)
+    # Per-shard goodput over the TRAINER partition (the player device is
+    # accounted by its own fetch/infeed spans), plus the topology + layout
+    # records behind `python -m sheeprl_tpu.telemetry mesh`.
+    telemetry.set_mesh(trainer_mesh)
+    telemetry.record_param_layouts(agent_state)
     # The trainer->player weight broadcast as a packed single-transfer mirror
     # (core/player.py): honors fabric.player_sync — "fresh" makes the next
     # inference wait for the post-update actor, "async" serves the newest
@@ -231,13 +236,14 @@ def main(runtime, cfg: Dict[str, Any]):
     # Coalesced loss fetch + interval bounding (telemetry/step_timer.py):
     # ONE block_until_ready + ONE device_get per log interval.
     train_timer = telemetry.step_timer("train", timer_key="Time/train_time")
+    perf = telemetry.perf
     keep_train_metrics = (aggregator is not None and not aggregator.disabled) or health.enabled
     for iter_num in range(start_iter, total_iters + 1):
         policy_step += policy_steps_per_iter
         telemetry.advance(policy_step)
         guard.advance(policy_step)
 
-        with timer("Time/env_interaction_time"):
+        with timer("Time/env_interaction_time"), perf.infeed():
             if iter_num <= learning_starts:
                 actions = envs.action_space.sample()
             else:
@@ -296,24 +302,35 @@ def main(runtime, cfg: Dict[str, Any]):
                     batch_size=per_rank_gradient_steps * global_batch,
                     sample_next_obs=cfg.buffer.sample_next_obs,
                 )
-                data = {
-                    k: jax.device_put(
-                        np.asarray(v)
+                # Accounted scatter (core/mesh.put_sharded): the H2D bytes
+                # land on the transfer ledger, and a layout mismatch would
+                # surface as transfer/reshard_events instead of hiding.
+                data = mesh_lib.put_sharded(
+                    {
+                        k: np.asarray(v)
                         .astype(np.float32)
-                        .reshape(per_rank_gradient_steps, global_batch, *np.asarray(v).shape[2:]),
-                        batch_sharding,
-                    )
-                    for k, v in sample.items()
-                }
+                        .reshape(per_rank_gradient_steps, global_batch, *np.asarray(v).shape[2:])
+                        for k, v in sample.items()
+                    },
+                    batch_sharding,
+                )
                 with timer("Time/train_time"):
                     do_ema = iter_num % target_freq_iters == 0
+                    tau_arr = np.asarray(agent.tau if do_ema else 0.0, np.float32)
+                    # Goodput accounting BEFORE the dispatch: arg shape specs
+                    # must be captured while the buffers are alive (donated).
+                    perf.note(
+                        f"train/g{per_rank_gradient_steps}", train_fn,
+                        (agent_state, opt_states, data, train_key, tau_arr),
+                        steps=per_rank_gradient_steps,
+                    )
                     with train_timer.step():
                         agent_state, opt_states, train_metrics, train_key = train_fn(
                             agent_state,
                             opt_states,
                             data,
                             train_key,
-                            np.asarray(agent.tau if do_ema else 0.0, np.float32),
+                            tau_arr,
                         )
                     # No sync here: the StepTimer queues the loss scalars
                     # device-side and bounds the interval with ONE block at

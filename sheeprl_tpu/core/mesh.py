@@ -10,6 +10,12 @@ Axes:
   - ``data``: batch (data-parallel) axis — replaces DDP gradient allreduce.
   - ``model``: optional tensor-parallel axis for wide layers (the reference
     has no TP at all; the 4096-wide RSSM stacks make it worthwhile on TPU).
+
+Every put helper here accounts the bytes it moves into the live tracer
+(``transfer/h2d_bytes``, ``transfer/d2d_bytes``, ``transfer/reshard_events``)
+— the runtime complement of graftlint GL018's static resharding-thrash rule.
+The counters ride the tracer's no-op fast path when telemetry is disabled,
+so the accounting adds one function call per put to the infeed hot path.
 """
 
 from __future__ import annotations
@@ -130,6 +136,29 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+# ------------------------------------------------------ transfer accounting
+def _leaf_nbytes(x: Any) -> int:
+    nbytes = getattr(x, "nbytes", None)
+    if nbytes is not None:
+        return int(nbytes)
+    try:
+        return int(np.asarray(x).nbytes)
+    except Exception:  # noqa: BLE001 - unsized leaf: account zero, not a crash
+        return 0
+
+
+def _account_transfer(kind: str, nbytes: int, calls: int = 1) -> None:
+    """Count one put-helper invocation's bytes into the live tracer
+    (``transfer/h2d_bytes`` etc. in telemetry.jsonl, mirrored onto /metrics
+    by ``Telemetry.log_counters``). A disabled tracer makes this two cheap
+    no-op calls — the infeed hot path keeps its budget."""
+    from sheeprl_tpu.telemetry import tracer as tracer_mod
+
+    t = tracer_mod.current()
+    t.count(f"transfer/{kind}_bytes", float(nbytes))
+    t.count(f"transfer/{kind}_calls", float(calls))
+
+
 def shard_batch(tree: Any, mesh: Mesh, axis: int = 0) -> Any:
     """Device-put a host pytree with its ``axis`` dim sharded over `data`.
 
@@ -137,21 +166,77 @@ def shard_batch(tree: Any, mesh: Mesh, axis: int = 0) -> Any:
     `to_tensor`/`get_tensor` bridge (sheeprl/data/buffers.py:1158-1180), but
     placing each shard directly on its device (no gather on one chip).
     """
+    moved = 0
 
     def _put(x):
+        nonlocal moved
         x = np.asarray(x)
+        moved += x.nbytes
         spec = [None] * x.ndim
         if x.ndim > axis:
             spec[axis] = DATA_AXIS
         return jax.device_put(x, NamedSharding(mesh, P(*spec)))
 
-    return jax.tree_util.tree_map(_put, tree)
+    out = jax.tree_util.tree_map(_put, tree)
+    _account_transfer("h2d", moved)
+    return out
 
 
 def replicate(tree: Any, mesh: Mesh) -> Any:
     """Device-put a host pytree fully replicated over the mesh (params)."""
     sharding = replicated_sharding(mesh)
-    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), tree)
+    moved = 0
+
+    def _put(x):
+        nonlocal moved
+        moved += _leaf_nbytes(x)
+        return jax.device_put(x, sharding)
+
+    out = jax.tree_util.tree_map(_put, tree)
+    # Logical bytes: one host copy feeds the replicas (the fan-out across
+    # devices is the runtime's broadcast, not a host read per replica).
+    _account_transfer("h2d", moved)
+    return out
+
+
+def put_sharded(tree: Any, sharding: Any) -> Any:
+    """Device-put a pytree onto an explicit sharding with the transfer
+    ledger told the truth: host leaves count as H2D infeed, device-resident
+    leaves whose layout differs count as D2D bytes plus one
+    ``transfer/reshard_events`` tick per leaf — the runtime complement of
+    graftlint GL018 (a loop that trips this every iteration is paying a
+    resharding tax GL018 would flag statically), and leaves already laid
+    out correctly count nothing (jax returns them as-is)."""
+    h2d = d2d = reshards = 0
+
+    def _put(x):
+        nonlocal h2d, d2d, reshards
+        current_sharding = getattr(x, "sharding", None)
+        if current_sharding is None:
+            h2d += _leaf_nbytes(x)
+        elif current_sharding != sharding:
+            d2d += _leaf_nbytes(x)
+            reshards += 1
+        return jax.device_put(x, sharding)
+
+    out = jax.tree_util.tree_map(_put, tree)
+    if h2d:
+        _account_transfer("h2d", h2d)
+    if d2d or reshards:
+        _account_transfer("d2d", d2d, calls=reshards)
+        from sheeprl_tpu.telemetry import tracer as tracer_mod
+
+        tracer_mod.current().count("transfer/reshard_events", float(reshards))
+    return out
+
+
+def constrain(tree: Any, sharding: Any) -> Any:
+    """Host-side ``with_sharding_constraint`` twin for already-device-backed
+    trees: re-lay out every leaf onto ``sharding`` via :func:`put_sharded`
+    (same accounting), for callers outside jit — inside jit, use
+    ``jax.lax.with_sharding_constraint`` (host counters would only fire at
+    trace time there, i.e. lie)."""
+    return put_sharded(tree, sharding)
 
 
 def shard_wide_params(tree: Any, mesh: Mesh, min_dim: int = 1024) -> Any:
@@ -169,9 +254,12 @@ def shard_wide_params(tree: Any, mesh: Mesh, min_dim: int = 1024) -> Any:
     TP of any kind).
     """
     model_size = int(mesh.shape[MODEL_AXIS])
+    moved = 0
 
     def _put(x):
+        nonlocal moved
         x = np.asarray(x) if not hasattr(x, "shape") else x
+        moved += _leaf_nbytes(x)
         wide = (
             model_size > 1
             and getattr(x, "ndim", 0) >= 1
@@ -184,7 +272,9 @@ def shard_wide_params(tree: Any, mesh: Mesh, min_dim: int = 1024) -> Any:
             return jax.device_put(x, NamedSharding(mesh, P(*spec)))
         return jax.device_put(x, NamedSharding(mesh, P()))
 
-    return jax.tree_util.tree_map(_put, tree)
+    out = jax.tree_util.tree_map(_put, tree)
+    _account_transfer("h2d", moved)
+    return out
 
 
 def local_batch_size(global_batch: int, mesh: Mesh) -> int:

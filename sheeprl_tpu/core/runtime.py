@@ -84,7 +84,20 @@ def force_cpu_platform(num_devices: Optional[int] = None, force: bool = False) -
 
     _jeb.clear_backends()
     jax.config.update("jax_platforms", "cpu")
-    if num_devices is not None and len(jax.devices()) < int(num_devices):
+    if num_devices is not None and not hasattr(jax.config, "jax_num_cpu_devices"):
+        # Older jax has no jax_num_cpu_devices option: the host-platform
+        # device count comes only from XLA_FLAGS, which XLA snapshots at the
+        # FIRST backend build of the process and never re-reads. Grow the
+        # flag preemptively — a `jax.devices()` probe would itself build
+        # that first backend and burn the one resize window. Like upstream's
+        # test_util, never override a count the environment already names.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count={int(num_devices)}".strip()
+            )
+            _jeb.clear_backends()
+    elif num_devices is not None and len(jax.devices()) < int(num_devices):
         # `num_devices` is a MINIMUM, applied only when the environment's
         # own sizing (XLA_FLAGS --xla_force_host_platform_device_count, or
         # a prior jax_num_cpu_devices) comes up short: pinning

@@ -146,6 +146,18 @@ class InferenceEngine:
         from sheeprl_tpu.telemetry.perf import PerfAccountant
 
         self.perf = PerfAccountant(enabled=bool(goodput), registry=self.registry)
+        # Device provenance gauges: which hardware this engine serves on,
+        # scrape-visible so a fleet dashboard can group replicas by backend
+        # (the serve-side mirror of the trainer's telemetry meta stamps).
+        try:
+            from sheeprl_tpu.telemetry.mesh_obs import device_provenance
+
+            provenance = device_provenance()
+            if provenance.get("device_count"):
+                self.registry.gauge("serve/device_count").set(float(provenance["device_count"]))
+                self.registry.gauge("serve/process_index").set(float(provenance.get("process_index", 0)))
+        except Exception:  # noqa: BLE001 - metrics bridge must not block serving
+            pass
         # bucket -> [requests_served, batches] for mean-occupancy reporting.
         # Written by the dispatcher thread, cleared by reset_stats() from
         # HTTP/bench threads — both sides must hold the condition's lock.

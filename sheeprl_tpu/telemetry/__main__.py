@@ -16,6 +16,13 @@ aggregated trace (every ``trace.json``, flight dump, and spill file under
 the dir, rebased onto one wall-clock timeline; ``--trace`` filters to one
 trace ID). The merged file loads in Perfetto like a single-process trace.
 
+``mesh <logdir>`` is the mesh inspector: it renders the device topology
+grid, the per-param sharding layouts (``visualize-sharding``-style ASCII
+blocks, but offline from the JSONL instead of needing live arrays), and a
+table of the latest per-shard goodput gauges (``perf/shard/*``) with the
+imbalance figure — everything the run recorded via ``Telemetry.set_mesh``
+and ``record_param_layouts``. Read-only and jax-free like ``tail``.
+
 ``perf [history]`` is the regression gate over ``BENCH_HISTORY.jsonl``:
 for every leg it splits the history into HEAD (the newest git sha present)
 vs baseline (everything before it), runs the bench_db noise-aware test
@@ -34,6 +41,7 @@ import sys
 import time
 from typing import Any, Dict, List, Optional
 
+from sheeprl_tpu.telemetry import mesh_obs
 from sheeprl_tpu.telemetry.telemetry import JSONL_FILENAME
 
 
@@ -127,15 +135,87 @@ def render(records: List[Dict[str, Any]], max_events: int = 8) -> str:
     return "\n".join(lines) + "\n"
 
 
-def tail(path: str, follow: bool = False, interval: float = 2.0, out: Any = None) -> int:
+def render_scrape(text: str) -> str:
+    """Render a scraped /metrics body as the same counters/gauges layout the
+    jsonl view uses. Series keep their label sets, so a federated endpoint
+    shows every process's samples side by side."""
+    parsed = mesh_obs.parse_prometheus_text(text)
+    lines: List[str] = []
+    counters = parsed.get("counters") or {}
+    gauges = parsed.get("gauges") or {}
+    if counters:
+        lines.append("counters:")
+        for name in sorted(counters):
+            lines.append(f"  {name:<48} {_fmt_value(counters[name])}")
+    if gauges:
+        lines.append("gauges:")
+        for name in sorted(gauges):
+            lines.append(f"  {name:<48} {_fmt_value(gauges[name])}")
+    if not lines:
+        lines.append("no samples in scrape")
+    return "\n".join(lines) + "\n"
+
+
+def find_spill_dirs(path: str) -> List[str]:
+    """Every directory under ``path`` holding flight spills (proc_*.jsonl)."""
+    dirs: List[str] = []
+    for root, _dirs, files in os.walk(path):
+        if any(n.startswith("proc_") and n.endswith(".jsonl") for n in files):
+            dirs.append(root)
+    return sorted(dirs)
+
+
+def render_cluster(path: str) -> str:
+    """Cluster-wide view: one summary line per spilling sibling process
+    (pid, run_info, headline counters from its federated registry snapshot).
+    Empty string when no spills exist — single-process runs stay quiet."""
+    lines: List[str] = []
+    for spill_dir in find_spill_dirs(path):
+        metas = mesh_obs.read_spill_metas(spill_dir)
+        if not metas:
+            continue
+        lines.append(f"cluster ({spill_dir}, {len(metas)} processes):")
+        for meta in sorted(metas, key=lambda m: int(m.get("pid", 0))):
+            info = meta.get("run_info") or {}
+            label = " ".join(f"{k}={v}" for k, v in sorted(info.items())) or "-"
+            lines.append(f"  pid {meta.get('pid', '?'):<8} {label}")
+            metrics = meta.get("metrics") or {}
+            counters = metrics.get("counters") or {}
+            for name in sorted(counters)[:4]:
+                lines.append(f"    {name:<34} {_fmt_value(counters[name])}")
+    return "\n".join(lines) + "\n" if lines else ""
+
+
+def tail(
+    path: Optional[str],
+    follow: bool = False,
+    interval: float = 2.0,
+    metrics_url: Optional[str] = None,
+    out: Any = None,
+) -> int:
     out = out if out is not None else sys.stdout
-    jsonl = find_jsonl(path)
-    if jsonl is None:
-        print(f"no {JSONL_FILENAME} found under {path!r} (is telemetry enabled?)", file=sys.stderr)
-        return 1
+    jsonl: Optional[str] = None
+    if path is not None:
+        jsonl = find_jsonl(path)
+        if jsonl is None:
+            print(f"no {JSONL_FILENAME} found under {path!r} (is telemetry enabled?)", file=sys.stderr)
+            return 1
+    elif metrics_url is None:
+        print("tail needs a logdir, a --metrics-url, or both", file=sys.stderr)
+        return 2
     while True:
-        out.write(f"== {jsonl} ==\n")
-        out.write(render(load_records(jsonl)))
+        if jsonl is not None:
+            out.write(f"== {jsonl} ==\n")
+            out.write(render(load_records(jsonl)))
+            cluster = render_cluster(path if path is not None and os.path.isdir(path) else os.path.dirname(jsonl))
+            if cluster:
+                out.write(cluster)
+        if metrics_url is not None:
+            out.write(f"== {metrics_url} ==\n")
+            try:
+                out.write(render_scrape(mesh_obs.fetch_metrics_text(metrics_url)))
+            except (OSError, ValueError) as exc:
+                out.write(f"scrape failed: {exc}\n")
         out.flush()
         if not follow:
             return 0
@@ -143,6 +223,40 @@ def tail(path: str, follow: bool = False, interval: float = 2.0, out: Any = None
             time.sleep(interval)
         except KeyboardInterrupt:  # pragma: no cover - interactive exit
             return 0
+
+
+def mesh(path: str, max_layouts: int = 8, out: Any = None) -> int:
+    """Offline mesh inspector: topology grid, param layout blocks, and the
+    latest per-shard goodput gauges — all from telemetry.jsonl."""
+    out = out if out is not None else sys.stdout
+    jsonl = find_jsonl(path)
+    if jsonl is None:
+        print(f"no {JSONL_FILENAME} found under {path!r} (is telemetry enabled?)", file=sys.stderr)
+        return 1
+    records = load_records(jsonl)
+    out.write(f"== {jsonl} ==\n")
+    topo_rec = next((r for r in reversed(records) if r.get("type") == "mesh"), None)
+    if topo_rec is None:
+        out.write("no mesh topology recorded (did the run call Telemetry.set_mesh?)\n")
+    else:
+        out.write(mesh_obs.topology_ascii(topo_rec.get("topology") or {}))
+    layouts_rec = next((r for r in reversed(records) if r.get("type") == "param_layouts"), None)
+    if layouts_rec is not None:
+        layouts = list(layouts_rec.get("layouts") or [])
+        out.write(f"\nparam layouts ({len(layouts)} recorded, showing {min(max_layouts, len(layouts))}):\n")
+        for layout in layouts[:max_layouts]:
+            out.write(mesh_obs.layout_ascii(layout))
+    intervals = [r for r in records if r.get("type") == "counters"]
+    latest = intervals[-1] if intervals else None
+    if latest is not None:
+        values: Dict[str, Any] = latest.get("values") or {}
+        shard_prefixes = (f"/{mesh_obs.SHARD_NS}/", "/shard_imbalance")
+        shard = {k: v for k, v in values.items() if any(p in k for p in shard_prefixes)}
+        if shard:
+            out.write(f"\nper-shard metrics (step {latest.get('step', '?')}):\n")
+            for name in sorted(shard):
+                out.write(f"  {name:<44} {_fmt_value(shard[name])}\n")
+    return 0
 
 
 def find_flight_dumps(path: str) -> List[str]:
@@ -333,9 +447,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     sub = parser.add_subparsers(dest="command", required=True)
     p_tail = sub.add_parser("tail", help="render current health/throughput from a run's telemetry.jsonl")
-    p_tail.add_argument("logdir", help="telemetry.jsonl path, a run dir, or any ancestor (newest run wins)")
+    p_tail.add_argument("logdir", nargs="?", help="telemetry.jsonl path, a run dir, or any ancestor (newest run wins)")
     p_tail.add_argument("--follow", "-f", action="store_true", help="re-render until interrupted")
     p_tail.add_argument("--interval", type=float, default=2.0, help="seconds between renders with --follow")
+    p_tail.add_argument("--metrics-url", dest="metrics_url", help="also scrape a live /metrics endpoint (works without a logdir)")
+    p_mesh = sub.add_parser("mesh", help="render mesh topology, param sharding layouts, and per-shard goodput")
+    p_mesh.add_argument("logdir", help="telemetry.jsonl path, a run dir, or any ancestor (newest run wins)")
+    p_mesh.add_argument("--max-layouts", type=int, default=8, help="param layout grids to render (default 8)")
     p_flight = sub.add_parser("flight", help="list/inspect flight dumps; --merge writes the cross-process trace")
     p_flight.add_argument("logdir", help="a run dir (or any ancestor) holding flight_*.json dumps")
     p_flight.add_argument("--show", help="specific dump to detail (default: the newest)")
@@ -351,7 +469,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     p_perf.add_argument("--head-runs", type=int, default=0, help="force HEAD = last N records instead of the newest-sha split")
     args = parser.parse_args(argv)
     if args.command == "tail":
-        return tail(args.logdir, follow=args.follow, interval=args.interval)
+        return tail(args.logdir, follow=args.follow, interval=args.interval, metrics_url=args.metrics_url)
+    if args.command == "mesh":
+        return mesh(args.logdir, max_layouts=args.max_layouts)
     if args.command == "flight":
         return flight(args.logdir, merge=args.merge, trace_id=args.trace_id, show=args.show)
     if args.command == "perf":
@@ -369,4 +489,12 @@ def main(argv: Optional[List[str]] = None) -> int:
 
 
 if __name__ == "__main__":  # pragma: no cover
-    raise SystemExit(main())
+    try:
+        raise SystemExit(main())
+    except BrokenPipeError:
+        # `... mesh <dir> | head` closes the pipe mid-render; that is the
+        # reader's choice, not an error worth a traceback.
+        import os as _os
+
+        _os.dup2(_os.open(_os.devnull, _os.O_WRONLY), sys.stdout.fileno())
+        raise SystemExit(0)

@@ -116,8 +116,13 @@ def make_record(
     breakdown: Optional[Dict[str, float]] = None,
     root: Optional[str] = None,
     direction: Optional[str] = None,
+    shards: Optional[Dict[str, float]] = None,
 ) -> Dict[str, Any]:
-    """One schema-versioned history record for a finished bench leg."""
+    """One schema-versioned history record for a finished bench leg.
+
+    ``shards`` carries the per-shard metric map (``{"data=0,model=0": mfu,
+    ...}``) behind a shard-imbalance leg, so the history keeps enough to
+    diagnose *which* shard drifted when the gate trips."""
     record: Dict[str, Any] = {
         "schema": SCHEMA_VERSION,
         "time": time.time(),
@@ -134,6 +139,8 @@ def make_record(
         record["breakdown"] = {k: float(v) for k, v in breakdown.items()}
     if goodput:
         record["goodput"] = {k: float(v) for k, v in goodput.items()}
+    if shards:
+        record["shards"] = {str(k): float(v) for k, v in shards.items()}
     if extra:
         record["extra"] = extra
     return record

@@ -169,11 +169,23 @@ class FlightRecorder:
             metrics = default_registry().snapshot()
         except Exception:  # noqa: BLE001
             metrics = {}
+        # Device provenance is resolved at record time, not construction:
+        # the recorder often exists before jax initializes devices, and
+        # jax-free processes (env workers) legitimately contribute nothing.
+        # Explicit run_info keys win over the resolved stamps.
+        run_info = dict(self.run_info)
+        try:
+            from sheeprl_tpu.telemetry.mesh_obs import device_provenance
+
+            for key, value in device_provenance().items():
+                run_info.setdefault(key, value)
+        except Exception:  # noqa: BLE001
+            pass
         return {
             "type": "process_meta",
             "pid": self.pid,
             "wall_s": time.time(),
-            "run_info": self.run_info,
+            "run_info": run_info,
             "metrics": metrics,
         }
 
@@ -519,6 +531,14 @@ def adopt_worker_process(
     rec = FlightRecorder(capacity=capacity, trace_dir=trace_dir, run_info=info)
     install(rec)
     ensure_live_tracer(capacity=capacity)
+    try:
+        # Seed the worker's registry so its spill metas always federate at
+        # least a liveness series into the merged /metrics endpoint.
+        from sheeprl_tpu.telemetry.registry import default_registry
+
+        default_registry().gauge("process/up").set(1.0)
+    except Exception:  # noqa: BLE001
+        pass
     if trace_dir is not None:
         rec.spill()  # visible to the parent's dumps even before first window
         # The adopt-time spill holds only the meta line; rewind the spill
@@ -575,6 +595,15 @@ class TracedEnv:
                 now - self._window_t0,
                 {"env": self._idx, "steps": self._every},
             )
+            try:
+                # Mirror into the worker's registry once per window (not per
+                # step) so the federated /metrics view carries live env
+                # throughput for every worker process.
+                from sheeprl_tpu.telemetry.registry import default_registry
+
+                default_registry().counter("env/steps").inc(float(self._every))
+            except Exception:  # noqa: BLE001
+                pass
             self._window_t0 = None
             rec = current()
             if rec is not None:

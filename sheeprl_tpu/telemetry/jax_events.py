@@ -40,6 +40,13 @@ _CACHE_COUNT_EVENTS = {
     "/jax/compilation_cache/cache_hits": "compile_cache_hits",
     "/jax/compilation_cache/cache_misses": "compile_cache_misses",
 }
+#: Substrings that mark a monitoring event as a device transfer. jax 0.4.37
+#: emits no transfer events yet (only the compile pipeline is instrumented),
+#: but the name family is reserved upstream — matching by substring means
+#: the runtime transfer ledger (core/mesh.py accounted puts) gains the
+#: runtime's own numbers the day the installed jax starts emitting them,
+#: with no code change here.
+_TRANSFER_NAME_PARTS = ("transfer", "device_put", "copy_to_host")
 
 _ACTIVE: List["JaxEventMonitor"] = []
 _LISTENERS_INSTALLED = False
@@ -63,9 +70,24 @@ def _registry_count(name: str, amount: float = 1.0) -> None:
         pass
 
 
+def _transfer_key(event: str) -> Optional[str]:
+    """Counter stem for a transfer-family monitoring event, else None."""
+    lowered = event.lower()
+    if not any(part in lowered for part in _TRANSFER_NAME_PARTS):
+        return None
+    stem = lowered.rsplit("/", 1)[-1] or "transfer"
+    return f"transfer_event_{stem}"
+
+
 def _on_event(event: str, **kwargs: Any) -> None:
     key = _CACHE_COUNT_EVENTS.get(event)
     if key is None:
+        tkey = _transfer_key(event)
+        if tkey is None:
+            return
+        _registry_count(f"jax/{tkey}")
+        for monitor in list(_ACTIVE):
+            monitor.counters[tkey] = monitor.counters.get(tkey, 0.0) + 1.0
         return
     _registry_count(f"jax/{key}")
     for monitor in list(_ACTIVE):
@@ -86,6 +108,15 @@ def _on_event_duration(event: str, duration_secs: float, **kwargs: Any) -> None:
             monitor.counters["trace_secs"] = monitor.counters.get("trace_secs", 0.0) + float(
                 duration_secs
             )
+    else:
+        tkey = _transfer_key(event)
+        if tkey is not None:
+            _registry_count(f"jax/{tkey}_calls")
+            _registry_count(f"jax/{tkey}_secs", float(duration_secs))
+            for monitor in list(_ACTIVE):
+                monitor.counters[f"{tkey}_secs"] = monitor.counters.get(
+                    f"{tkey}_secs", 0.0
+                ) + float(duration_secs)
 
 
 def _ensure_listeners() -> None:

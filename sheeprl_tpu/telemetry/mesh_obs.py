@@ -1,0 +1,565 @@
+"""Mesh observatory: per-shard attribution, topology rendering, federation.
+
+PR 16's shardlint (GL014-GL018) polices the mesh statically; this module is
+its runtime twin. ROADMAP item 1 (Sebulba scale-out) needs the learner's
+goodput *per shard*, because a sharded train step with one aggregate MFU
+number hides exactly the skew (one slow replica gates the allreduce) and
+resharding thrash that kill TPU utilization — the failure modes the Podracer
+report (arXiv:2104.06272) spends most of its pages on. Four readouts live
+here:
+
+- **per-shard flop attribution** — :func:`shares_from_aot` splits an AOT
+  ``cost_analysis()`` total across devices by weighting each input/output
+  array with the bytes its ``devices_indices_map`` places on each device.
+  The shares always sum to 1, so the per-shard MFU gauges the
+  :class:`~sheeprl_tpu.telemetry.perf.PerfAccountant` derives from them sum
+  exactly to the aggregate MFU;
+- **topology + layout serialization** — :func:`mesh_topology` and
+  :func:`param_layouts` turn a live ``jax.sharding.Mesh`` and a sharded
+  param tree into plain dicts that ride telemetry.jsonl, with stdlib-only
+  ASCII renderers (:func:`topology_ascii`, :func:`layout_ascii`) behind the
+  ``python -m sheeprl_tpu.telemetry mesh`` inspector;
+- **cross-process federation** — :class:`SpillMetricsSource` re-renders the
+  registry snapshots that sibling processes embed in their PR 11 flight
+  spills (``proc_<pid>.jsonl`` ``process_meta`` lines) as Prometheus text
+  with ``pid``/``role`` labels. It duck-types ``prometheus_text()``, so
+  ``merged_prometheus_text`` and the live :class:`MetricsExporter` treat it
+  as one more registry: ONE ``/metrics`` endpoint covers the trainer and
+  every spilling worker;
+- **scrape ingestion** — :func:`fetch_metrics_text` +
+  :func:`parse_prometheus_text` back ``telemetry tail --metrics-url``,
+  folding a running exporter into the same read-only live view.
+
+jax is imported lazily inside the functions that need a live mesh; module
+import stays stdlib-only so every ``python -m sheeprl_tpu.telemetry`` CLI
+path works on machines without (or before importing) jax.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+import urllib.request
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "shard_label",
+    "device_labels",
+    "device_provenance",
+    "mesh_topology",
+    "topology_ascii",
+    "shares_from_aot",
+    "uniform_shares",
+    "imbalance",
+    "param_layouts",
+    "layout_ascii",
+    "read_spill_metas",
+    "snapshot_prometheus_text",
+    "SpillMetricsSource",
+    "fetch_metrics_text",
+    "parse_prometheus_text",
+]
+
+#: Gauge namespace under the perf prefix: ``perf/shard/<label>/mfu``.
+SHARD_NS = "shard"
+
+
+# ------------------------------------------------------------- labels & topo
+def shard_label(coords: Dict[str, int]) -> str:
+    """Canonical device label from mesh coordinates: ``data=0,model=1``.
+    Axis order follows the mesh's own axis order (insertion order of
+    ``coords``), matching GL014's axis vocabulary."""
+    return ",".join(f"{axis}={int(idx)}" for axis, idx in coords.items())
+
+
+def device_labels(mesh: Any) -> Dict[int, str]:
+    """``{device_id: "data=i,model=j"}`` for every device in the mesh."""
+    import numpy as np
+
+    labels: Dict[int, str] = {}
+    axes = tuple(mesh.axis_names)
+    for coords, dev in np.ndenumerate(mesh.devices):
+        labels[dev.id] = shard_label(dict(zip(axes, coords)))
+    return labels
+
+
+def device_provenance() -> Dict[str, Any]:
+    """Backend/device identity of this process — ``{}`` when jax is not
+    already imported. Reads ``sys.modules`` only, never triggers the import:
+    flight spills from jax-free processes (env workers, CLI tools) must stay
+    cheap, while any process that ran device code gets attributable spills.
+    """
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return {}
+    try:
+        devices = jax.devices()
+        return {
+            "backend": jax.default_backend(),
+            "device_kind": getattr(devices[0], "device_kind", "") if devices else "",
+            "device_count": jax.device_count(),
+            "local_device_count": jax.local_device_count(),
+            "process_index": jax.process_index(),
+        }
+    except Exception:  # noqa: BLE001 - provenance must never break a spill
+        return {}
+
+
+def mesh_topology(mesh: Any) -> Dict[str, Any]:
+    """Serializable topology of a live mesh: axis names/sizes plus one entry
+    per device (id, coords, kind, owning process). This is what the
+    ``telemetry mesh`` inspector renders back without importing jax."""
+    import numpy as np
+
+    axes = tuple(mesh.axis_names)
+    devices: List[Dict[str, Any]] = []
+    for coords, dev in np.ndenumerate(mesh.devices):
+        devices.append(
+            {
+                "id": int(dev.id),
+                "coords": {axis: int(i) for axis, i in zip(axes, coords)},
+                "kind": getattr(dev, "device_kind", ""),
+                "process_index": int(getattr(dev, "process_index", 0)),
+            }
+        )
+    return {
+        "axis_names": list(axes),
+        "axis_sizes": {axis: int(size) for axis, size in mesh.shape.items()},
+        "devices": devices,
+    }
+
+
+def topology_ascii(topo: Dict[str, Any]) -> str:
+    """Render a serialized topology as a device-id grid: first axis down,
+    remaining axes (flattened) across. Stdlib-only."""
+    axes: List[str] = list(topo.get("axis_names") or [])
+    sizes: Dict[str, int] = {k: int(v) for k, v in (topo.get("axis_sizes") or {}).items()}
+    devices: List[Dict[str, Any]] = list(topo.get("devices") or [])
+    if not axes or not devices:
+        return "(empty mesh)\n"
+    rows = sizes.get(axes[0], 1)
+    cols = 1
+    for axis in axes[1:]:
+        cols *= sizes.get(axis, 1)
+
+    def flat_col(coords: Dict[str, Any]) -> int:
+        idx = 0
+        for axis in axes[1:]:
+            idx = idx * sizes.get(axis, 1) + int(coords.get(axis, 0))
+        return idx
+
+    grid: List[List[str]] = [["?"] * cols for _ in range(rows)]
+    for dev in devices:
+        coords = dev.get("coords") or {}
+        r = int(coords.get(axes[0], 0))
+        c = flat_col(coords)
+        if 0 <= r < rows and 0 <= c < cols:
+            grid[r][c] = str(dev.get("id", "?"))
+    shape = " x ".join(f"{axes_i}={sizes.get(axes_i, 1)}" for axes_i in axes)
+    width = max(3, max(len(cell) for row in grid for cell in row))
+    lines = [f"mesh ({shape}), {len(devices)} devices"]
+    header = " " * (len(axes[0]) + 3) + " ".join(
+        f"{axis_label:>{width}}" for axis_label in (_col_labels(axes[1:], sizes, cols))
+    )
+    lines.append(header.rstrip())
+    for r, row in enumerate(grid):
+        lines.append(f"{axes[0]}={r:<2} " + " ".join(f"[{cell:>{width - 2}}]" for cell in row))
+    return "\n".join(lines) + "\n"
+
+
+def _col_labels(axes: Sequence[str], sizes: Dict[str, int], cols: int) -> List[str]:
+    if not axes:
+        return [""] * cols
+    labels = []
+    for c in range(cols):
+        rem, parts = c, []
+        for axis in reversed(axes):
+            size = max(sizes.get(axis, 1), 1)
+            parts.append(rem % size)
+            rem //= size
+        parts.reverse()
+        labels.append("/".join(str(p) for p in parts))
+    return labels
+
+
+# -------------------------------------------------- per-shard flop attribution
+def _slice_nelems(index: Tuple[Any, ...], shape: Sequence[int]) -> int:
+    """Element count of one device's slice from ``devices_indices_map``."""
+    n = 1
+    for sl, dim in zip(index, shape):
+        start = sl.start if sl.start is not None else 0
+        stop = sl.stop if sl.stop is not None else int(dim)
+        n *= max(int(stop) - int(start), 0)
+    return n
+
+
+def _accumulate_weights(weights: Dict[int, float], shape: Sequence[int], dtype: Any, sharding: Any) -> None:
+    import numpy as np
+
+    try:
+        itemsize = float(np.dtype(dtype).itemsize)
+    except TypeError:
+        itemsize = 4.0
+    index_map = sharding.devices_indices_map(tuple(int(d) for d in shape))
+    for dev, index in index_map.items():
+        nbytes = _slice_nelems(index, shape) * itemsize
+        weights[dev.id] = weights.get(dev.id, 0.0) + nbytes
+
+
+def shares_from_aot(lowered: Any, compiled: Any) -> Optional[Dict[int, float]]:
+    """Per-device fraction of one dispatch's work, from the AOT pair the
+    cost harvest already produced.
+
+    XLA's ``cost_analysis`` is a program total; the executable's in/out
+    shardings say where the operands live. Weighting every input and output
+    array by the bytes each device holds (via ``devices_indices_map``, which
+    handles NamedSharding, GSPMD-propagated, and single-device layouts
+    uniformly) gives a distribution over devices that tracks how GSPMD
+    actually splits the math: batch-sharded operands put 1/N of their bytes
+    per data shard, replicated params weight every shard equally. Returns
+    fractions summing to 1.0, or None when the executable exposes no
+    shardings (the caller degrades to uniform shares)."""
+    import jax
+
+    weights: Dict[int, float] = {}
+    try:
+        in_avals = lowered.in_avals
+        in_shardings = compiled.input_shardings
+        out_info = lowered.out_info
+        out_shardings = compiled.output_shardings
+    except Exception:  # noqa: BLE001 - AOT surface varies across jax versions
+        return None
+
+    def _is_spec(x: Any) -> bool:
+        return hasattr(x, "shape") and hasattr(x, "dtype")
+
+    def _is_sharding(x: Any) -> bool:
+        return hasattr(x, "devices_indices_map")
+
+    try:
+        flat_in = jax.tree_util.tree_leaves(in_avals, is_leaf=_is_spec)
+        flat_in_sh = jax.tree_util.tree_leaves(in_shardings, is_leaf=_is_sharding)
+        flat_out = jax.tree_util.tree_leaves(out_info, is_leaf=_is_spec)
+        flat_out_sh = jax.tree_util.tree_leaves(out_shardings, is_leaf=_is_sharding)
+        pairs = []
+        if len(flat_in) == len(flat_in_sh):
+            pairs.extend(zip(flat_in, flat_in_sh))
+        if len(flat_out) == len(flat_out_sh):
+            pairs.extend(zip(flat_out, flat_out_sh))
+        for spec, sharding in pairs:
+            if not (_is_spec(spec) and _is_sharding(sharding)):
+                continue
+            _accumulate_weights(weights, tuple(spec.shape), spec.dtype, sharding)
+    except Exception:  # noqa: BLE001 - a metric must never crash the publish
+        return None
+    total = sum(weights.values())
+    if total <= 0.0:
+        return None
+    return {dev_id: w / total for dev_id, w in weights.items()}
+
+
+def uniform_shares(device_ids: Iterable[int]) -> Dict[int, float]:
+    """Even split across ``device_ids`` — the degraded fallback that keeps
+    the sum-to-aggregate invariant when a key's shardings are unavailable."""
+    ids = [int(d) for d in device_ids]
+    if not ids:
+        return {}
+    share = 1.0 / len(ids)
+    return {d: share for d in ids}
+
+
+def imbalance(values: Iterable[float]) -> float:
+    """Max/mean skew of per-shard work: 1.0 when perfectly even, →N when one
+    of N shards does everything. 1.0 on empty/zero input (no work is not
+    skew)."""
+    vals = [float(v) for v in values if math.isfinite(float(v))]
+    if not vals:
+        return 1.0
+    mean = sum(vals) / len(vals)
+    if mean <= 0.0:
+        return 1.0
+    return max(vals) / mean
+
+
+# --------------------------------------------------------------- param layouts
+def param_layouts(tree: Any, max_leaves: int = 24) -> List[Dict[str, Any]]:
+    """Serializable sharding layout of up to ``max_leaves`` array leaves:
+    dotted path name, shape/dtype, the PartitionSpec (when named), and each
+    device's index ranges from ``devices_indices_map``. What the
+    ``telemetry mesh`` inspector renders as visualize-sharding-style grids.
+    """
+    import jax
+
+    layouts: List[Dict[str, Any]] = []
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    for path, leaf in flat:
+        if len(layouts) >= max_leaves:
+            break
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is None or not hasattr(leaf, "shape"):
+            continue
+        shape = tuple(int(d) for d in leaf.shape)
+        entry: Dict[str, Any] = {
+            "name": _path_name(path),
+            "shape": list(shape),
+            "dtype": str(getattr(leaf, "dtype", "")),
+        }
+        spec = getattr(sharding, "spec", None)
+        if spec is not None:
+            entry["spec"] = str(spec)
+        try:
+            index_map = sharding.devices_indices_map(shape)
+            entry["devices"] = {
+                str(dev.id): [
+                    [
+                        int(sl.start) if sl.start is not None else 0,
+                        int(sl.stop) if sl.stop is not None else int(dim),
+                    ]
+                    for sl, dim in zip(index, shape)
+                ]
+                for dev, index in index_map.items()
+            }
+        except Exception:  # noqa: BLE001 - unsupported layout: name+shape only
+            pass
+        layouts.append(entry)
+    return layouts
+
+
+def _path_name(path: Tuple[Any, ...]) -> str:
+    parts = []
+    for entry in path:
+        key = getattr(entry, "key", None)
+        if key is None:
+            key = getattr(entry, "idx", None)
+        if key is None:
+            key = getattr(entry, "name", None)
+        parts.append(str(key) if key is not None else str(entry))
+    return "/".join(parts) or "<root>"
+
+
+def layout_ascii(layout: Dict[str, Any]) -> str:
+    """Render one :func:`param_layouts` entry as an ASCII block grid in the
+    style of ``jax.debug.visualize_array_sharding``: one cell per distinct
+    block, listing the devices that hold it (replicas group together).
+    Stdlib-only; degrades to a one-line summary when index ranges are
+    missing."""
+    shape = [int(d) for d in layout.get("shape") or []]
+    head = f"{layout.get('name', '?')}  ({', '.join(str(d) for d in shape)}) {layout.get('dtype', '')}"
+    if layout.get("spec"):
+        head += f"  {layout['spec']}"
+    devices: Dict[str, List[List[int]]] = layout.get("devices") or {}
+    if not devices or not shape:
+        return head + "\n"
+    # Group devices by their block (identical index ranges = replicas).
+    blocks: Dict[Tuple[Tuple[int, int], ...], List[int]] = {}
+    for dev_id, ranges in sorted(devices.items(), key=lambda kv: int(kv[0])):
+        key = tuple((int(lo), int(hi)) for lo, hi in ranges)
+        blocks.setdefault(key, []).append(int(dev_id))
+    # Lay blocks out on the first two partitioned dims (row-major).
+    dim_starts: List[List[int]] = [sorted({blk[d][0] for blk in blocks}) for d in range(len(shape))]
+    split_dims = [d for d, starts in enumerate(dim_starts) if len(starts) > 1]
+    row_dim = split_dims[0] if split_dims else 0
+    col_dim = split_dims[1] if len(split_dims) > 1 else None
+    rows = dim_starts[row_dim] if split_dims else [0]
+    cols = dim_starts[col_dim] if col_dim is not None else [None]
+    cells: List[List[str]] = []
+    for r in rows:
+        row_cells = []
+        for c in cols:
+            members = [
+                ids
+                for blk, ids in blocks.items()
+                if blk[row_dim][0] == r and (c is None or blk[col_dim][0] == c)
+            ]
+            ids = sorted(i for group in members for i in group)
+            row_cells.append(",".join(str(i) for i in ids) if ids else "-")
+        cells.append(row_cells)
+    width = max(5, max(len(cell) for row in cells for cell in row) + 2)
+    sep = "+" + "+".join("-" * width for _ in cells[0]) + "+"
+    lines = [head, sep]
+    for row in cells:
+        lines.append("|" + "|".join(f"{cell:^{width}}" for cell in row) + "|")
+        lines.append(sep)
+    return "\n".join(lines) + "\n"
+
+
+# ----------------------------------------------------------------- federation
+def read_spill_metas(spill_dir: str, exclude_pids: Iterable[int] = ()) -> List[Dict[str, Any]]:
+    """The ``process_meta`` line of every flight spill in ``spill_dir``
+    (``proc_<pid>.jsonl``), skipping ``exclude_pids``. Each meta carries the
+    spilling process's run_info and a full registry snapshot — the federated
+    metric substrate. Torn or foreign files are skipped, never fatal."""
+    metas: List[Dict[str, Any]] = []
+    excluded = {int(p) for p in exclude_pids}
+    try:
+        names = sorted(os.listdir(spill_dir))
+    except OSError:
+        return metas
+    for name in names:
+        if not (name.startswith("proc_") and name.endswith(".jsonl")):
+            continue
+        try:
+            pid = int(name[len("proc_") : -len(".jsonl")])
+        except ValueError:
+            continue
+        if pid in excluded:
+            continue
+        try:
+            with open(os.path.join(spill_dir, name), "r", encoding="utf-8") as fp:
+                for line in fp:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    rec = json.loads(line)
+                    if isinstance(rec, dict) and rec.get("type") == "process_meta":
+                        metas.append(rec)
+                    break  # the meta is the first record of every spill
+        except (OSError, json.JSONDecodeError):
+            continue
+    return metas
+
+
+def snapshot_prometheus_text(snapshot: Dict[str, Any], labels: Optional[Dict[str, Any]] = None) -> str:
+    """Render a registry ``snapshot()`` dict as Prometheus text 0.0.4 with a
+    fixed label set (``{pid="...",role="..."}``). Counters keep their
+    ``_total`` suffix; histogram summaries render as ``_sum``/``_count``.
+    The labels keep federated series from colliding with the local
+    registry's unlabeled series of the same name."""
+    from sheeprl_tpu.telemetry.registry import prometheus_name
+
+    label_str = ""
+    if labels:
+        inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in labels.items())
+        label_str = "{" + inner + "}"
+    lines: List[str] = []
+    for name, value in sorted((snapshot.get("counters") or {}).items()):
+        pname = prometheus_name(name)
+        lines.append(f"{pname}_total{label_str} {_num(value)}")
+    for name, value in sorted((snapshot.get("gauges") or {}).items()):
+        pname = prometheus_name(name)
+        lines.append(f"{pname}{label_str} {_num(value)}")
+    for name, summary in sorted((snapshot.get("histograms") or {}).items()):
+        if not isinstance(summary, dict):
+            continue
+        pname = prometheus_name(name)
+        if "sum" in summary:
+            lines.append(f"{pname}_sum{label_str} {_num(summary['sum'])}")
+        if "count" in summary:
+            lines.append(f"{pname}_count{label_str} {_num(summary['count'])}")
+    return "\n".join(lines) + "\n" if lines else ""
+
+
+def _escape_label(value: Any) -> str:
+    return str(value).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _num(value: Any) -> str:
+    try:
+        f = float(value)
+    except (TypeError, ValueError):
+        return "0"
+    if f.is_integer() and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class SpillMetricsSource:
+    """Live federated metric source over a flight spill directory.
+
+    Duck-types ``prometheus_text()`` so ``merged_prometheus_text`` and the
+    :class:`~sheeprl_tpu.telemetry.registry.MetricsExporter` treat it as one
+    more registry: every scrape re-reads the sibling ``proc_<pid>.jsonl``
+    metas (cheap — first line of a handful of small files) and re-renders
+    their registry snapshots with ``pid``/``role`` labels. The trainer's own
+    pid is excluded; its live registry is already on the endpoint."""
+
+    def __init__(self, spill_dir: str, exclude_pids: Iterable[int] = ()) -> None:
+        self.spill_dir = str(spill_dir)
+        self.exclude_pids = tuple(int(p) for p in exclude_pids)
+
+    def prometheus_text(self) -> str:
+        parts: List[str] = []
+        for meta in read_spill_metas(self.spill_dir, self.exclude_pids):
+            run_info = meta.get("run_info") or {}
+            labels = {"pid": meta.get("pid", "?")}
+            role = run_info.get("role") or run_info.get("algo") or ("env" if "env" in run_info else None)
+            if role is not None:
+                labels["role"] = role
+            text = snapshot_prometheus_text(meta.get("metrics") or {}, labels)
+            if text:
+                parts.append(text)
+        return "".join(parts)
+
+
+# ------------------------------------------------------------ scrape ingestion
+def fetch_metrics_text(url: str, timeout: float = 3.0) -> str:
+    """GET a ``/metrics`` endpoint (http/https only), returning the body as
+    text. Read-only and stdlib-only for ``telemetry tail --metrics-url``."""
+    if not url.startswith(("http://", "https://")):
+        raise ValueError(f"--metrics-url must be http(s), got {url!r}")
+    with urllib.request.urlopen(url, timeout=timeout) as resp:  # noqa: S310 - scheme checked above
+        return resp.read().decode("utf-8", errors="replace")
+
+
+def parse_prometheus_text(text: str) -> Dict[str, Dict[str, float]]:
+    """Parse Prometheus text 0.0.4 into ``{"counters", "gauges"}`` keyed by
+    sample name (labels kept verbatim in the key). ``# TYPE`` lines decide
+    the kind; untyped samples with a ``_total`` suffix count as counters,
+    anything else as a gauge. Unparseable lines are skipped."""
+    types: Dict[str, str] = {}
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, float] = {}
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        # Sample: name{labels} value [timestamp] — split on the last space
+        # run outside braces.
+        name, value = _split_sample(line)
+        if name is None or value is None:
+            continue
+        bare = name.split("{", 1)[0]
+        kind = types.get(bare)
+        if kind is None and bare.endswith("_total"):
+            kind = "counter"
+        if kind is None:
+            for suffix in ("_bucket", "_sum", "_count"):
+                if bare.endswith(suffix) and types.get(bare[: -len(suffix)]) == "histogram":
+                    kind = "histogram_part"
+                    break
+        if kind == "counter" or (kind is None and bare.endswith("_total")):
+            counters[name] = value
+        elif kind in (None, "gauge"):
+            gauges[name] = value
+        # histogram parts are folded away: the tail view shows scalars
+    return {"counters": counters, "gauges": gauges}
+
+
+def _split_sample(line: str) -> Tuple[Optional[str], Optional[float]]:
+    depth = 0
+    split_at = -1
+    for i, ch in enumerate(line):
+        if ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth = max(depth - 1, 0)
+        elif ch in (" ", "\t") and depth == 0:
+            split_at = i
+            break
+    if split_at < 0:
+        return None, None
+    name = line[:split_at]
+    rest = line[split_at:].split()
+    if not rest:
+        return None, None
+    try:
+        return name, float(rest[0])
+    except ValueError:
+        return None, None

@@ -197,15 +197,37 @@ def _arg_specs(tree: Any) -> Any:
     """Shape/dtype specs for a pytree of (possibly soon-donated) arrays.
     Array-likes become ``jax.ShapeDtypeStruct``; everything else (python
     scalars, None) passes through verbatim so weak-typing matches the real
-    call and ``lower`` resolves to the SAME executable the loop compiled."""
+    call and ``lower`` resolves to the SAME executable the loop compiled.
+    The leaf's sharding rides along when present — without it the deferred
+    lowering sees single-device inputs and the per-shard attribution
+    (mesh_obs.shares_from_aot) would pile every flop onto device 0."""
     import jax
 
     def spec(leaf: Any) -> Any:
         if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
-            return jax.ShapeDtypeStruct(tuple(leaf.shape), leaf.dtype)
+            sharding = getattr(leaf, "sharding", None)
+            try:
+                return jax.ShapeDtypeStruct(tuple(leaf.shape), leaf.dtype, sharding=sharding)
+            except TypeError:
+                return jax.ShapeDtypeStruct(tuple(leaf.shape), leaf.dtype)
         return leaf
 
     return jax.tree_util.tree_map(spec, tree)
+
+
+def _cost_from_compiled(compiled: Any) -> Optional[Dict[str, float]]:
+    """FLOPs + bytes accessed from an already-compiled executable's
+    ``cost_analysis()``; None when the backend exposes no cost model."""
+    analysis = compiled.cost_analysis()
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else {}
+    if not isinstance(analysis, dict):
+        return None
+    flops = float(analysis.get("flops", 0.0))
+    bytes_accessed = float(analysis.get("bytes accessed", 0.0))
+    if flops <= 0.0 and bytes_accessed <= 0.0:
+        return None
+    return {"flops": max(flops, 0.0), "bytes": max(bytes_accessed, 0.0)}
 
 
 def jit_cost(fn: Any, args: Tuple[Any, ...] = (), kwargs: Optional[Dict[str, Any]] = None) -> Optional[Dict[str, float]]:
@@ -216,17 +238,7 @@ def jit_cost(fn: Any, args: Tuple[Any, ...] = (), kwargs: Optional[Dict[str, Any
     to time-only accounting, never crash a train loop over a metric."""
     try:
         lowered = fn.lower(*args, **(kwargs or {}))
-        compiled = lowered.compile()
-        analysis = compiled.cost_analysis()
-        if isinstance(analysis, (list, tuple)):
-            analysis = analysis[0] if analysis else {}
-        if not isinstance(analysis, dict):
-            return None
-        flops = float(analysis.get("flops", 0.0))
-        bytes_accessed = float(analysis.get("bytes accessed", 0.0))
-        if flops <= 0.0 and bytes_accessed <= 0.0:
-            return None
-        return {"flops": max(flops, 0.0), "bytes": max(bytes_accessed, 0.0)}
+        return _cost_from_compiled(lowered.compile())
     except Exception:
         return None
 
@@ -247,6 +259,7 @@ class PerfAccountant:
         peak_hbm_gbps: Optional[float] = None,
         probe: bool = True,
         max_harvests: int = 16,
+        per_shard: bool = True,
     ) -> None:
         self.enabled = bool(enabled)
         self.prefix = prefix
@@ -256,6 +269,7 @@ class PerfAccountant:
         self._peak_bw_cfg = peak_hbm_gbps * 1e9 if peak_hbm_gbps else None
         self._probe = bool(probe)
         self._max_harvests = int(max_harvests)
+        self._per_shard = bool(per_shard)
         self._lock = threading.Lock()
         self._specs: Dict[str, Tuple[Any, Any, Any]] = {}  # graftlint: guarded-by(self._lock)
         self._costs: Dict[str, Dict[str, float]] = {}  # graftlint: guarded-by(self._lock)
@@ -264,11 +278,30 @@ class PerfAccountant:
         self._infeed_s = 0.0  # graftlint: guarded-by(self._lock)
         self._compute_s = 0.0  # graftlint: guarded-by(self._lock)
         self.harvest_failures = 0
+        # Mesh attribution state: the live mesh (set_mesh), per-key device
+        # shares from the AOT shardings, and the per-device running totals
+        # the interval differencing anchors against.
+        self._mesh: Optional[Any] = None  # graftlint: guarded-by(self._lock)
+        self._shard_shares: Dict[str, Dict[int, float]] = {}  # graftlint: guarded-by(self._lock)
+        self._prev_shard: Dict[int, float] = {}  # graftlint: guarded-by(self._lock)
+        self._dev_labels: Optional[Dict[int, str]] = None  # graftlint: guarded-by(self._lock)
         # Interval state: wall anchor starts at first recorded activity so
         # the first published interval measures the loop, not agent init.
         self._anchor: Optional[float] = None
         self._prev: Dict[str, float] = {"flops": 0.0, "bytes": 0.0, "steps": 0.0, "compute_s": 0.0, "infeed_s": 0.0, "timer_s": 0.0}
         self.last_gauges: Dict[str, float] = {}
+
+    def set_mesh(self, mesh: Any) -> None:
+        """Attach the live device mesh so publish() also splits the flop
+        totals per shard (``perf/shard/<label>/mfu``, HBM occupancy, and the
+        max/mean imbalance gauge). Safe to call more than once; a mesh swap
+        resets the per-device differencing anchors."""
+        if not self.enabled or mesh is None:
+            return
+        with self._lock:
+            self._mesh = mesh
+            self._prev_shard = {}
+            self._dev_labels = None
 
     # ------------------------------------------------------------- hot path
     def note(self, key: str, fn: Any = None, args: Tuple[Any, ...] = (), kwargs: Optional[Dict[str, Any]] = None, steps: float = 1.0) -> None:
@@ -335,18 +368,63 @@ class PerfAccountant:
     def _harvest_pending(self) -> None:
         """Resolve every deferred cost harvest. Runs at publish time (log
         interval), never on the dispatch path; a failed harvest is recorded
-        and not retried (the key degrades to count-only accounting)."""
+        and not retried (the key degrades to count-only accounting). One
+        lower/compile serves both the cost total and — when a mesh is
+        attached — the per-device shares from the executable's shardings."""
         with self._lock:
             pending = list(self._specs.items())
             self._specs.clear()
+            want_shares = self._per_shard and self._mesh is not None
         for key, (fn, specs, kwargs) in pending:
-            cost = jit_cost(fn, specs, kwargs)
+            cost = None
+            shares = None
+            try:
+                lowered = fn.lower(*specs, **(kwargs or {}))
+                compiled = lowered.compile()
+                cost = _cost_from_compiled(compiled)
+                if want_shares and cost is not None:
+                    from sheeprl_tpu.telemetry import mesh_obs
+
+                    shares = mesh_obs.shares_from_aot(lowered, compiled)
+            except Exception:  # noqa: BLE001 - degrade, never crash the loop
+                cost = None
             with self._lock:
                 if cost is None:
                     self.harvest_failures += 1
                     self._costs[key] = {"flops": 0.0, "bytes": 0.0}
                 else:
                     self._costs[key] = cost
+                if shares:
+                    self._shard_shares[key] = shares
+
+    def _shard_interval_locked(self) -> Tuple[Optional[Dict[int, float]], Dict[int, str], Dict[int, Any]]:
+        """Per-device flop deltas for this interval (caller holds the lock).
+
+        Every mesh device starts at 0.0 so idle shards still weigh into the
+        imbalance denominator; keys without harvested shares split uniformly
+        across the mesh, preserving Σ(shard flops) == aggregate flops — the
+        invariant that makes the per-shard MFU gauges sum to ``perf/mfu``.
+        Returns ``(deltas, labels, devices)`` or ``(None, {}, {})`` when no
+        mesh is attached."""
+        if not self._per_shard or self._mesh is None:
+            return None, {}, {}
+        from sheeprl_tpu.telemetry import mesh_obs
+
+        if self._dev_labels is None:
+            self._dev_labels = mesh_obs.device_labels(self._mesh)
+        mesh_devices = {int(d.id): d for d in self._mesh.devices.flat}
+        totals: Dict[int, float] = {dev_id: 0.0 for dev_id in mesh_devices}
+        for key, cost in self._costs.items():
+            count = self._counts.get(key, 0)
+            flops = cost.get("flops", 0.0)
+            if count <= 0 or flops <= 0.0:
+                continue
+            shares = self._shard_shares.get(key) or mesh_obs.uniform_shares(mesh_devices)
+            for dev_id, share in shares.items():
+                totals[dev_id] = totals.get(dev_id, 0.0) + count * flops * share
+        deltas = {dev_id: max(total - self._prev_shard.get(dev_id, 0.0), 0.0) for dev_id, total in totals.items()}
+        self._prev_shard = totals
+        return deltas, dict(self._dev_labels), mesh_devices
 
     def publish(self, step_timer: Any = None, tracer: Any = None, registry: Any = None) -> Dict[str, float]:
         """Compute the interval's goodput gauges and push them to the tracer
@@ -387,6 +465,7 @@ class PerfAccountant:
                 "timer_s": timer_total,
             }
             peaks = self._resolve_peaks_locked()
+            shard_d, shard_labels, mesh_devices = self._shard_interval_locked()
 
         # Breakdown fractions: compute + infeed measured on the loop thread,
         # host is the remainder. Pipelined overlap can push the measured sum
@@ -413,6 +492,23 @@ class PerfAccountant:
         if peaks["bytes_per_s"] > 0.0:
             gauges[f"{p}/hbm_bw_util"] = bytes_d / (wall * peaks["bytes_per_s"])
             gauges[f"{p}/peak_hbm_bytes_per_s"] = peaks["bytes_per_s"]
+
+        if shard_d is not None:
+            from sheeprl_tpu.telemetry import mesh_obs
+
+            if peaks["flops"] > 0.0:
+                for dev_id in sorted(shard_d):
+                    label = shard_labels.get(dev_id, f"device={dev_id}")
+                    gauges[f"{p}/{mesh_obs.SHARD_NS}/{label}/mfu"] = shard_d[dev_id] / (wall * peaks["flops"])
+            gauges[f"{p}/shard_imbalance"] = mesh_obs.imbalance(shard_d.values())
+            for dev_id, dev in mesh_devices.items():
+                try:
+                    stats = dev.memory_stats()
+                except Exception:  # noqa: BLE001 - optional per-backend API
+                    stats = None
+                if isinstance(stats, dict) and "bytes_in_use" in stats:
+                    label = shard_labels.get(dev_id, f"device={dev_id}")
+                    gauges[f"{p}/{mesh_obs.SHARD_NS}/{label}/hbm_bytes_in_use"] = float(stats["bytes_in_use"])
 
         if tracer is not None:
             for name, value in gauges.items():

@@ -31,7 +31,7 @@ from __future__ import annotations
 
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Sequence
 
 from sheeprl_tpu.telemetry.histogram import Histogram
 
@@ -233,9 +233,12 @@ def _fmt(value: float) -> str:
     return repr(f)
 
 
-def merged_prometheus_text(registries: Iterable[MetricsRegistry]) -> str:
-    """Concatenate the renderings of several registries (e.g. the serving
-    engine's own registry plus the process default one)."""
+def merged_prometheus_text(registries: Iterable[Any]) -> str:
+    """Concatenate the renderings of several metric sources (e.g. the
+    serving engine's own registry plus the process default one). Duck-typed:
+    anything with a ``prometheus_text()`` method qualifies, which is how
+    federated sources like :class:`~sheeprl_tpu.telemetry.mesh_obs.
+    SpillMetricsSource` ride the same endpoint as live registries."""
     parts = []
     seen: set = set()
     for reg in registries:
@@ -248,13 +251,20 @@ def merged_prometheus_text(registries: Iterable[MetricsRegistry]) -> str:
 
 # ---------------------------------------------------------------- exporter
 class _MetricsHandler(BaseHTTPRequestHandler):
-    registries: Tuple[MetricsRegistry, ...] = ()
+    # Resolved per request so the registry set is LIVE: sources registered
+    # after exporter startup (per-replica registries, federation) appear on
+    # the next scrape instead of being frozen out at construction time.
+    registries_fn: Any = staticmethod(lambda: ())
 
     def do_GET(self) -> None:  # noqa: N802 - BaseHTTPRequestHandler API
         if self.path.split("?")[0] not in ("/metrics", "/"):
             self.send_error(404)
             return
-        body = merged_prometheus_text(self.registries).encode("utf-8")
+        try:
+            registries = tuple(type(self).registries_fn())
+        except Exception:  # noqa: BLE001 - a bad supplier must not kill the scrape
+            registries = ()
+        body = merged_prometheus_text(registries).encode("utf-8")
         self.send_response(200)
         self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
         self.send_header("Content-Length", str(len(body)))
@@ -270,10 +280,23 @@ class MetricsExporter:
 
     Stdlib ThreadingHTTPServer on a daemon thread: no dependency, no
     interference with the train loop (rendering happens on the scraper's
-    connection thread and only takes the registry locks briefly)."""
+    connection thread and only takes the registry locks briefly).
 
-    def __init__(self, port: int, registries: Sequence[MetricsRegistry], host: str = "0.0.0.0") -> None:
-        handler = type("_BoundMetricsHandler", (_MetricsHandler,), {"registries": tuple(registries)})
+    ``registries`` is either a sequence of metric sources or a zero-arg
+    callable returning one; a callable (or a mutable sequence held by the
+    caller) makes the set live — every scrape re-resolves it, so sources
+    created after startup are visible without restarting the exporter."""
+
+    def __init__(self, port: int, registries: Any, host: str = "0.0.0.0") -> None:
+        if callable(registries):
+            supplier = registries
+        else:
+            held = registries  # live by reference: caller may append later
+
+            def supplier() -> Sequence[Any]:
+                return tuple(held)
+
+        handler = type("_BoundMetricsHandler", (_MetricsHandler,), {"registries_fn": staticmethod(supplier)})
         self._http = ThreadingHTTPServer((host, int(port)), handler)
         self._http.daemon_threads = True
         self._thread = threading.Thread(target=self._http.serve_forever, name="metrics-exporter", daemon=True)

@@ -65,11 +65,14 @@ class Telemetry:
         perf_probe: bool = True,
         perf_peak_flops: Optional[float] = None,
         perf_peak_hbm_gbps: Optional[float] = None,
+        perf_per_shard: bool = True,
+        federate_metrics: bool = True,
     ) -> None:
         self.enabled = bool(enabled)
         self.chrome_trace = bool(chrome_trace)
         self.jsonl = bool(jsonl)
         self.metrics_port = int(metrics_port) if metrics_port is not None else None
+        self.federate_metrics = bool(federate_metrics)
         # Flight recorder knobs: deliberately independent of `enabled` — the
         # crash ring is always-on unless explicitly switched off.
         self.flight_enabled = bool(flight_enabled)
@@ -94,6 +97,7 @@ class Telemetry:
             probe=bool(perf_probe),
             peak_flops=perf_peak_flops,
             peak_hbm_gbps=perf_peak_hbm_gbps,
+            per_shard=bool(perf_per_shard),
         )
         self._step_timers: Dict[str, StepTimer] = {}
         self._log_dir: Optional[str] = None
@@ -112,6 +116,8 @@ class Telemetry:
         self._carrier_prev: Optional[tuple] = None
         self._flight: Optional[flight_mod.FlightRecorder] = None
         self._flight_tracer: Optional[Tracer] = None
+        # Federated metric source over sibling flight spills (mesh_obs).
+        self._federation: Any = None
 
     # ------------------------------------------------------------- config
     @classmethod
@@ -130,6 +136,8 @@ class Telemetry:
             perf_probe=bool(perf.get("probe", True)),
             perf_peak_flops=perf.get("peak_flops"),
             perf_peak_hbm_gbps=perf.get("peak_hbm_gbps"),
+            perf_per_shard=bool(perf.get("per_shard", True)),
+            federate_metrics=bool(tele.get("federate_metrics", True)),
             flight_enabled=bool(fl.get("enabled", True)),
             flight_capacity=int(fl.get("capacity", 4096)),
             flight_spill_interval_s=float(fl.get("spill_interval_s", 5.0)),
@@ -171,8 +179,19 @@ class Telemetry:
         if self.metrics_port is not None and self._rank_zero:
             from sheeprl_tpu.telemetry.registry import MetricsExporter, default_registry
 
+            def _metric_sources() -> list:
+                # Resolved per scrape: the default registry is re-fetched (it
+                # may be reset) and the federated spill source — created by
+                # _open_tracing, possibly after the exporter — appears as
+                # soon as it exists. This is the ONE merged endpoint covering
+                # the trainer plus every spilling sibling process.
+                sources: list = [default_registry()]
+                if self._federation is not None:
+                    sources.append(self._federation)
+                return sources
+
             try:
-                self._exporter = MetricsExporter(self.metrics_port, [default_registry()])
+                self._exporter = MetricsExporter(self.metrics_port, _metric_sources)
             except OSError as err:
                 warnings.warn(f"telemetry.metrics_port={self.metrics_port} unavailable ({err}); exporter disabled")
         if self._jsonl_path() is not None:
@@ -198,6 +217,8 @@ class Telemetry:
                     ),
                     "host": bench_db.host_fingerprint(),
                     "device": getattr(jax.devices()[0], "device_kind", ""),
+                    "device_count": jax.device_count(),
+                    "local_device_count": jax.local_device_count(),
                 },
                 mode="w",
             )
@@ -232,6 +253,12 @@ class Telemetry:
                 run_info={"role": "trainer"},
             )
             flight_mod.install(self._flight)
+            if self.federate_metrics and trace_dir is not None:
+                from sheeprl_tpu.telemetry import mesh_obs
+
+                self._federation = mesh_obs.SpillMetricsSource(
+                    trace_dir, exclude_pids=(os.getpid(),)
+                )
             if not self.enabled:
                 # Telemetry off still means a populated crash ring: give the
                 # process a live tracer feeding the flight sink.
@@ -246,6 +273,7 @@ class Telemetry:
         if self._flight is not None:
             flight_mod.uninstall(self._flight)
             self._flight = None
+        self._federation = None
         if self._flight_tracer is not None:
             if tracer_mod.current() is self._flight_tracer:
                 tracer_mod.set_current(None)
@@ -431,6 +459,40 @@ class Telemetry:
         """Annotate this process in flight dumps (algo name, rank, role)."""
         if self._flight is not None:
             self._flight.run_info.update(info)
+
+    def set_mesh(self, mesh: Any) -> None:
+        """Attach the run's device mesh: arms the accountant's per-shard
+        goodput split, stamps the axis sizes into flight ``run_info``, and
+        appends a serialized ``{"type": "mesh"}`` topology record to
+        telemetry.jsonl for the ``telemetry mesh`` inspector. Call once the
+        mesh exists (after :meth:`open`); safe no-op on ``mesh=None``."""
+        if mesh is None:
+            return
+        self._perf.set_mesh(mesh)
+        try:
+            from sheeprl_tpu.telemetry import mesh_obs
+
+            topo = mesh_obs.mesh_topology(mesh)
+        except Exception:  # noqa: BLE001 - inspector data, never run-fatal
+            return
+        self.set_run_info(mesh=topo["axis_sizes"])
+        if self.enabled:
+            self.record_event({"type": "mesh", "time": time.time(), "topology": topo})
+
+    def record_param_layouts(self, tree: Any, max_leaves: int = 24) -> None:
+        """Serialize the sharding layout of up to ``max_leaves`` param leaves
+        into telemetry.jsonl (``{"type": "param_layouts"}``) — the data the
+        ``telemetry mesh`` inspector renders as per-param ASCII grids."""
+        if not self.enabled:
+            return
+        try:
+            from sheeprl_tpu.telemetry import mesh_obs
+
+            layouts = mesh_obs.param_layouts(tree, max_leaves=max_leaves)
+        except Exception:  # noqa: BLE001
+            return
+        if layouts:
+            self.record_event({"type": "param_layouts", "time": time.time(), "layouts": layouts})
 
     # ------------------------------------------------------------- export
     def _jsonl_path(self) -> Optional[str]:

@@ -64,7 +64,11 @@ def test_spill_writes_the_proc_file_atomically(tmp_path):
     assert sorted(os.listdir(tmp_path)) == [os.path.basename(path)]  # no tmp leftover
     records = [json.loads(line) for line in open(path)]
     assert records[0]["type"] == "process_meta"
-    assert records[0]["run_info"] == {"role": "trainer"}
+    # Caller keys survive verbatim; the recorder enriches the rest with
+    # device provenance (backend, device counts) for the cluster view.
+    assert records[0]["run_info"]["role"] == "trainer"
+    assert "backend" in records[0]["run_info"]
+    assert "device_count" in records[0]["run_info"]
     assert records[1]["name"] == "work" and records[1]["trace_id"] == "a" * 32
 
 

@@ -1,0 +1,441 @@
+"""Mesh observatory (telemetry/mesh_obs.py + the per-shard PerfAccountant
+split): per-device flop attribution from AOT shardings, topology/layout
+rendering, cross-process metric federation, the live-registry exporter fix,
+and the e2e acceptance contract — on the virtual 8-device CPU mesh a sac run
+publishes perf/shard/*/mfu gauges whose flop split sums to the aggregate MFU,
+and `telemetry mesh` renders the topology plus at least one param layout."""
+
+import glob
+import io
+import json
+import os
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, SingleDeviceSharding
+from jax.sharding import PartitionSpec as P
+
+from sheeprl_tpu.cli import run
+from sheeprl_tpu.telemetry import mesh_obs
+from sheeprl_tpu.telemetry.flight import FlightRecorder
+from sheeprl_tpu.telemetry.perf import PerfAccountant
+from sheeprl_tpu.telemetry.registry import MetricsExporter, MetricsRegistry, default_registry, merged_prometheus_text
+
+pytestmark = pytest.mark.telemetry
+
+DEVICES = jax.devices()
+NEEDS_8 = pytest.mark.skipif(len(DEVICES) < 8, reason="needs the 8 virtual CPU devices from conftest XLA_FLAGS")
+
+
+def _mesh8():
+    return Mesh(np.array(DEVICES[:8]).reshape(8), ("data",))
+
+
+# --------------------------------------------------------- flop attribution
+@NEEDS_8
+class TestSharesFromAot:
+    def _aot(self, fn, *args):
+        lowered = fn.lower(*args)
+        return lowered, lowered.compile()
+
+    def test_shares_sum_to_one_and_split_evenly(self):
+        mesh = _mesh8()
+        x = jax.device_put(jnp.ones((64, 128), jnp.float32), NamedSharding(mesh, P("data")))
+        w = jax.device_put(jnp.ones((128, 128), jnp.float32), NamedSharding(mesh, P()))
+        f = jax.jit(lambda x, w: jnp.tanh(x @ w))
+        shares = mesh_obs.shares_from_aot(*self._aot(f, x, w))
+        assert shares is not None
+        assert sum(shares.values()) == pytest.approx(1.0, abs=1e-9)
+        assert len(shares) == 8
+        # Batch sharded + replicated params: every device holds the same
+        # byte weight, so the split is uniform.
+        for share in shares.values():
+            assert share == pytest.approx(1.0 / 8, rel=1e-6)
+
+    def test_single_device_layout_concentrates_the_shares(self):
+        lone = SingleDeviceSharding(DEVICES[0])
+        x = jax.device_put(jnp.ones((64, 64), jnp.float32), lone)
+        f = jax.jit(lambda x: x @ x)
+        shares = mesh_obs.shares_from_aot(*self._aot(f, x))
+        assert shares is not None
+        assert shares[DEVICES[0].id] == pytest.approx(1.0, abs=1e-9)
+
+    def test_unlowerable_input_degrades_to_none(self):
+        class Bogus:
+            def __getattr__(self, name):
+                raise RuntimeError("no AOT surface")
+
+        assert mesh_obs.shares_from_aot(Bogus(), Bogus()) is None
+
+
+class TestShareHelpers:
+    def test_uniform_shares(self):
+        shares = mesh_obs.uniform_shares([3, 5, 9])
+        assert sum(shares.values()) == pytest.approx(1.0)
+        assert shares == {3: pytest.approx(1 / 3), 5: pytest.approx(1 / 3), 9: pytest.approx(1 / 3)}
+        assert mesh_obs.uniform_shares([]) == {}
+
+    def test_imbalance_even_skewed_empty(self):
+        assert mesh_obs.imbalance([1.0, 1.0, 1.0, 1.0]) == pytest.approx(1.0)
+        # One of 4 shards does all the work: max/mean = 4.
+        assert mesh_obs.imbalance([4.0, 0.0, 0.0, 0.0]) == pytest.approx(4.0)
+        assert mesh_obs.imbalance([]) == 1.0
+        assert mesh_obs.imbalance([0.0, 0.0]) == 1.0
+
+
+# ------------------------------------------------------- per-shard accountant
+@NEEDS_8
+class TestPerShardAccounting:
+    def _run_and_publish(self, acc, mesh, sharding):
+        x = jax.device_put(jnp.ones((64, 128), jnp.float32), sharding)
+        w = jax.device_put(jnp.ones((128, 128), jnp.float32), NamedSharding(mesh, P()))
+        f = jax.jit(lambda x, w: jnp.tanh(x @ w))
+        acc.note("train/f", f, (x, w), steps=1)
+        f(x, w).block_until_ready()
+        return acc.publish()
+
+    def test_shard_mfu_sums_to_aggregate(self):
+        mesh = _mesh8()
+        acc = PerfAccountant(enabled=True, registry=MetricsRegistry(), probe=False, peak_flops=1e12, peak_hbm_gbps=1.0)
+        acc.set_mesh(mesh)
+        gauges = self._run_and_publish(acc, mesh, NamedSharding(mesh, P("data")))
+        shard = {k: v for k, v in gauges.items() if "/shard/" in k and k.endswith("/mfu")}
+        assert len(shard) == 8
+        assert all(k.startswith("perf/shard/data=") for k in shard)
+        # The acceptance tolerance: the split must sum to the aggregate MFU.
+        assert sum(shard.values()) == pytest.approx(gauges["perf/mfu"], abs=1e-6)
+        assert gauges["perf/shard_imbalance"] == pytest.approx(1.0, rel=1e-6)
+
+    def test_imbalance_reacts_to_skewed_sharding(self):
+        # Synthetic skew: the whole operand committed to one device (an
+        # uneven NamedSharding is rejected by jax outright). All flops land
+        # on that shard -> max/mean over 8 mesh devices reads ~8.
+        mesh = _mesh8()
+        acc = PerfAccountant(enabled=True, registry=MetricsRegistry(), probe=False, peak_flops=1e12, peak_hbm_gbps=1.0)
+        acc.set_mesh(mesh)
+        lone = SingleDeviceSharding(DEVICES[0])
+        x = jax.device_put(jnp.ones((64, 64), jnp.float32), lone)
+        f = jax.jit(lambda x: x @ x)
+        acc.note("train/lone", f, (x,), steps=1)
+        f(x).block_until_ready()
+        gauges = acc.publish()
+        assert gauges["perf/shard_imbalance"] > 4.0
+        busy = gauges["perf/shard/data=0/mfu"]
+        idle = gauges["perf/shard/data=1/mfu"]
+        assert busy > 0.0 and idle == pytest.approx(0.0, abs=busy * 1e-6)
+
+    def test_uniform_fallback_preserves_the_sum(self):
+        # A key noted without fn has no harvestable shardings; with counts
+        # but no costs the shard gauges still sum to the (zero-flop)
+        # aggregate and imbalance stays 1.0 — degraded, never wrong.
+        mesh = _mesh8()
+        acc = PerfAccountant(enabled=True, registry=MetricsRegistry(), probe=False, peak_flops=1e12, peak_hbm_gbps=1.0)
+        acc.set_mesh(mesh)
+        acc.note("train/opaque", steps=1)
+        gauges = acc.publish()
+        assert gauges["perf/shard_imbalance"] == 1.0
+        shard = [v for k, v in gauges.items() if "/shard/" in k and k.endswith("/mfu")]
+        assert sum(shard) == pytest.approx(gauges["perf/mfu"], abs=1e-6)
+
+    def test_per_shard_off_emits_no_shard_gauges(self):
+        mesh = _mesh8()
+        acc = PerfAccountant(
+            enabled=True, registry=MetricsRegistry(), probe=False, peak_flops=1e12, peak_hbm_gbps=1.0, per_shard=False
+        )
+        acc.set_mesh(mesh)
+        gauges = self._run_and_publish(acc, mesh, NamedSharding(mesh, P("data")))
+        assert gauges["perf/mfu"] > 0.0
+        assert not any("/shard" in k for k in gauges)
+
+
+# ------------------------------------------------------- topology + layouts
+@NEEDS_8
+class TestTopologyAndLayouts:
+    def test_topology_round_trips_through_json_and_renders(self):
+        topo = mesh_obs.mesh_topology(_mesh8())
+        topo = json.loads(json.dumps(topo))
+        assert topo["axis_names"] == ["data"]
+        assert topo["axis_sizes"] == {"data": 8}
+        assert len(topo["devices"]) == 8
+        art = mesh_obs.topology_ascii(topo)
+        assert "data=8" in art
+        for dev in topo["devices"]:
+            assert f"[{dev['id']:>2}]" in art or f"[{dev['id']}]" in art
+
+    def test_param_layouts_capture_spec_and_blocks(self):
+        mesh = _mesh8()
+        tree = {
+            "w": jax.device_put(jnp.ones((16, 4), jnp.float32), NamedSharding(mesh, P("data", None))),
+            "b": jax.device_put(jnp.ones((4,), jnp.float32), NamedSharding(mesh, P())),
+        }
+        layouts = json.loads(json.dumps(mesh_obs.param_layouts(tree)))
+        by_name = {entry["name"]: entry for entry in layouts}
+        assert set(by_name) == {"w", "b"}
+        assert by_name["w"]["shape"] == [16, 4]
+        assert len(by_name["w"]["devices"]) == 8
+        # Sharded dim: 8 distinct row blocks of 2; replicated b: one block.
+        w_art = mesh_obs.layout_ascii(by_name["w"])
+        assert w_art.count("+") >= 9 * 2  # 9 separator rows in an 8-block grid
+        b_art = mesh_obs.layout_ascii(by_name["b"])
+        assert "0,1,2,3,4,5,6,7" in b_art
+
+    def test_layout_ascii_degrades_without_device_ranges(self):
+        art = mesh_obs.layout_ascii({"name": "x", "shape": [4], "dtype": "float32"})
+        assert art.startswith("x")
+        assert "+" not in art
+
+    def test_topology_ascii_empty(self):
+        assert "empty" in mesh_obs.topology_ascii({})
+
+
+def test_device_provenance_reports_this_process():
+    # jax is imported by this test module, so provenance must resolve.
+    prov = mesh_obs.device_provenance()
+    assert prov["backend"] == jax.default_backend()
+    assert prov["device_count"] == jax.device_count()
+    assert "process_index" in prov
+
+
+# ------------------------------------------------------------------ federation
+def _spill(dirpath, pid, counters=None, gauges=None, run_info=None):
+    os.makedirs(dirpath, exist_ok=True)
+    meta = {
+        "type": "process_meta",
+        "pid": pid,
+        "wall_s": 1.0,
+        "run_info": run_info or {},
+        "metrics": {"counters": counters or {}, "gauges": gauges or {}, "histograms": {}},
+    }
+    with open(os.path.join(dirpath, f"proc_{pid}.jsonl"), "w") as fp:
+        fp.write(json.dumps(meta) + "\n")
+        fp.write(json.dumps({"type": "span", "name": "x"}) + "\n")
+
+
+class TestFederation:
+    def test_read_spill_metas_skips_excluded_and_torn(self, tmp_path):
+        d = str(tmp_path / "flight")
+        _spill(d, 111, counters={"env/steps": 64})
+        _spill(d, 222, counters={"env/steps": 32})
+        with open(os.path.join(d, "proc_333.jsonl"), "w") as fp:
+            fp.write('{"torn')  # never fatal
+        metas = mesh_obs.read_spill_metas(d, exclude_pids=(222,))
+        assert [m["pid"] for m in metas] == [111]
+
+    def test_snapshot_prometheus_text_labels_and_escapes(self):
+        text = mesh_obs.snapshot_prometheus_text(
+            {"counters": {"env/steps": 64}, "gauges": {"process/up": 1.0}, "histograms": {"lat": {"sum": 2.5, "count": 4}}},
+            labels={"pid": 111, "role": 'env"worker"'},
+        )
+        assert 'env_steps_total{pid="111",role="env\\"worker\\""} 64' in text
+        assert 'process_up{pid="111"' in text
+        assert "lat_sum{" in text and "lat_count{" in text
+
+    def test_spill_source_merges_into_one_endpoint(self, tmp_path):
+        d = str(tmp_path / "flight")
+        _spill(d, 111, counters={"env/steps": 64}, run_info={"role": "env_worker"})
+        _spill(d, 999, counters={"env/steps": 1})
+        source = mesh_obs.SpillMetricsSource(d, exclude_pids=(999,))
+        reg = MetricsRegistry()
+        reg.counter("train/steps").inc(5)
+        merged = merged_prometheus_text([reg, source])
+        # ONE text body covers the local registry and the labeled sibling.
+        assert "train_steps_total 5" in merged
+        assert 'env_steps_total{pid="111",role="env_worker"} 64' in merged
+        assert 'pid="999"' not in merged
+
+    def test_spill_source_is_live_per_scrape(self, tmp_path):
+        d = str(tmp_path / "flight")
+        source = mesh_obs.SpillMetricsSource(d)
+        assert source.prometheus_text() == ""
+        _spill(d, 42, counters={"env/steps": 7})
+        assert 'env_steps_total{pid="42"} 7' in source.prometheus_text()
+
+
+# -------------------------------------------------------- exporter liveness
+class TestLiveExporter:
+    def _scrape(self, port):
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=10) as resp:
+            return resp.read().decode()
+
+    def test_mutable_collection_is_read_per_request(self):
+        regs = [MetricsRegistry()]
+        regs[0].counter("first").inc()
+        exporter = MetricsExporter(0, regs, host="127.0.0.1")
+        try:
+            assert "first_total 1" in self._scrape(exporter.port)
+            late = MetricsRegistry()
+            late.counter("late_joiner").inc(3)
+            regs.append(late)  # after startup — the frozen-tuple bug's case
+            body = self._scrape(exporter.port)
+            assert "first_total 1" in body
+            assert "late_joiner_total 3" in body
+        finally:
+            exporter.close()
+
+    def test_callable_supplier_is_resolved_per_request(self):
+        current = {"reg": MetricsRegistry()}
+        current["reg"].gauge("generation").set(1)
+        exporter = MetricsExporter(0, lambda: [current["reg"]], host="127.0.0.1")
+        try:
+            assert "generation 1" in self._scrape(exporter.port)
+            swapped = MetricsRegistry()
+            swapped.gauge("generation").set(2)
+            current["reg"] = swapped
+            assert "generation 2" in self._scrape(exporter.port)
+        finally:
+            exporter.close()
+
+    def test_supplier_failure_returns_empty_not_500(self):
+        def boom():
+            raise RuntimeError("supplier died")
+
+        exporter = MetricsExporter(0, boom, host="127.0.0.1")
+        try:
+            assert self._scrape(exporter.port).strip() == ""
+        finally:
+            exporter.close()
+
+
+# ------------------------------------------------------- provenance stamping
+class TestFlightProvenance:
+    def test_meta_record_carries_device_provenance(self):
+        rec = FlightRecorder(run_info={"role": "trainer"})
+        info = rec._meta_record()["run_info"]
+        assert info["role"] == "trainer"
+        assert info["backend"] == jax.default_backend()
+        assert info["device_count"] == jax.device_count()
+
+    def test_explicit_run_info_wins_over_provenance(self):
+        rec = FlightRecorder(run_info={"backend": "custom-override"})
+        assert rec._meta_record()["run_info"]["backend"] == "custom-override"
+
+
+# ----------------------------------------------------------- scrape ingestion
+class TestScrapeIngestion:
+    def test_parse_prometheus_text_types_and_labels(self):
+        text = (
+            "# HELP train_steps_total steps\n"
+            "# TYPE train_steps_total counter\n"
+            "train_steps_total 42\n"
+            "# TYPE mfu gauge\n"
+            'mfu{pid="1"} 0.25\n'
+            "untyped_total 3\n"
+            "plain_value 7\n"
+            "# TYPE lat histogram\n"
+            'lat_bucket{le="0.1"} 2\n'
+            "lat_sum 0.3\n"
+            "lat_count 4\n"
+            "garbage line without value\n"
+        )
+        parsed = mesh_obs.parse_prometheus_text(text)
+        assert parsed["counters"]["train_steps_total"] == 42.0
+        assert parsed["counters"]["untyped_total"] == 3.0
+        assert parsed["gauges"]['mfu{pid="1"}'] == 0.25
+        assert parsed["gauges"]["plain_value"] == 7.0
+        assert not any("lat_" in k for k in parsed["gauges"])
+
+    def test_fetch_metrics_text_rejects_non_http(self):
+        with pytest.raises(ValueError):
+            mesh_obs.fetch_metrics_text("file:///etc/passwd")
+
+    def test_tail_metrics_url_renders_a_live_endpoint(self):
+        from sheeprl_tpu.telemetry.__main__ import tail
+
+        reg = MetricsRegistry()
+        reg.counter("env/steps").inc(99)
+        exporter = MetricsExporter(0, [reg], host="127.0.0.1")
+        try:
+            out = io.StringIO()
+            code = tail(None, metrics_url=f"http://127.0.0.1:{exporter.port}/metrics", out=out)
+        finally:
+            exporter.close()
+        assert code == 0
+        body = out.getvalue()
+        assert "env_steps_total" in body and "99" in body
+
+    def test_tail_without_any_source_errors(self):
+        from sheeprl_tpu.telemetry.__main__ import tail
+
+        assert tail(None) == 2
+
+
+# ------------------------------------------------------------- e2e contract
+@pytest.fixture(autouse=True)
+def _chdir_tmp(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+
+
+def _tiny_sac_mesh8(**extra):
+    args = [
+        "exp=sac",
+        "env=dummy",
+        "env.id=continuous_dummy",
+        "env.wrapper.id=continuous_dummy",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "algo.per_rank_batch_size=8",
+        "algo.learning_starts=4",
+        "algo.hidden_size=8",
+        "algo.run_test=False",
+        "algo.total_steps=32",
+        "buffer.memmap=False",
+        "buffer.size=64",
+        "checkpoint.every=0",
+        "fabric.accelerator=cpu",
+        "fabric.devices=8",
+        "telemetry.enabled=True",
+        "metric.log_level=1",
+        "metric.log_every=1",
+    ]
+    for k, v in extra.items():
+        args.append(f"{k}={v}")
+    return args
+
+
+def _records(root):
+    jsonl = glob.glob(os.path.join(root, "logs", "runs", "**", "telemetry.jsonl"), recursive=True)
+    assert jsonl, "telemetry.jsonl missing"
+    return jsonl[-1], [json.loads(line) for line in open(jsonl[-1])]
+
+
+@NEEDS_8
+class TestMeshEndToEnd:
+    def test_sac_mesh8_publishes_per_shard_goodput(self, tmp_path):
+        run(_tiny_sac_mesh8())
+        path, lines = _records(str(tmp_path))
+        counters = [rec["values"] for rec in lines if rec["type"] == "counters"]
+        with_shard = [c for c in counters if any("/shard/" in k for k in c)]
+        assert with_shard, f"no perf/shard gauges; keys={sorted(counters[-1]) if counters else []}"
+        gauges = with_shard[-1]
+        shard = {k: v for k, v in gauges.items() if "/shard/" in k and k.endswith("/mfu")}
+        assert len(shard) == 8
+        assert all(k.startswith("perf/shard/data=") for k in shard)
+        # Acceptance: the shard flop split sums to the aggregate MFU.
+        assert sum(shard.values()) == pytest.approx(gauges["perf/mfu"], abs=1e-6)
+        assert gauges["perf/shard_imbalance"] >= 1.0
+        # The same gauges ride /metrics via the default registry.
+        text = default_registry().prometheus_text()
+        assert "perf_shard_data_0_mfu" in text or "perf_shard" in text
+        assert "perf_shard_imbalance" in text
+        # Meta line provenance (satellite): device counts stamped.
+        meta = next(rec for rec in lines if rec["type"] == "meta")
+        assert meta["device_count"] == jax.device_count()
+        assert meta["local_device_count"] == jax.local_device_count()
+        # Topology + layouts recorded for the inspector.
+        assert any(rec["type"] == "mesh" for rec in lines)
+        assert any(rec["type"] == "param_layouts" for rec in lines)
+
+    def test_telemetry_mesh_cli_renders_topology_and_layouts(self, tmp_path):
+        run(_tiny_sac_mesh8())
+        from sheeprl_tpu.telemetry.__main__ import mesh as mesh_cmd
+
+        out = io.StringIO()
+        assert mesh_cmd(str(tmp_path), out=out) == 0
+        body = out.getvalue()
+        assert "data=8" in body  # topology grid
+        assert "param layouts" in body and "+" in body  # >=1 rendered layout
+        assert "perf/shard/" in body  # per-shard metric table
