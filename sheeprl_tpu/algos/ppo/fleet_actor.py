@@ -1,0 +1,145 @@
+"""PPO actor replica — the fleet (multi-process Sebulba) twin of the rollout
+collection block in ``ppo_decoupled.main``.
+
+On-policy lockstep across the process boundary: the replica waits for a
+params broadcast *newer* than the one that produced its previous segment,
+collects a full ``rollout_steps`` segment with it, computes GAE locally (the
+trajectory and its value estimates are replica-local, so the
+returns/advantages are too), and ships one ``rollout`` message carrying the
+whole [T, E, ...] segment. The learner gathers one segment per live replica,
+concatenates along the env axis, and updates — a dead replica shrinks that
+round's batch instead of wedging the round (graceful degradation; the
+supervisor restarts it for the next one).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class _ActorRuntime:
+    """The two attributes ``build_agent`` reads from the real Runtime."""
+
+    def __init__(self, cfg, seed: int) -> None:
+        import jax
+
+        from sheeprl_tpu.core.precision import resolve_precision
+
+        self.precision = resolve_precision(str(cfg.fabric.get("precision", "32-true") or "32-true"))
+        self.root_key = jax.random.PRNGKey(int(seed))
+
+
+def actor_loop(ctx) -> None:
+    """Fleet replica entry (``sheeprl_tpu.algos.ppo.fleet_actor:actor_loop``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.algos.ppo.agent import actions_metadata, build_agent
+    from sheeprl_tpu.algos.ppo.utils import prepare_obs
+    from sheeprl_tpu.utils.env import make_vector_env
+    from sheeprl_tpu.utils.ops import gae
+
+    cfg = ctx.cfg
+    cfg.seed = ctx.seed
+    num_envs = int(cfg.env.num_envs)
+    rollout_steps = int(cfg.algo.rollout_steps)
+    obs_keys = list(cfg.algo.cnn_keys.encoder) + list(cfg.algo.mlp_keys.encoder)
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    clip_rewards_fn = (lambda r: np.tanh(r)) if cfg.env.clip_rewards else (lambda r: r)
+
+    envs = make_vector_env(cfg, ctx.replica, None)
+    actions_dim, is_continuous = actions_metadata(envs.single_action_space)
+    agent, _ = build_agent(
+        _ActorRuntime(cfg, ctx.seed), actions_dim, is_continuous, cfg, envs.single_observation_space
+    )
+    player_step_fn = jax.jit(agent.player_step)
+    get_values_fn = jax.jit(agent.get_values)
+    gae_fn = jax.jit(
+        lambda rewards, values, dones, next_values: gae(
+            rewards, values, dones, next_values, cfg.algo.gamma, cfg.algo.gae_lambda
+        )
+    )
+    rollout_key = jax.random.PRNGKey(ctx.seed)
+
+    next_obs = envs.reset(seed=cfg.seed)[0]
+    version = 0
+    try:
+        while not ctx.should_stop():
+            # Lockstep: only a broadcast newer than the one behind the
+            # previous segment starts a new rollout (idle pings keep the
+            # supervisor's liveness deadline fed while we wait).
+            got = ctx.wait_params(min_version=version + 1, timeout=0.5)
+            if got is None:
+                continue
+            version, params = got
+
+            seg = {k: [] for k in obs_keys}
+            for extra in ("dones", "values", "actions", "logprobs", "rewards"):
+                seg[extra] = []
+            episodes = []
+            for _ in range(rollout_steps):
+                np_obs = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=num_envs)
+                *step_out, rollout_key = player_step_fn(params, np_obs, rollout_key)
+                actions, real_actions_np, logprobs, values = (np.asarray(x) for x in step_out)
+
+                obs, rewards, terminated, truncated, info = envs.step(
+                    real_actions_np.reshape(envs.action_space.shape)
+                )
+                truncated_envs = np.nonzero(truncated)[0]
+                if len(truncated_envs) > 0:
+                    final_obs = info["final_obs"]
+                    real_next_obs = {
+                        k: np.stack([np.asarray(final_obs[e][k], np.float32) for e in truncated_envs])
+                        for k in obs_keys
+                    }
+                    jnp_next = prepare_obs(real_next_obs, cnn_keys=cnn_keys, num_envs=len(truncated_envs))
+                    vals = np.asarray(get_values_fn(params, jnp_next))
+                    rewards[truncated_envs] += cfg.algo.gamma * vals.reshape(
+                        rewards[truncated_envs].shape
+                    )
+                dones = np.logical_or(terminated, truncated).reshape(num_envs, -1).astype(np.uint8)
+                rewards = clip_rewards_fn(rewards).reshape(num_envs, -1).astype(np.float32)
+
+                for k in obs_keys:
+                    seg[k].append(np.asarray(next_obs[k]))
+                seg["dones"].append(dones)
+                seg["values"].append(values)
+                seg["actions"].append(actions)
+                seg["logprobs"].append(logprobs)
+                seg["rewards"].append(rewards)
+
+                if "final_info" in info:
+                    fi = info["final_info"]
+                    for i in np.nonzero(fi.get("_episode", []))[0]:
+                        episodes.append((float(fi["episode"]["r"][i]), float(fi["episode"]["l"][i])))
+
+                next_obs = obs
+                ctx.maybe_ping()
+                if ctx.should_stop():
+                    break
+            if ctx.should_stop():
+                break
+
+            rows = {k: np.stack(v) for k, v in seg.items()}  # [T, E, ...]
+            # GAE is replica-local: this trajectory, its values, its final
+            # bootstrap — same math the in-process loop runs on the player.
+            jnp_obs = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=num_envs)
+            next_values = get_values_fn(params, jnp_obs)
+            returns, advantages = gae_fn(
+                jnp.asarray(rows["rewards"], jnp.float32),
+                jnp.asarray(rows["values"], jnp.float32),
+                jnp.asarray(rows["dones"], jnp.float32),
+                next_values,
+            )
+            rows["returns"] = np.asarray(returns)
+            rows["advantages"] = np.asarray(advantages)
+
+            ctx.ship(
+                rows,
+                env_steps=rollout_steps * num_envs,
+                episodes=episodes,
+                kind="rollout",
+                meta={"version": int(version)},
+            )
+    finally:
+        envs.close()

@@ -30,11 +30,18 @@ from sheeprl_tpu.algos.ppo.ppo import _current_lr, make_train_step
 from sheeprl_tpu.core.player import ParamMirror
 from sheeprl_tpu.algos.ppo.utils import prepare_obs, test
 from sheeprl_tpu.config.instantiate import instantiate
+from sheeprl_tpu.core import fleet as fleet_lib
 from sheeprl_tpu.core import mesh as mesh_lib
 from sheeprl_tpu.core.mesh import DATA_AXIS, split_player_trainer
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.registry import register_algorithm
-from sheeprl_tpu.utils.checkpoint import load_checkpoint, restore_opt_state, save_checkpoint
+from sheeprl_tpu.utils.checkpoint import (
+    load_checkpoint,
+    load_recorded_shardings,
+    place_with_recorded_shardings,
+    restore_opt_state,
+    save_checkpoint,
+)
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -68,6 +75,10 @@ def main(runtime, cfg: Dict[str, Any]):
     health = runtime.health
 
     # ----------------------------------------------------------------- envs
+    # Fleet mode moves the rollout collection into supervised actor-replica
+    # processes (core/fleet.py); the local vector env is then only the probe
+    # the agent build and validation key off.
+    use_fleet = fleet_lib.fleet_active(cfg)
     envs = make_vector_env(cfg, rank, log_dir)
     observation_space = envs.single_observation_space
     if not isinstance(observation_space, gym.spaces.Dict):
@@ -85,6 +96,20 @@ def main(runtime, cfg: Dict[str, Any]):
 
     actions_dim, is_continuous = actions_metadata(envs.single_action_space)
     clip_rewards_fn = (lambda r: np.tanh(r)) if cfg.env.clip_rewards else (lambda r: r)
+
+    fleet_sup = None
+    if use_fleet:
+        envs.close()  # the probe served its purpose; replicas own the envs
+        fleet_sup = fleet_lib.FleetSupervisor.from_config(
+            cfg,
+            "sheeprl_tpu.algos.ppo.fleet_actor:actor_loop",
+            seed=int(cfg.seed),
+            log_dir=log_dir,
+        )
+        fleet_sup.start()
+        runtime.print(
+            f"Fleet: {fleet_sup.replicas} actor replica(s), quorum {int(cfg.fleet.quorum)}"
+        )
 
     # ---------------------------------------------------------------- agent
     # Eager flax/optax init runs host-side (each eager dispatch pays the
@@ -122,8 +147,27 @@ def main(runtime, cfg: Dict[str, Any]):
     runtime.print(f"Decoupled PPO: player on {player_device}, {n_trainers} trainer device(s)")
     # shard_wide_params == replicate when model_axis is 1; with a model
     # axis it shards wide dense stacks tensor-parallel over the trainers.
-    params = mesh_lib.shard_wide_params(params, trainer_mesh)
-    opt_state = mesh_lib.shard_wide_params(opt_state, trainer_mesh)
+    # A resumed run prefers the checkpoint manifest's recorded per-leaf
+    # shardings replayed against THIS mesh (utils/checkpoint.py) — the
+    # elastic-resume path: an 8-device save restarts bit-compatibly on 4.
+    recorded = (
+        load_recorded_shardings(cfg.checkpoint.resume_from)
+        if cfg.checkpoint.resume_from
+        else None
+    )
+    if recorded:
+        def _wide(leaf):
+            return mesh_lib.shard_wide_params(leaf, trainer_mesh)
+
+        params = place_with_recorded_shardings(
+            params, recorded, trainer_mesh, prefix="agent", default=_wide
+        )
+        opt_state = place_with_recorded_shardings(
+            opt_state, recorded, trainer_mesh, prefix="optimizer", default=_wide
+        )
+    else:
+        params = mesh_lib.shard_wide_params(params, trainer_mesh)
+        opt_state = mesh_lib.shard_wide_params(opt_state, trainer_mesh)
     # Per-shard goodput over the TRAINER partition + the topology/layout
     # records behind `python -m sheeprl_tpu.telemetry mesh`.
     telemetry.set_mesh(trainer_mesh)
@@ -168,10 +212,13 @@ def main(runtime, cfg: Dict[str, Any]):
     last_train = 0
     train_step_count = 0
     start_iter = state["iter_num"] + 1 if state is not None else 1
-    policy_step = state["iter_num"] * cfg.env.num_envs * cfg.algo.rollout_steps if state is not None else 0
+    policy_steps_per_iter = int(cfg.env.num_envs * cfg.algo.rollout_steps)
+    if use_fleet:
+        # Each iteration gathers one rollout segment per replica.
+        policy_steps_per_iter *= int(cfg.fleet.replicas)
+    policy_step = state["iter_num"] * policy_steps_per_iter if state is not None else 0
     last_log = state["last_log"] if state is not None else 0
     last_checkpoint = state["last_checkpoint"] if state is not None else 0
-    policy_steps_per_iter = int(cfg.env.num_envs * cfg.algo.rollout_steps)
     total_iters = cfg.algo.total_steps // policy_steps_per_iter if not cfg.dry_run else 1
     if state is not None:
         cfg.algo.per_rank_batch_size = state["batch_size"]
@@ -228,125 +275,185 @@ def main(runtime, cfg: Dict[str, Any]):
     perf = telemetry.perf
     keep_train_metrics = (aggregator is not None and not aggregator.disabled) or health.enabled
     step_data = {}
-    next_obs = envs.reset(seed=cfg.seed)[0]
-    for k in obs_keys:
-        step_data[k] = next_obs[k][np.newaxis]
+    if not use_fleet:
+        next_obs = envs.reset(seed=cfg.seed)[0]
+        for k in obs_keys:
+            step_data[k] = next_obs[k][np.newaxis]
 
     for iter_num in range(start_iter, total_iters + 1):
         telemetry.advance(policy_step)
         guard.advance(policy_step)
-        for _ in range(0, cfg.algo.rollout_steps):
-            policy_step += cfg.env.num_envs
-
+        flat = None
+        if use_fleet:
             with timer("Time/env_interaction_time"), perf.infeed():
-                with jax.default_device(player_device):
-                    # prepare_obs is numpy; PRNG split + normalization run
-                    # inside the jit — one dispatch, one host fetch per step.
-                    np_obs = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
-                    *step_out, rollout_key = player_step_fn(
-                        params_mirror.get(), np_obs, rollout_key
-                    )
-                # Structural per-step sync (actions feed env.step): accounted
-                # through the telemetry fetch.
-                actions, real_actions_np, logprobs, values = telemetry.fetch(
-                    step_out, label="player_actions"
+                # Round k: broadcast version k, then gather one version-k
+                # rollout segment per live replica — the lockstep the
+                # in-process loop gets from the blocking mirror copy,
+                # stretched across the process boundary. A replica that
+                # dies mid-round shrinks the round (graceful degradation);
+                # its supervised restart joins the next one.
+                # copy=True: np.asarray of a CPU jax array can alias device
+                # memory, and the pump threads pickle it off-thread while the
+                # train step donates/overwrites those buffers.
+                fleet_sup.push_params(
+                    jax.tree_util.tree_map(lambda a: np.array(a, copy=True), params),
+                    version=iter_num,
                 )
+                gathered = {}
+                while not guard.preempted:
+                    need = fleet_sup.live_replicas
+                    if need == 0 or len(gathered) >= need:
+                        break
+                    shipment = fleet_sup.recv(timeout=0.5)
+                    if shipment is None or shipment.kind != "rollout":
+                        continue
+                    if int(shipment.meta.get("version", -1)) != iter_num:
+                        continue  # stale straggler from an earlier round
+                    gathered[shipment.replica] = shipment
+                    policy_step += shipment.env_steps
+                    if cfg.metric.log_level > 0:
+                        for ep_rew, ep_len in shipment.episodes:
+                            if aggregator and "Rewards/rew_avg" in aggregator:
+                                aggregator.update("Rewards/rew_avg", ep_rew)
+                            if aggregator and "Game/ep_len_avg" in aggregator:
+                                aggregator.update("Game/ep_len_avg", ep_len)
+                            runtime.print(
+                                f"Rank-0: policy_step={policy_step}, "
+                                f"reward_replica_{shipment.replica}={ep_rew}"
+                            )
+            if gathered and not guard.preempted:
+                # Concat along the env axis: per-replica [T, E, ...] rows
+                # (returns/advantages already computed replica-side) become
+                # one [T*E*live, ...] flat pool. The per-replica rollout
+                # size is n_trainers-divisible (checked above), so any live
+                # subset shards evenly; a changed live count recompiles
+                # train_fn once per distinct count, bounded by replicas.
+                def _flatten(arr):
+                    arr = np.asarray(arr)
+                    return arr.reshape(-1, *arr.shape[2:])
 
-                obs, rewards, terminated, truncated, info = envs.step(
-                    real_actions_np.reshape(envs.action_space.shape)
+                keys = next(iter(gathered.values())).rows.keys()
+                flat = mesh_lib.put_sharded(
+                    {
+                        k: np.concatenate([_flatten(s.rows[k]) for s in gathered.values()])
+                        for k in keys
+                    },
+                    batch_sharding,
                 )
-                truncated_envs = np.nonzero(truncated)[0]
-                if len(truncated_envs) > 0:
-                    final_obs = info["final_obs"]
-                    real_next_obs = {
-                        k: np.stack([np.asarray(final_obs[e][k], np.float32) for e in truncated_envs])
-                        for k in obs_keys
-                    }
+        else:
+            for _ in range(0, cfg.algo.rollout_steps):
+                policy_step += cfg.env.num_envs
+
+                with timer("Time/env_interaction_time"), perf.infeed():
                     with jax.default_device(player_device):
-                        jnp_next = prepare_obs(real_next_obs, cnn_keys=cnn_keys, num_envs=len(truncated_envs))
-                        vals = np.asarray(get_values_fn(params_mirror.get(), jnp_next))
-                    rewards[truncated_envs] += cfg.algo.gamma * vals.reshape(rewards[truncated_envs].shape)
-                dones = np.logical_or(terminated, truncated).reshape(cfg.env.num_envs, -1).astype(np.uint8)
-                rewards = clip_rewards_fn(rewards).reshape(cfg.env.num_envs, -1).astype(np.float32)
+                        # prepare_obs is numpy; PRNG split + normalization run
+                        # inside the jit — one dispatch, one host fetch per step.
+                        np_obs = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
+                        *step_out, rollout_key = player_step_fn(
+                            params_mirror.get(), np_obs, rollout_key
+                        )
+                    # Structural per-step sync (actions feed env.step): accounted
+                    # through the telemetry fetch.
+                    actions, real_actions_np, logprobs, values = telemetry.fetch(
+                        step_out, label="player_actions"
+                    )
 
-            step_data["dones"] = dones[np.newaxis]
-            step_data["values"] = values[np.newaxis]
-            step_data["actions"] = actions[np.newaxis]
-            step_data["logprobs"] = logprobs[np.newaxis]
-            step_data["rewards"] = rewards[np.newaxis]
-            if cfg.buffer.memmap:
-                step_data["returns"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
-                step_data["advantages"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
+                    obs, rewards, terminated, truncated, info = envs.step(
+                        real_actions_np.reshape(envs.action_space.shape)
+                    )
+                    truncated_envs = np.nonzero(truncated)[0]
+                    if len(truncated_envs) > 0:
+                        final_obs = info["final_obs"]
+                        real_next_obs = {
+                            k: np.stack([np.asarray(final_obs[e][k], np.float32) for e in truncated_envs])
+                            for k in obs_keys
+                        }
+                        with jax.default_device(player_device):
+                            jnp_next = prepare_obs(real_next_obs, cnn_keys=cnn_keys, num_envs=len(truncated_envs))
+                            vals = np.asarray(get_values_fn(params_mirror.get(), jnp_next))
+                        rewards[truncated_envs] += cfg.algo.gamma * vals.reshape(rewards[truncated_envs].shape)
+                    dones = np.logical_or(terminated, truncated).reshape(cfg.env.num_envs, -1).astype(np.uint8)
+                    rewards = clip_rewards_fn(rewards).reshape(cfg.env.num_envs, -1).astype(np.float32)
 
-            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+                step_data["dones"] = dones[np.newaxis]
+                step_data["values"] = values[np.newaxis]
+                step_data["actions"] = actions[np.newaxis]
+                step_data["logprobs"] = logprobs[np.newaxis]
+                step_data["rewards"] = rewards[np.newaxis]
+                if cfg.buffer.memmap:
+                    step_data["returns"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
+                    step_data["advantages"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
 
-            next_obs = {}
-            for k in obs_keys:
-                step_data[k] = obs[k][np.newaxis]
-                next_obs[k] = obs[k]
+                rb.add(step_data, validate_args=cfg.buffer.validate_args)
 
-            if cfg.metric.log_level > 0 and "final_info" in info:
-                fi = info["final_info"]
-                for i in np.nonzero(fi.get("_episode", []))[0]:
-                    ep_rew = float(fi["episode"]["r"][i])
-                    ep_len = float(fi["episode"]["l"][i])
-                    if aggregator and "Rewards/rew_avg" in aggregator:
-                        aggregator.update("Rewards/rew_avg", ep_rew)
-                    if aggregator and "Game/ep_len_avg" in aggregator:
-                        aggregator.update("Game/ep_len_avg", ep_len)
-                    runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+                next_obs = {}
+                for k in obs_keys:
+                    step_data[k] = obs[k][np.newaxis]
+                    next_obs[k] = obs[k]
 
-        # --------------------------------------- GAE (player device) + ship
-        local_data = rb.to_tensor()
-        with jax.default_device(player_device):
-            jnp_obs = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
-            next_values = get_values_fn(params_mirror.get(), jnp_obs)
-            returns, advantages = gae_fn(
-                jnp.asarray(np.asarray(local_data["rewards"], np.float32)),
-                jnp.asarray(np.asarray(local_data["values"], np.float32)),
-                jnp.asarray(np.asarray(local_data["dones"], np.float32)),
-                next_values,
-            )
-        local_data["returns"] = np.asarray(returns)
-        local_data["advantages"] = np.asarray(advantages)
+                if cfg.metric.log_level > 0 and "final_info" in info:
+                    fi = info["final_info"]
+                    for i in np.nonzero(fi.get("_episode", []))[0]:
+                        ep_rew = float(fi["episode"]["r"][i])
+                        ep_len = float(fi["episode"]["l"][i])
+                        if aggregator and "Rewards/rew_avg" in aggregator:
+                            aggregator.update("Rewards/rew_avg", ep_rew)
+                        if aggregator and "Game/ep_len_avg" in aggregator:
+                            aggregator.update("Game/ep_len_avg", ep_len)
+                        runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
 
-        # The scatter: flatten [T, N_envs] -> [T*N_envs] and place directly
-        # sharded over the trainer mesh (the reference permutes + splits +
-        # scatter_object_list, ppo_decoupled.py:295-300; the in-jit epoch
-        # permutation already randomizes minibatch membership).
-        # Accounted scatter (core/mesh.put_sharded): H2D bytes land on the
-        # transfer ledger; a layout mismatch would tick reshard_events.
-        flat = mesh_lib.put_sharded(
-            {k: np.asarray(v).reshape(-1, *np.asarray(v).shape[2:]) for k, v in local_data.items()},
-            batch_sharding,
-        )
-
-        with timer("Time/train_time"):
-            clip_arr = np.asarray(cfg.algo.clip_coef, np.float32)
-            ent_arr = np.asarray(cfg.algo.ent_coef, np.float32)
-            # Goodput accounting BEFORE the dispatch: arg shape specs must be
-            # captured while the buffers are alive (the jit donates them).
-            perf.note(
-                "train/update", train_fn,
-                (params, opt_state, flat, train_key, clip_arr, ent_arr),
-                steps=float(cfg.algo.update_epochs),
-            )
-            with train_timer.step():
-                params, opt_state, train_metrics, train_key = train_fn(
-                    params,
-                    opt_state,
-                    flat,
-                    train_key,
-                    clip_arr,
-                    ent_arr,
+            # ----------------------------------- GAE (player device) + ship
+            local_data = rb.to_tensor()
+            with jax.default_device(player_device):
+                jnp_obs = prepare_obs(next_obs, cnn_keys=cnn_keys, num_envs=cfg.env.num_envs)
+                next_values = get_values_fn(params_mirror.get(), jnp_obs)
+                returns, advantages = gae_fn(
+                    jnp.asarray(np.asarray(local_data["rewards"], np.float32)),
+                    jnp.asarray(np.asarray(local_data["values"], np.float32)),
+                    jnp.asarray(np.asarray(local_data["dones"], np.float32)),
+                    next_values,
                 )
-            # The broadcast back: the player's next rollout waits on this copy.
-            params_mirror.push(params)
-            # No sync here (PPO is lockstep anyway — the next rollout waits on
-            # the mirror copy): the StepTimer queues the loss scalars and
-            # bounds the interval with ONE block at the flush below.
-            train_timer.pend(params, train_metrics if keep_train_metrics else None)
-        train_step_count += n_trainers
+            local_data["returns"] = np.asarray(returns)
+            local_data["advantages"] = np.asarray(advantages)
+
+            # The scatter: flatten [T, N_envs] -> [T*N_envs] and place directly
+            # sharded over the trainer mesh (the reference permutes + splits +
+            # scatter_object_list, ppo_decoupled.py:295-300; the in-jit epoch
+            # permutation already randomizes minibatch membership).
+            # Accounted scatter (core/mesh.put_sharded): H2D bytes land on the
+            # transfer ledger; a layout mismatch would tick reshard_events.
+            flat = mesh_lib.put_sharded(
+                {k: np.asarray(v).reshape(-1, *np.asarray(v).shape[2:]) for k, v in local_data.items()},
+                batch_sharding,
+            )
+
+        if flat is not None:
+            with timer("Time/train_time"):
+                clip_arr = np.asarray(cfg.algo.clip_coef, np.float32)
+                ent_arr = np.asarray(cfg.algo.ent_coef, np.float32)
+                # Goodput accounting BEFORE the dispatch: arg shape specs must be
+                # captured while the buffers are alive (the jit donates them).
+                perf.note(
+                    "train/update", train_fn,
+                    (params, opt_state, flat, train_key, clip_arr, ent_arr),
+                    steps=float(cfg.algo.update_epochs),
+                )
+                with train_timer.step():
+                    params, opt_state, train_metrics, train_key = train_fn(
+                        params,
+                        opt_state,
+                        flat,
+                        train_key,
+                        clip_arr,
+                        ent_arr,
+                    )
+                # The broadcast back: the player's next rollout waits on this copy.
+                params_mirror.push(params)
+                # No sync here (PPO is lockstep anyway — the next rollout waits on
+                # the mirror copy): the StepTimer queues the loss scalars and
+                # bounds the interval with ONE block at the flush below.
+                train_timer.pend(params, train_metrics if keep_train_metrics else None)
+            train_step_count += n_trainers
 
         # ------------------------------------------------------- logging
         should_log = cfg.metric.log_level > 0 and (
@@ -408,6 +515,11 @@ def main(runtime, cfg: Dict[str, Any]):
             )
 
         # ---------------------------------------------------- checkpoint
+        if guard.preempted and use_fleet:
+            # Drain before the final save: stop broadcasts, collect the byes,
+            # account any rows still in flight as dropped — the checkpoint
+            # then captures a quiesced fleet.
+            fleet_sup.drain_and_stop()
         if health.allow_save() and (
             (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every)
             or ((iter_num == total_iters or guard.preempted) and cfg.checkpoint.save_last)
@@ -428,7 +540,10 @@ def main(runtime, cfg: Dict[str, Any]):
         if guard.preempted:
             runtime.print(f"Preemption: exiting cleanly after final checkpoint at policy step {policy_step}")
             break
-    envs.close()
+    if use_fleet:
+        fleet_sup.close()
+    else:
+        envs.close()
     if runtime.is_global_zero and cfg.algo.run_test and not guard.preempted:
         test(agent, params_mirror.get(), runtime, cfg, log_dir, logger)
 

@@ -1,0 +1,120 @@
+"""SAC actor replica — the fleet (multi-process Sebulba) twin of the env
+interaction block in ``sac_decoupled.main``.
+
+Runs inside a :class:`~sheeprl_tpu.core.fleet.FleetSupervisor` replica
+process: step the vector env, ship one rows message per vector step (the
+shipment doubles as the heartbeat), act randomly until the learner's first
+params broadcast arrives (the process-level analog of the learning-starts
+prefill), then with the newest actor snapshot thereafter. Off-policy SAC
+makes the replica embarrassingly restartable: transitions are self-contained,
+so the learner interleaves shipments from any mix of replica generations and
+a restarted replica simply starts shipping fresh trajectories from its
+``SeedSequence([seed, replica, restart])`` reseed.
+"""
+
+from __future__ import annotations
+
+import copy
+
+import numpy as np
+
+
+class _ActorRuntime:
+    """The two attributes ``build_agent`` reads from the real Runtime —
+    constructing the full Runtime in a replica would launch meshes and
+    telemetry the actor has no use for."""
+
+    def __init__(self, cfg, seed: int) -> None:
+        import jax
+
+        from sheeprl_tpu.core.precision import resolve_precision
+
+        self.precision = resolve_precision(str(cfg.fabric.get("precision", "32-true") or "32-true"))
+        self.root_key = jax.random.PRNGKey(int(seed))
+
+
+def actor_loop(ctx) -> None:
+    """Fleet replica entry (``sheeprl_tpu.algos.sac.fleet_actor:actor_loop``)."""
+    import jax
+
+    from sheeprl_tpu.algos.sac.agent import build_agent
+    from sheeprl_tpu.algos.sac.utils import prepare_obs
+    from sheeprl_tpu.utils.env import make_vector_env
+
+    cfg = ctx.cfg
+    # The replica's whole stochastic world (env seeds, action sampling, agent
+    # init) keys off the supervisor-derived seed: restart k explores fresh
+    # trajectories, deterministically.
+    cfg.seed = ctx.seed
+    num_envs = int(cfg.env.num_envs)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    sample_next_obs = bool(cfg.buffer.sample_next_obs)
+
+    envs = make_vector_env(cfg, ctx.replica, None)
+    agent, _ = build_agent(
+        _ActorRuntime(cfg, ctx.seed), cfg, envs.single_observation_space, envs.single_action_space
+    )
+
+    def _player(p, o, k):
+        next_k, sub = jax.random.split(k)
+        return agent.get_actions(p, o, sub, greedy=False), next_k
+
+    player_fn = jax.jit(_player)
+    key = jax.random.PRNGKey(ctx.seed)
+
+    obs = envs.reset(seed=cfg.seed)[0]
+    actor_params = None
+    row = {}
+    try:
+        while not ctx.should_stop():
+            got = ctx.poll_params()
+            if got is not None:
+                actor_params = got[1]
+            if actor_params is None:
+                # No broadcast yet: the learner is still prefilling — random
+                # actions, exactly like the in-process loop before
+                # learning_starts.
+                actions = envs.action_space.sample()
+            else:
+                np_obs = prepare_obs(obs, mlp_keys=mlp_keys, num_envs=num_envs)
+                actions_j, key = player_fn(actor_params, np_obs, key)
+                actions = np.asarray(actions_j)
+            next_obs, rewards, terminated, truncated, infos = envs.step(
+                actions.reshape(envs.action_space.shape)
+            )
+            rewards = rewards.reshape(num_envs, -1)
+
+            episodes = []
+            if "final_info" in infos:
+                fi = infos["final_info"]
+                for i in np.nonzero(fi.get("_episode", []))[0]:
+                    episodes.append((float(fi["episode"]["r"][i]), float(fi["episode"]["l"][i])))
+
+            real_next_obs = copy.deepcopy(next_obs)
+            if "final_obs" in infos:
+                done_mask = np.logical_or(terminated, truncated)
+                for idx in np.nonzero(done_mask)[0]:
+                    final = infos["final_obs"][idx]
+                    if final is not None:
+                        for k2, v in final.items():
+                            real_next_obs[k2][idx] = v
+
+            row["terminated"] = terminated.reshape(1, num_envs, -1).astype(np.uint8)
+            row["truncated"] = truncated.reshape(1, num_envs, -1).astype(np.uint8)
+            row["actions"] = actions.reshape(1, num_envs, -1)
+            row["observations"] = np.concatenate(
+                [obs[k] for k in mlp_keys], axis=-1
+            ).astype(np.float32)[np.newaxis]
+            if not sample_next_obs:
+                row["next_observations"] = np.concatenate(
+                    [real_next_obs[k] for k in mlp_keys], axis=-1
+                ).astype(np.float32)[np.newaxis]
+            row["rewards"] = rewards[np.newaxis].astype(np.float32)
+
+            # Ship-or-drop: a drop_shipment injector swallows the send; the
+            # env steps still happened, which is exactly the gap the
+            # learner-side accounting and idle pings must absorb.
+            ctx.ship(row, env_steps=num_envs, episodes=episodes)
+            obs = next_obs
+    finally:
+        envs.close()

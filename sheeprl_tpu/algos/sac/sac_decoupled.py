@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import copy
 import os
+import time
 import warnings
 from typing import Any, Dict
 
@@ -40,12 +41,19 @@ from sheeprl_tpu.algos.sac.sac import _make_optimizer, make_train_step
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.core.player import ParamMirror
 from sheeprl_tpu.config.instantiate import instantiate
+from sheeprl_tpu.core import fleet as fleet_lib
 from sheeprl_tpu.core import mesh as mesh_lib
 from sheeprl_tpu.core.mesh import DATA_AXIS, split_player_trainer
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.core.runtime import DispatchThrottle
 from sheeprl_tpu.registry import register_algorithm
-from sheeprl_tpu.utils.checkpoint import load_checkpoint, restore_opt_state, save_checkpoint
+from sheeprl_tpu.utils.checkpoint import (
+    load_checkpoint,
+    load_recorded_shardings,
+    place_with_recorded_shardings,
+    restore_opt_state,
+    save_checkpoint,
+)
 from sheeprl_tpu.utils.env import make_vector_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -79,6 +87,10 @@ def main(runtime, cfg: Dict[str, Any]):
     runtime.print(f"Log dir: {log_dir}")
 
     # ------------------------------------------------------------ environment
+    # Fleet mode moves env stepping into supervised actor-replica processes
+    # (core/fleet.py); the learner keeps one short-lived local vector env
+    # purely as the space probe its agent/validation code keys off.
+    use_fleet = fleet_lib.fleet_active(cfg)
     envs = make_vector_env(cfg, rank, log_dir)
     action_space = envs.single_action_space
     observation_space = envs.single_observation_space
@@ -98,6 +110,20 @@ def main(runtime, cfg: Dict[str, Any]):
     if cfg.metric.log_level > 0:
         runtime.print("Encoder MLP keys:", cfg.algo.mlp_keys.encoder)
     mlp_keys = list(cfg.algo.mlp_keys.encoder)
+
+    fleet_sup = None
+    if use_fleet:
+        envs.close()  # the probe served its purpose; replicas own the envs
+        fleet_sup = fleet_lib.FleetSupervisor.from_config(
+            cfg,
+            "sheeprl_tpu.algos.sac.fleet_actor:actor_loop",
+            seed=int(cfg.seed),
+            log_dir=log_dir,
+        )
+        fleet_sup.start()
+        runtime.print(
+            f"Fleet: {fleet_sup.replicas} actor replica(s), quorum {int(cfg.fleet.quorum)}"
+        )
 
     # ------------------------------------------------------- agent + optimizers
     # Eager flax/optax init runs host-side (each eager dispatch pays the
@@ -135,8 +161,35 @@ def main(runtime, cfg: Dict[str, Any]):
     runtime.print(f"Decoupled SAC: player on {player_device}, {n_trainers} trainer device(s)")
     # shard_wide_params == replicate when model_axis is 1; with a model
     # axis it shards wide dense stacks tensor-parallel over the trainers.
-    agent_state = mesh_lib.shard_wide_params(agent_state, trainer_mesh)
-    opt_states = mesh_lib.shard_wide_params(opt_states, trainer_mesh)
+    # A resumed run prefers the checkpoint manifest's recorded per-leaf
+    # shardings (utils/checkpoint.py): the layout intent of the saving mesh,
+    # replayed against THIS mesh — the elastic-resume path that makes an
+    # 8-device save restart bit-compatibly on 4 (or 1) devices.
+    recorded = (
+        load_recorded_shardings(cfg.checkpoint.resume_from)
+        if cfg.checkpoint.resume_from
+        else None
+    )
+    if recorded:
+        def _wide(leaf):
+            return mesh_lib.shard_wide_params(leaf, trainer_mesh)
+
+        agent_state = place_with_recorded_shardings(
+            agent_state, recorded, trainer_mesh, prefix="agent", default=_wide
+        )
+        opt_states = {
+            name: place_with_recorded_shardings(
+                opt_states[name], recorded, trainer_mesh, prefix=ckpt_key, default=_wide
+            )
+            for name, ckpt_key in (
+                ("qf", "qf_optimizer"),
+                ("actor", "actor_optimizer"),
+                ("alpha", "alpha_optimizer"),
+            )
+        }
+    else:
+        agent_state = mesh_lib.shard_wide_params(agent_state, trainer_mesh)
+        opt_states = mesh_lib.shard_wide_params(opt_states, trainer_mesh)
     # Per-shard goodput over the TRAINER partition (the player device is
     # accounted by its own fetch/infeed spans), plus the topology + layout
     # records behind `python -m sheeprl_tpu.telemetry mesh`.
@@ -227,7 +280,8 @@ def main(runtime, cfg: Dict[str, Any]):
     rollout_key = jax.device_put(rollout_key, player_device)
 
     step_data = {}
-    obs = envs.reset(seed=cfg.seed)[0]
+    obs = envs.reset(seed=cfg.seed)[0] if not use_fleet else None
+    fleet_sync_every = max(1, int(cfg.fleet.param_sync_every)) if use_fleet else 0
 
     cumulative_per_rank_gradient_steps = 0
     # Bound async in-flight train dispatches (core/runtime.py: an
@@ -243,54 +297,87 @@ def main(runtime, cfg: Dict[str, Any]):
         telemetry.advance(policy_step)
         guard.advance(policy_step)
 
-        with timer("Time/env_interaction_time"), perf.infeed():
-            if iter_num <= learning_starts:
-                actions = envs.action_space.sample()
-            else:
-                with jax.default_device(player_device):
-                    np_obs = prepare_obs(obs, mlp_keys=mlp_keys, num_envs=cfg.env.num_envs)
-                    actions_j, rollout_key = player_fn(actor_mirror.get(), np_obs, rollout_key)
-                # Structural per-step sync (actions feed env.step): accounted
-                # through the telemetry fetch.
-                actions = telemetry.fetch(actions_j, label="player_actions")
-            next_obs, rewards, terminated, truncated, infos = envs.step(
-                actions.reshape(envs.action_space.shape)
-            )
-            rewards = rewards.reshape(cfg.env.num_envs, -1)
+        if use_fleet:
+            # The replicas step the envs; the learner's "env interaction" is
+            # one admitted shipment per iteration. Supervision (liveness,
+            # restarts, quorum) runs inside recv — the bounded timeout keeps
+            # the preemption flag honored even when the whole fleet is quiet.
+            with timer("Time/env_interaction_time"), perf.infeed():
+                shipment = None
+                # A preempted learner still ingests THIS iteration's shipment
+                # when the fleet can provide one (bounded grace): the in-place
+                # signal handler semantics of the non-fleet path, where the
+                # interrupted iteration completes before the final save. That
+                # keeps the preempt checkpoint's iter_num/replay position
+                # identical to the no-fault run — resume-to-parity, not
+                # resume-minus-one-shipment.
+                grace = time.monotonic() + 5.0
+                while shipment is None:
+                    if guard.preempted and (
+                        fleet_sup.live_replicas == 0 or time.monotonic() > grace
+                    ):
+                        break
+                    shipment = fleet_sup.recv(timeout=0.5)
+            if shipment is not None:
+                rb.add(shipment.rows, validate_args=cfg.buffer.validate_args)
+                if cfg.metric.log_level > 0:
+                    for ep_rew, ep_len in shipment.episodes:
+                        if aggregator and not aggregator.disabled:
+                            aggregator.update("Rewards/rew_avg", ep_rew)
+                            aggregator.update("Game/ep_len_avg", ep_len)
+                        runtime.print(
+                            f"Rank-0: policy_step={policy_step}, "
+                            f"reward_replica_{shipment.replica}={ep_rew}"
+                        )
+        else:
+            with timer("Time/env_interaction_time"), perf.infeed():
+                if iter_num <= learning_starts:
+                    actions = envs.action_space.sample()
+                else:
+                    with jax.default_device(player_device):
+                        np_obs = prepare_obs(obs, mlp_keys=mlp_keys, num_envs=cfg.env.num_envs)
+                        actions_j, rollout_key = player_fn(actor_mirror.get(), np_obs, rollout_key)
+                    # Structural per-step sync (actions feed env.step): accounted
+                    # through the telemetry fetch.
+                    actions = telemetry.fetch(actions_j, label="player_actions")
+                next_obs, rewards, terminated, truncated, infos = envs.step(
+                    actions.reshape(envs.action_space.shape)
+                )
+                rewards = rewards.reshape(cfg.env.num_envs, -1)
 
-        if cfg.metric.log_level > 0 and "final_info" in infos:
-            fi = infos["final_info"]
-            for i in np.nonzero(fi.get("_episode", []))[0]:
-                ep_rew = float(fi["episode"]["r"][i])
-                ep_len = float(fi["episode"]["l"][i])
-                if aggregator and not aggregator.disabled:
-                    aggregator.update("Rewards/rew_avg", ep_rew)
-                    aggregator.update("Game/ep_len_avg", ep_len)
-                runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+            if cfg.metric.log_level > 0 and "final_info" in infos:
+                fi = infos["final_info"]
+                for i in np.nonzero(fi.get("_episode", []))[0]:
+                    ep_rew = float(fi["episode"]["r"][i])
+                    ep_len = float(fi["episode"]["l"][i])
+                    if aggregator and not aggregator.disabled:
+                        aggregator.update("Rewards/rew_avg", ep_rew)
+                        aggregator.update("Game/ep_len_avg", ep_len)
+                    runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
 
-        real_next_obs = copy.deepcopy(next_obs)
-        if "final_obs" in infos:
-            done_mask = np.logical_or(terminated, truncated)
-            for idx in np.nonzero(done_mask)[0]:
-                final = infos["final_obs"][idx]
-                if final is not None:
-                    for k, v in final.items():
-                        real_next_obs[k][idx] = v
-        real_next_obs_cat = np.concatenate([real_next_obs[k] for k in mlp_keys], axis=-1).astype(np.float32)
+            real_next_obs = copy.deepcopy(next_obs)
+            if "final_obs" in infos:
+                done_mask = np.logical_or(terminated, truncated)
+                for idx in np.nonzero(done_mask)[0]:
+                    final = infos["final_obs"][idx]
+                    if final is not None:
+                        for k, v in final.items():
+                            real_next_obs[k][idx] = v
+            real_next_obs_cat = np.concatenate([real_next_obs[k] for k in mlp_keys], axis=-1).astype(np.float32)
 
-        step_data["terminated"] = terminated.reshape(1, cfg.env.num_envs, -1).astype(np.uint8)
-        step_data["truncated"] = truncated.reshape(1, cfg.env.num_envs, -1).astype(np.uint8)
-        step_data["actions"] = actions.reshape(1, cfg.env.num_envs, -1)
-        step_data["observations"] = np.concatenate([obs[k] for k in mlp_keys], axis=-1).astype(np.float32)[np.newaxis]
-        if not cfg.buffer.sample_next_obs:
-            step_data["next_observations"] = real_next_obs_cat[np.newaxis]
-        step_data["rewards"] = rewards[np.newaxis].astype(np.float32)
-        rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            step_data["terminated"] = terminated.reshape(1, cfg.env.num_envs, -1).astype(np.uint8)
+            step_data["truncated"] = truncated.reshape(1, cfg.env.num_envs, -1).astype(np.uint8)
+            step_data["actions"] = actions.reshape(1, cfg.env.num_envs, -1)
+            step_data["observations"] = np.concatenate([obs[k] for k in mlp_keys], axis=-1).astype(np.float32)[np.newaxis]
+            if not cfg.buffer.sample_next_obs:
+                step_data["next_observations"] = real_next_obs_cat[np.newaxis]
+            step_data["rewards"] = rewards[np.newaxis].astype(np.float32)
+            rb.add(step_data, validate_args=cfg.buffer.validate_args)
 
-        obs = next_obs
+            obs = next_obs
 
         # ------------------------------------------------- trainer partition
-        if iter_num >= learning_starts:
+        if iter_num >= learning_starts and not (use_fleet and shipment is None):
             ratio_steps = policy_step - prefill_steps * policy_steps_per_iter
             per_rank_gradient_steps = ratio(ratio_steps / n_trainers)
             if per_rank_gradient_steps > 0:
@@ -344,6 +431,20 @@ def main(runtime, cfg: Dict[str, Any]):
                     actor_mirror.push(agent_state["actor"])
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                 train_step_count += n_trainers
+                if use_fleet and iter_num % fleet_sync_every == 0:
+                    # Cross-process weight broadcast: one host pull, fanned
+                    # out by the per-replica pump threads (a dead replica's
+                    # pump dies with its pipe instead of blocking this call).
+                    # copy=True is load-bearing: np.asarray of a CPU jax
+                    # array can be a zero-copy view, and the pump threads
+                    # pickle asynchronously while the next train step DONATES
+                    # these buffers.
+                    fleet_sup.push_params(
+                        jax.tree_util.tree_map(
+                            lambda a: np.array(a, copy=True), agent_state["actor"]
+                        ),
+                        version=iter_num,
+                    )
 
         # ------------------------------------------------------------ logging
         should_log = cfg.metric.log_level > 0 and (
@@ -401,6 +502,11 @@ def main(runtime, cfg: Dict[str, Any]):
             )
             or ((iter_num == total_iters or guard.preempted) and cfg.checkpoint.save_last)
         ):
+            if guard.preempted and use_fleet:
+                # Whole-fleet drain BEFORE the final save: replicas get stop,
+                # their byes are collected, stragglers' in-flight rows are
+                # accounted dropped — then the learner commits and exits.
+                fleet_sup.drain_and_stop()
             last_checkpoint = policy_step
             ckpt_state = {
                 "agent": agent_state,
@@ -431,7 +537,10 @@ def main(runtime, cfg: Dict[str, Any]):
         if guard.preempted:
             runtime.print(f"Preemption: exiting cleanly after final checkpoint at policy step {policy_step}")
             break
-    envs.close()
+    if use_fleet:
+        fleet_sup.close()  # idempotent after a preemption drain
+    else:
+        envs.close()
     if runtime.is_global_zero and cfg.algo.run_test and not guard.preempted:
         # flush: serve the final trained weights, not a stale async snapshot
         test(agent, {"actor": actor_mirror.flush()}, runtime, cfg, log_dir, logger)

@@ -120,12 +120,34 @@ def check_configs(cfg: dotdict) -> None:
                 raise ValueError("resilience.watchdog.enabled=True requires timeout_s > 0")
         ch = res.get("chaos")
         if ch is not None and bool(ch.get("enabled", False)):
-            known = ("env_step_raise", "nan_reward", "sigterm", "sigint", "fail_point", "delayed_fetch")
+            from sheeprl_tpu.core.chaos import STEP_INJECTOR_KINDS
+
+            known = ("env_step_raise", "nan_reward") + tuple(STEP_INJECTOR_KINDS)
             for inj in ch.get("injectors") or []:
                 if str(inj.get("kind", "")) not in known:
                     raise ValueError(
                         f"Unknown resilience.chaos injector kind {inj.get('kind')!r}. Valid: {known}"
                     )
+    fleet = cfg.get("fleet")
+    if fleet is not None:
+        replicas = int(fleet.get("replicas", 1) or 1)
+        if replicas < 1:
+            raise ValueError(f"fleet.replicas must be >= 1, got {replicas}")
+        quorum = int(fleet.get("quorum", 1) or 1)
+        if not 1 <= quorum <= replicas:
+            raise ValueError(f"fleet.quorum must be in [1, fleet.replicas={replicas}], got {quorum}")
+        start_method = str(fleet.get("start_method", "spawn") or "spawn")
+        if start_method != "spawn":
+            # Forking after JAX initializes inherits locked runtime state in
+            # every replica; only spawn gives each one a clean interpreter.
+            raise ValueError(f"fleet.start_method must be 'spawn', got {start_method!r}")
+        from sheeprl_tpu.core.fleet import fleet_active
+
+        if fleet_active(cfg) and not str(cfg.algo.name).endswith("_decoupled"):
+            raise ValueError(
+                "Fleet mode (fleet.replicas > 1 or fleet.enabled=True) requires a decoupled "
+                f"algorithm (the replicas own the envs); got algo.name={cfg.algo.name!r}"
+            )
     health = cfg.get("health")
     if health is not None:
         for knob in ("policy", "anomaly_policy"):

@@ -30,6 +30,16 @@ Two layers:
      this process once ``policy_step >= N`` (fired from
      ``PreemptionGuard.advance`` so delivery lands at an iteration
      boundary, exactly like a cloud preemption notice).
+   - ``{"kind": "kill9", "at_step": N, "replica": K}`` — SIGKILL this
+     process: no handler, no drain, no atexit — the ungraceful death a
+     supervisor must detect from the outside. ``replica`` (optional)
+     targets fleet actor-replica ``K``; without it the injector fires in
+     the learner/controller process.
+   - ``{"kind": "drop_shipment", "at_step": N, "replica": K, "times": T}``
+     — silently swallow the next ``T`` (default 1) rollout shipments on
+     the fleet ship path (``fleet.ship`` drop point): the message-loss
+     twin of ``kill9``, exercising heartbeat idle-ping liveness rather
+     than pipe-EOF death evidence.
    - ``{"kind": "fail_point", "name": "checkpoint.before_commit",
      "at_step": N}`` — arm the named fail point once ``policy_step >= N``
      (``at_step`` 0/absent arms it immediately).
@@ -56,9 +66,11 @@ from typing import Any, Callable, Dict, List, Optional
 __all__ = [
     "ChaosFault",
     "ChaosMonkey",
+    "arm_drop",
     "arm_fail_point",
     "corrupt_checkpoint",
     "maybe_delay",
+    "maybe_drop",
     "maybe_fail",
     "reset",
     "wrap_env_thunks",
@@ -75,6 +87,7 @@ class ChaosFault(RuntimeError):
 _armed: bool = False
 _fail_points: Dict[str, int] = {}  # name -> remaining fires (-1 = always)
 _delays: Dict[str, float] = {}  # name -> seconds (one-shot)
+_drops: Dict[str, int] = {}  # name -> remaining message drops (-1 = always)
 _fired: set = set()  # injector ids that already fired (survives env rebuild)
 
 
@@ -90,7 +103,7 @@ def _count_fault(label: str) -> None:
 
 def _refresh_armed() -> None:
     global _armed
-    _armed = bool(_fail_points or _delays)
+    _armed = bool(_fail_points or _delays or _drops)
 
 
 def arm_fail_point(name: str, times: int = 1) -> None:
@@ -137,6 +150,30 @@ def maybe_delay(name: str) -> None:
         time.sleep(seconds)
 
 
+def arm_drop(name: str, times: int = 1) -> None:
+    """Arm drop point `name` to swallow its next `times` messages (-1 forever)."""
+    _drops[name] = int(times)
+    _refresh_armed()
+
+
+def maybe_drop(name: str) -> bool:
+    """True if drop point `name` is armed: the caller must silently discard
+    the message it was about to send (lost-in-transit, not an error) — the
+    injection seam for ``drop_shipment``. Near-free when nothing is armed."""
+    if not _armed:
+        return False
+    remaining = _drops.get(name)
+    if remaining is None or remaining == 0:
+        return False
+    if remaining > 0:
+        _drops[name] = remaining - 1
+        if _drops[name] == 0:
+            del _drops[name]
+        _refresh_armed()
+    _count_fault(f"drop:{name}")
+    return True
+
+
 def fire_once(injector_id: str, label: str) -> bool:
     """Record `injector_id` as fired; False if it already fired (so a
     supervisor-rebuilt env does not replay the same configured fault)."""
@@ -151,6 +188,7 @@ def reset() -> None:
     """Clear all armed points and the fired registry (test isolation)."""
     _fail_points.clear()
     _delays.clear()
+    _drops.clear()
     _fired.clear()
     _refresh_armed()
 
@@ -275,6 +313,16 @@ def wrap_env_thunks(
 
 
 # --------------------------------------------------------- step injectors
+STEP_INJECTOR_KINDS = (
+    "sigterm",
+    "sigint",
+    "kill9",
+    "fail_point",
+    "delayed_fetch",
+    "drop_shipment",
+)
+
+
 class ChaosMonkey:
     """Policy-step-driven injector driver (signals, fail points, delays).
 
@@ -282,16 +330,37 @@ class ChaosMonkey:
     iteration via ``PreemptionGuard.advance(policy_step)``; env_step_raise
     injectors are handled separately by :func:`wrap_env_thunks` because they
     live inside env workers, not the train loop.
+
+    ``replica`` scopes the injector list to one process of a fleet: a spec
+    carrying a ``replica`` field fires only in the monkey built with that
+    replica index (fleet actor replicas pulse their own monkey per shipped
+    step); specs without one fire only in the learner/controller monkey
+    (``replica=None``). The fired-once registry is per process, so a
+    replica-targeted injector fires once per configured fault even across
+    a supervised restart of a *different* replica — but a restarted replica
+    process starts with a fresh registry, which is exactly right: the
+    supervisor re-delivers the fault only if the spec says so (its
+    ``at_step`` gate re-arms against the new process's local step count,
+    so `kill9` tests pin `at_step` below the pre-restart step count).
     """
 
-    def __init__(self, injectors: Optional[List[Dict[str, Any]]]) -> None:
+    def __init__(
+        self,
+        injectors: Optional[List[Dict[str, Any]]],
+        replica: Optional[int] = None,
+    ) -> None:
         self._injectors: List[Dict[str, Any]] = []
         for idx, inj in enumerate(injectors or []):
             kind = str(inj.get("kind", ""))
             if kind in _ENV_INJECTOR_WRAPPERS:
                 continue  # env-side; see wrap_env_thunks
-            if kind not in ("sigterm", "sigint", "fail_point", "delayed_fetch"):
+            if kind not in STEP_INJECTOR_KINDS:
                 warnings.warn(f"Unknown chaos injector kind {kind!r}: ignored")
+                continue
+            target = inj.get("replica", None)
+            if (target is None) != (replica is None):
+                continue  # replica-targeted spec in the learner, or vice versa
+            if target is not None and int(target) != int(replica):
                 continue
             spec = dict(inj)
             spec["_id"] = f"{kind}[{idx}]"
@@ -309,10 +378,17 @@ class ChaosMonkey:
                 os.kill(os.getpid(), signal.SIGTERM)
             elif kind == "sigint":
                 os.kill(os.getpid(), signal.SIGINT)
+            elif kind == "kill9":
+                # Ungraceful by design: no drain, no final save, no atexit.
+                # The fleet supervisor must notice from the outside (pipe
+                # EOF / waitpid), exactly like an OOM kill.
+                os.kill(os.getpid(), signal.SIGKILL)
             elif kind == "fail_point":
                 arm_fail_point(str(spec["name"]), int(spec.get("times", 1)))
             elif kind == "delayed_fetch":
                 arm_delay("fetch.harvest", float(spec.get("seconds", 0.1)))
+            elif kind == "drop_shipment":
+                arm_drop("fleet.ship", int(spec.get("times", 1)))
 
 
 # --------------------------------------------------- checkpoint corruption

@@ -30,6 +30,17 @@ where the old checkpoint is gone and the new one incomplete. Layout::
 
 Pre-manifest checkpoints (Orbax tree at the directory root) still load; they
 are simply never considered *valid* by the resilience fallback scan.
+
+Topology elasticity (the t5x recorded-shardings seam): the manifest also
+records each device-backed leaf's ``NamedSharding`` — the PartitionSpec axis
+names plus the mesh axis sizes it was saved under — keyed by the leaf's
+normalized tree path. Since the payload itself is full host numpy (never a
+shard), a run saved on an 8-device mesh restores *bit-compatibly* on 4 (or
+1): :func:`place_with_recorded_shardings` replays each recorded spec against
+the new mesh, dropping any axis that no longer divides (replicating that dim
+instead), so the layout intent survives resizes and the values are untouched.
+The ``shardings`` manifest key is optional — schema_version stays 1 and
+pre-elastic readers/writers interoperate both ways.
 """
 
 from __future__ import annotations
@@ -227,10 +238,12 @@ def find_latest_valid_checkpoint(
     fallback path a preempted run resumes through when the most recent save
     was interrupted.
     """
-    if not os.path.isdir(ckpt_dir):
-        return None
+    try:
+        names = os.listdir(ckpt_dir)
+    except OSError:
+        return None  # racing a writer/GC that (re)moved the dir itself
     entries = []
-    for name in os.listdir(ckpt_dir):
+    for name in names:
         m = _CKPT_RE.search(name)
         if not m:
             continue
@@ -335,6 +348,9 @@ def save_checkpoint(
     tracer = tracer_mod.current()
     start = time.perf_counter()
     chaos.maybe_fail("checkpoint.before_write")
+    # Capture per-leaf shardings BEFORE the host pull erases them — the
+    # manifest records layout intent; the payload stays full host numpy.
+    recorded_shardings = _record_shardings(state)
     host_state = jax.tree_util.tree_map(
         lambda x: np.asarray(x) if isinstance(x, jax.Array) else x, state
     )
@@ -370,6 +386,10 @@ def save_checkpoint(
             "aux_sha256": _sha256_file(staging_aux),
             "created_unix": time.time(),
         }
+        if recorded_shardings:
+            # Optional key, same schema version: pre-elastic readers ignore
+            # it, pre-elastic writers simply never produce it.
+            manifest["shardings"] = recorded_shardings
         staging_manifest = os.path.join(staging, MANIFEST_NAME)
         with open(staging_manifest, "w") as fp:
             json.dump(manifest, fp, indent=2)
@@ -436,6 +456,119 @@ def _keystr(path: Tuple[Any, ...]) -> str:
     return "/".join(parts)
 
 
+# ------------------------------------------------- topology-elastic layout
+def _record_shardings(state: Any) -> Dict[str, Any]:
+    """Per-leaf ``NamedSharding`` descriptors for every device-backed leaf of
+    ``state``, keyed by :func:`_keystr` path: the PartitionSpec entries (None
+    / axis name / list of axis names per dim) plus the saving mesh's axis
+    sizes. JSON-native so the descriptors live in the manifest."""
+    from jax.sharding import NamedSharding
+
+    recorded: Dict[str, Any] = {}
+    flat, _ = jax.tree_util.tree_flatten_with_path(state)
+    for path, leaf in flat:
+        sharding = getattr(leaf, "sharding", None)
+        if not isinstance(sharding, NamedSharding):
+            continue
+        entries: List[Any] = []
+        for entry in sharding.spec:
+            if entry is None:
+                entries.append(None)
+            elif isinstance(entry, (tuple, list)):
+                entries.append([str(a) for a in entry])
+            else:
+                entries.append(str(entry))
+        recorded[_keystr(path)] = {
+            "spec": entries,
+            "mesh": {
+                str(a): int(s)
+                for a, s in zip(sharding.mesh.axis_names, sharding.mesh.devices.shape)
+            },
+        }
+    return recorded
+
+
+def load_recorded_shardings(ckpt_path: str) -> Optional[Dict[str, Any]]:
+    """The manifest's recorded per-leaf shardings, or None for pre-elastic
+    checkpoints (restores then fall back to the caller's static layout
+    rule, e.g. ``shard_wide_params``)."""
+    manifest = read_manifest(ckpt_path)
+    if manifest is None:
+        return None
+    shardings = manifest.get("shardings")
+    return shardings if isinstance(shardings, dict) and shardings else None
+
+
+def _adapt_spec(spec_entries: List[Any], shape: Tuple[int, ...], mesh: Any) -> Any:
+    """Replay a recorded PartitionSpec against a (possibly resized) mesh:
+    each dim keeps its recorded axes only when they exist on the new mesh
+    AND their combined size still divides the dim — otherwise that dim
+    degrades to replicated. 8 -> 4 -> 1 devices all restore the same values;
+    only the layout adapts."""
+    from jax.sharding import PartitionSpec
+
+    padded = list(spec_entries) + [None] * (len(shape) - len(spec_entries))
+    out: List[Any] = []
+    for dim, entry in zip(shape, padded):
+        if entry is None:
+            out.append(None)
+            continue
+        axes = [str(a) for a in (entry if isinstance(entry, (list, tuple)) else [entry])]
+        axes = [a for a in axes if a in mesh.shape]
+        size = 1
+        for a in axes:
+            size *= int(mesh.shape[a])
+        if axes and dim % size == 0:
+            out.append(tuple(axes) if len(axes) > 1 else axes[0])
+        else:
+            out.append(None)
+    while out and out[-1] is None:
+        out.pop()
+    return PartitionSpec(*out)
+
+
+def place_with_recorded_shardings(
+    tree: Any,
+    shardings: Dict[str, Any],
+    mesh: Any,
+    *,
+    prefix: str = "",
+    default: Optional[Callable[[Any], Any]] = None,
+) -> Any:
+    """Device-put a restored host pytree using the manifest's recorded
+    per-leaf shardings, adapted to ``mesh`` (the resharding restore path).
+
+    ``prefix`` maps this subtree into the checkpoint's key space (the state
+    dict key it was saved under, e.g. ``"agent"``). Leaves without a record
+    go through ``default`` (per-leaf callable) or replicate. Placement goes
+    through ``core.mesh.put_sharded`` so the restore H2D lands on the
+    transfer ledger like any other infeed.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from sheeprl_tpu.core import mesh as mesh_lib
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    placed = []
+    for path, leaf in flat:
+        rel = _keystr(path)
+        key = f"{prefix}/{rel}" if prefix and rel else (prefix or rel)
+        record = shardings.get(key)
+        # Never np.asarray a device-backed leaf: on the CPU backend that is a
+        # zero-copy VIEW of the live XLA buffer, and re-device_put of the view
+        # aliases the original's memory — a later donation then frees a buffer
+        # another live array still owns (heap corruption, not an exception).
+        arr = leaf if hasattr(leaf, "shape") else np.asarray(leaf)
+        if record is not None:
+            spec = _adapt_spec(list(record.get("spec", [])), arr.shape, mesh)
+            placed.append(mesh_lib.put_sharded(arr, NamedSharding(mesh, spec)))
+        elif default is not None:
+            placed.append(default(leaf))
+        else:
+            placed.append(mesh_lib.put_sharded(arr, NamedSharding(mesh, PartitionSpec())))
+    return jax.tree_util.tree_unflatten(treedef, placed)
+
+
 def restore_opt_state(fresh_opt_state: Any, ckpt_opt_state: Any) -> Any:
     """Pour restored optimizer leaves into a freshly-built optax state.
 
@@ -483,9 +616,21 @@ def _gc_old_checkpoints(ckpt_dir: str, keep_last: int) -> None:
     """Delete all but the newest `keep_last` checkpoints **per rank**,
     ordered by the policy-step embedded in the name (reference:
     callback.py:144-148). Grouping by rank matters: a global sort would let
-    one rank's burst of saves GC another rank's only snapshot."""
+    one rank's burst of saves GC another rank's only snapshot.
+
+    Deletion is rename-first: the doomed checkpoint is atomically renamed to
+    a ``.trash-*`` sibling before its contents are removed. A concurrent
+    reader (``find_latest_valid_checkpoint`` in a resuming process, racing
+    this writer's GC) therefore either sees the complete checkpoint or none
+    at all — never a half-deleted one that passes structural validation but
+    fails to load. A bare ``shutil.rmtree`` would expose exactly that torn
+    window (manifest still readable, array files already gone)."""
+    try:
+        names = os.listdir(ckpt_dir)
+    except OSError:
+        return
     by_rank: Dict[int, List[Tuple[int, str]]] = {}
-    for name in os.listdir(ckpt_dir):
+    for name in names:
         m = _CKPT_RE.search(name)
         if m:
             by_rank.setdefault(int(m.group(2)), []).append(
@@ -494,4 +639,11 @@ def _gc_old_checkpoints(ckpt_dir: str, keep_last: int) -> None:
     for entries in by_rank.values():
         entries.sort()
         for _, path in entries[:-keep_last] if keep_last < len(entries) else []:
-            shutil.rmtree(path, ignore_errors=True)
+            trash = os.path.join(
+                ckpt_dir, f"{_TRASH_PREFIX}{os.path.basename(path)}-{uuid.uuid4().hex[:8]}"
+            )
+            try:
+                os.rename(path, trash)
+            except OSError:
+                continue  # another rank's GC got there first
+            shutil.rmtree(trash, ignore_errors=True)

@@ -1,0 +1,291 @@
+"""FleetSupervisor unit tests: liveness, supervised restart, deterministic
+reseeding, replay-continuity accounting, quorum, params broadcast, drain.
+
+All tests drive real spawn processes running the JAX-free toy actors in
+fleet_toy_actors.py, so the process-boundary mechanics (pipe EOF as death
+evidence, torn streams, SIGKILL-grade exits) are the real thing, not mocks.
+"""
+
+import os
+import time
+
+import pytest
+
+from sheeprl_tpu.core.fleet import (
+    FleetQuorumError,
+    FleetSupervisor,
+    fleet_active,
+    replica_seed,
+)
+from sheeprl_tpu.telemetry.registry import default_registry
+from sheeprl_tpu.utils.utils import dotdict
+
+
+def toy_cfg(**extra):
+    cfg = {"toy_total": 5, "resilience": {"chaos": {"enabled": False}}}
+    cfg.update(extra)
+    return dotdict(cfg)
+
+
+def make_sup(actor, cfg=None, **kw):
+    kw.setdefault("replicas", 2)
+    kw.setdefault("seed", 42)
+    kw.setdefault("backoff_base_s", 0.05)
+    kw.setdefault("backoff_max_s", 0.2)
+    kw.setdefault("ping_interval_s", 0.2)
+    kw.setdefault("heartbeat_timeout_s", 30.0)
+    return FleetSupervisor(f"fleet_toy_actors:{actor}", cfg or toy_cfg(), **kw)
+
+
+def collect(sup, *, timeout=60.0, per_recv=1.0):
+    """Drain the fleet to completion, returning every admitted shipment."""
+    out = []
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        s = sup.recv(timeout=per_recv)
+        if s is not None:
+            out.append(s)
+        elif sup.live_replicas == 0:
+            break
+    return out
+
+
+# ------------------------------------------------------------ config surface
+def test_fleet_active_auto_tracks_replica_count():
+    assert not fleet_active(dotdict({"fleet": {"replicas": 1, "enabled": None}}))
+    assert fleet_active(dotdict({"fleet": {"replicas": 2, "enabled": None}}))
+    assert fleet_active(dotdict({"fleet": {"replicas": 1, "enabled": True}}))
+    assert not fleet_active(dotdict({"fleet": {"replicas": 4, "enabled": False}}))
+    assert not fleet_active(dotdict({}))
+
+
+def test_replica_seed_is_deterministic_and_collision_free():
+    assert replica_seed(42, 1, 0) == replica_seed(42, 1, 0)
+    seen = {replica_seed(42, r, k) for r in range(4) for k in range(4)}
+    assert len(seen) == 16  # distinct across both replica and restart axes
+    assert replica_seed(43, 1, 0) != replica_seed(42, 1, 0)
+
+
+def test_supervisor_rejects_bad_quorum():
+    with pytest.raises(ValueError, match="quorum"):
+        make_sup("steady", replicas=2, quorum=3)
+
+
+# ------------------------------------------------------- steady-state fleet
+def test_steady_fleet_ships_everything_then_finishes_clean():
+    sup = make_sup("steady", replicas=2)
+    sup.start()
+    try:
+        shipments = collect(sup)
+        assert len(shipments) == 10  # 2 replicas x toy_total rows
+        by_replica = {r: [s for s in shipments if s.replica == r] for r in (0, 1)}
+        for r, group in by_replica.items():
+            assert [s.rows["i"] for s in group] == list(range(5))
+            assert all(s.rows["restart"] == 0 for s in group)
+            assert all(s.generation == 0 for s in group)
+            assert all(s.rows["seed"] == replica_seed(42, r, 0) for s in group)
+        assert sup.restarts_total == 0
+        assert sup.rows_dropped == 0
+        assert sup.live_replicas == 0  # both finished with a clean bye
+        assert default_registry().gauge("fleet/replicas_live").value == 0.0
+    finally:
+        sup.close()
+
+
+# ------------------------------------------------- death, restart, reseeding
+def test_hard_death_restarts_with_fresh_seed_and_accounts_rows():
+    restarts_before = default_registry().counter("fleet/replica_restarts").value
+    sup = make_sup("crash_once", replicas=2)
+    sup.start()
+    try:
+        shipments = collect(sup)
+        assert sup.restarts_total == 2  # each replica died exactly once
+        for r in (0, 1):
+            gen1 = [s for s in shipments if s.replica == r and s.generation == 1]
+            # The restarted generation runs the full toy_total stream.
+            assert [s.rows["i"] for s in gen1] == list(range(5))
+            assert all(s.rows["restart"] == 1 for s in gen1)
+            # Deterministic reseed: restart 1 explores a DIFFERENT stream
+            # than the crashed generation 0 would have.
+            assert gen1[0].rows["seed"] == replica_seed(42, r, 1)
+            assert gen1[0].rows["seed"] != replica_seed(42, r, 0)
+        assert (
+            default_registry().counter("fleet/replica_restarts").value
+            == restarts_before + 2
+        )
+    finally:
+        sup.close()
+
+
+def test_quorum_breaker_trips_when_fleet_cannot_recover():
+    sup = make_sup("always_crash", replicas=2, quorum=2, max_restarts=1)
+    sup.start()
+    try:
+        with pytest.raises(FleetQuorumError):
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                sup.recv(timeout=1.0)
+    finally:
+        sup.close()
+
+
+def test_heartbeat_timeout_reaps_hung_replica():
+    sup = make_sup("hang", replicas=1, heartbeat_timeout_s=1.0)
+    sup.start()
+    try:
+        shipments = collect(sup)
+        # The hung generation 0 never shipped; the restart streams all 5.
+        assert sup.restarts_total == 1
+        assert [s.rows["i"] for s in shipments] == list(range(5))
+        assert all(s.generation == 1 for s in shipments)
+        assert default_registry().gauge("fleet/heartbeat_age_s").value >= 0.0
+    finally:
+        sup.close()
+
+
+# -------------------------------------------------------------- params plane
+def test_params_broadcast_and_restart_reoffer():
+    sup = make_sup("echo_params", replicas=2)
+    sup.start()
+    try:
+        sup.push_params({"w": [1.0, 2.0]}, version=7)
+        echoes = []
+        deadline = time.monotonic() + 30.0
+        while len(echoes) < 2 and time.monotonic() < deadline:
+            s = sup.recv(timeout=1.0)
+            if s is not None:
+                echoes.append(s)
+        assert len(echoes) == 2
+        for s in echoes:
+            assert s.meta["version"] == 7
+            assert s.rows["params"] == {"w": [1.0, 2.0]}
+        sup.drain_and_stop(timeout=10.0)
+    finally:
+        sup.close()
+
+
+# ------------------------------------------------------------------- drain
+def test_drain_accounts_inflight_rows_and_reaps_processes():
+    sup = make_sup("ship_until_stopped", replicas=2)
+    sup.start()
+    try:
+        got = 0
+        while got < 6:
+            if sup.recv(timeout=5.0) is not None:
+                got += 1
+        procs = [s.proc for s in sup._slots]
+        sup.drain_and_stop(timeout=10.0)
+        for p in procs:
+            assert p is None or not p.is_alive()
+        # Continuous shippers almost certainly had rows in flight at the
+        # stop; whatever arrived during the drain is accounted, not ingested.
+        assert sup.rows_dropped == default_registry().counter("fleet/rows_dropped").value - _dropped_before
+    finally:
+        sup.close()
+
+
+_dropped_before = 0
+
+
+@pytest.fixture(autouse=True)
+def _snapshot_drop_counter():
+    global _dropped_before
+    _dropped_before = default_registry().counter("fleet/rows_dropped").value
+    yield
+
+
+# ----------------------------------------------------------- flow control
+def test_ship_blocks_at_max_inflight_until_credit_and_stop_unblocks():
+    """Credit-based backpressure, driven deterministically: a ReplicaContext
+    wired to raw in-process pipes blocks ship() at max_inflight, keeps
+    heartbeating while blocked, resumes on a credit, and bails on stop."""
+    import multiprocessing as mp
+    import threading
+
+    from sheeprl_tpu.core.fleet import ReplicaContext
+
+    rows_parent, rows_child = mp.Pipe(duplex=False)
+    ctrl_child, ctrl_parent = mp.Pipe(duplex=False)
+    ctx = ReplicaContext(
+        toy_cfg(), 0, 0, 1, "", rows_child, ctrl_child,
+        ping_interval_s=0.05, max_inflight=2,
+    )
+    assert ctx.ship({"i": 0}, env_steps=1)
+    assert ctx.ship({"i": 1}, env_steps=1)
+
+    results = []
+    done = threading.Event()
+
+    def blocked_ship():
+        results.append(ctx.ship({"i": 2}, env_steps=1))
+        done.set()
+
+    t = threading.Thread(target=blocked_ship, daemon=True)
+    t.start()
+    assert not done.wait(0.4)  # out of credits: the third ship must block
+    kinds = []
+    while rows_parent.poll(0):
+        kinds.append(rows_parent.recv()[0])
+    assert kinds.count("rows") == 2
+    assert "ping" in kinds  # liveness does not depend on throughput
+
+    ctrl_parent.send(("credit", 1, None))
+    assert done.wait(5.0) and results == [True]
+    t.join(timeout=5.0)
+
+    # Credits are spent again; a stop must unblock the sender with False
+    # (draining — nobody will read those rows).
+    results.clear()
+    done.clear()
+    t2 = threading.Thread(target=blocked_ship, daemon=True)
+    t2.start()
+    assert not done.wait(0.2)
+    ctrl_parent.send(("stop", None, None))
+    assert done.wait(5.0) and results == [False]
+    t2.join(timeout=5.0)
+    for end in (rows_parent, rows_child, ctrl_child, ctrl_parent):
+        end.close()
+
+
+# --------------------------------------------------- chaos-injector plumbing
+def test_replica_scoped_kill9_restarts_only_its_target():
+    cfg = toy_cfg(
+        resilience={
+            "chaos": {
+                "enabled": True,
+                "injectors": [{"kind": "kill9", "at_step": 3, "replica": 1}],
+            }
+        }
+    )
+    sup = make_sup("chaos_driven", cfg=cfg, replicas=2)
+    sup.start()
+    try:
+        shipments = collect(sup)
+        assert sup.restarts_total == 1  # only replica 1 died
+        assert all(s.generation == 0 for s in shipments if s.replica == 0)
+        assert any(s.generation == 1 for s in shipments if s.replica == 1)
+        # Replica 0 delivered its full uninterrupted stream.
+        assert [s.rows["i"] for s in shipments if s.replica == 0] == list(range(5))
+    finally:
+        sup.close()
+
+
+def test_replica_scoped_drop_shipment_swallows_and_accounts_nothing_ingested():
+    cfg = toy_cfg(
+        resilience={
+            "chaos": {
+                "enabled": True,
+                "injectors": [{"kind": "drop_shipment", "at_step": 2, "replica": 0}],
+            }
+        }
+    )
+    sup = make_sup("chaos_driven", cfg=cfg, replicas=1)
+    sup.start()
+    try:
+        shipments = collect(sup)
+        # Row i=1 (the second ship, env step 2) was swallowed child-side:
+        # never ingested, and the replica carried on without a restart.
+        assert [s.rows["i"] for s in shipments] == [0, 2, 3, 4]
+        assert sup.restarts_total == 0
+    finally:
+        sup.close()

@@ -1,0 +1,51 @@
+"""Spawn-process workers for the checkpoint writer/GC-vs-reader race test.
+
+Module-level functions so the spawn start method can import them in the
+child; each child re-imports jax on CPU (the parent's conftest env vars are
+inherited through os.environ)."""
+
+import os
+import time
+
+
+def writer(ckpt_dir, rank, steps, keep_last):
+    """Save `steps` checkpoints for one rank, GCing down to keep_last after
+    each — the concurrent-rank writer half of the race."""
+    import numpy as np
+
+    from sheeprl_tpu.utils.checkpoint import save_checkpoint
+
+    for step in range(1, steps + 1):
+        state = {
+            "agent": {"w": np.full((16, 16), step * 10 + rank, np.float32)},
+            "iter_num": step,
+        }
+        save_checkpoint(
+            os.path.join(ckpt_dir, f"ckpt_{step}_{rank}.ckpt"), state, keep_last=keep_last
+        )
+
+
+def reader(ckpt_dir, stop_evt, failures):
+    """Hammer the resume discovery path while writers save and GC.
+
+    The torn-latest contract: any path the discovery returns either fully
+    digest-validates, or has atomically vanished (GC renamed it away whole).
+    A path that still exists on disk but fails digest validation is exactly
+    the half-deleted window the rename-first GC must close."""
+    from sheeprl_tpu.core.resilience import resolve_auto_resume
+    from sheeprl_tpu.utils.checkpoint import (
+        find_latest_valid_checkpoint,
+        validate_checkpoint,
+    )
+
+    while not stop_evt.is_set():
+        for path in (
+            find_latest_valid_checkpoint(ckpt_dir),
+            resolve_auto_resume("auto", search_root=os.path.dirname(ckpt_dir)),
+        ):
+            if path is None:
+                continue
+            if not validate_checkpoint(path, verify_digest=True) and os.path.isdir(path):
+                failures.put(("torn", path, sorted(os.listdir(path))))
+                return
+        time.sleep(0.001)

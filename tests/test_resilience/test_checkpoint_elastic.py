@@ -1,0 +1,129 @@
+"""Topology-elastic sharded checkpoints: a save on an 8-device mesh records
+per-leaf shardings in the manifest, and the restore replays them against
+whatever mesh exists at resume time — 8, 4, or 1 devices — with bit-exact
+values (the payload is always full host arrays; only the layout adapts)."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec
+
+from sheeprl_tpu.core import mesh as mesh_lib
+from sheeprl_tpu.utils.checkpoint import (
+    load_checkpoint,
+    load_recorded_shardings,
+    place_with_recorded_shardings,
+    read_manifest,
+    save_checkpoint,
+    validate_checkpoint,
+)
+
+
+def _mesh(n, model_axis=1):
+    return mesh_lib.build_mesh(jax.devices()[:n], model_axis_size=model_axis)
+
+
+def _sharded_state(mesh):
+    """Three layouts worth recording: data-sharded, model-sharded (TP), and
+    replicated — plus a non-array aux leaf."""
+    w_data = mesh_lib.put_sharded(
+        np.arange(16 * 4, dtype=np.float32).reshape(16, 4),
+        NamedSharding(mesh, PartitionSpec("data")),
+    )
+    w_model = mesh_lib.put_sharded(
+        np.arange(8 * 8, dtype=np.float32).reshape(8, 8) * 0.5,
+        NamedSharding(mesh, PartitionSpec(None, "model")),
+    )
+    bias = mesh_lib.put_sharded(
+        np.linspace(-1.0, 1.0, 8).astype(np.float32),
+        NamedSharding(mesh, PartitionSpec()),
+    )
+    return {"agent": {"w_data": w_data, "w_model": w_model, "bias": bias}, "iter_num": 3}
+
+
+def _leaf_axes(arr):
+    return tuple(arr.sharding.spec)
+
+
+def test_manifest_records_per_leaf_shardings(tmp_path):
+    mesh = _mesh(8, model_axis=2)
+    path = str(tmp_path / "ckpt_8_0.ckpt")
+    with mesh:
+        save_checkpoint(path, _sharded_state(mesh))
+    manifest = read_manifest(path)
+    assert validate_checkpoint(path, verify_digest=True)
+    recorded = manifest["shardings"]
+    assert recorded["agent/w_data"]["spec"] == ["data"]
+    assert recorded["agent/w_model"]["spec"] == [None, "model"]
+    assert recorded["agent/bias"]["spec"] == []
+    assert recorded["agent/w_data"]["mesh"] == {"data": 4, "model": 2}
+    # The sidecar is backward compatible: same schema, just one more key.
+    assert load_recorded_shardings(path) == recorded
+
+
+@pytest.mark.parametrize("resume_devices,resume_model", [(8, 2), (4, 2), (1, 1)])
+def test_restore_is_bit_exact_across_topologies(tmp_path, resume_devices, resume_model):
+    save_mesh = _mesh(8, model_axis=2)
+    path = str(tmp_path / "ckpt_8_0.ckpt")
+    with save_mesh:
+        state = _sharded_state(save_mesh)
+        expected = {k: np.asarray(v) for k, v in state["agent"].items()}
+        save_checkpoint(path, state)
+
+    resume_mesh = _mesh(resume_devices, model_axis=resume_model)
+    loaded = load_checkpoint(path)
+    placed = place_with_recorded_shardings(
+        loaded["agent"], load_recorded_shardings(path), resume_mesh, prefix="agent"
+    )
+    for key, exp in expected.items():
+        np.testing.assert_array_equal(np.asarray(placed[key]), exp)
+
+    # Layout adapted, not just replicated: the recorded spec survives on any
+    # mesh that still has the axes (on the 1x1 mesh the axes have size 1, so
+    # the same spec is trivially fully replicated).
+    assert _leaf_axes(placed["w_data"]) == ("data",)
+    assert _leaf_axes(placed["w_model"]) == (None, "model")
+    if resume_devices == 1:
+        assert placed["w_data"].sharding.is_fully_replicated
+        assert placed["w_model"].sharding.is_fully_replicated
+
+
+def test_non_divisible_dim_degrades_to_replicated(tmp_path):
+    # Saved data-sharded over 8 rows on an 8x1 mesh; resumed on a 3-device
+    # mesh whose data axis (3) does not divide 8 -> that dim replicates.
+    save_mesh = _mesh(8)
+    path = str(tmp_path / "ckpt_1_0.ckpt")
+    with save_mesh:
+        w = mesh_lib.put_sharded(
+            np.arange(8 * 2, dtype=np.float32).reshape(8, 2),
+            NamedSharding(save_mesh, PartitionSpec("data")),
+        )
+        save_checkpoint(path, {"agent": {"w": w}})
+    resume_mesh = _mesh(3)
+    placed = place_with_recorded_shardings(
+        load_checkpoint(path)["agent"], load_recorded_shardings(path), resume_mesh, prefix="agent"
+    )
+    assert _leaf_axes(placed["w"]) == ()
+    np.testing.assert_array_equal(
+        np.asarray(placed["w"]), np.arange(16, dtype=np.float32).reshape(8, 2)
+    )
+
+
+def test_pre_elastic_checkpoint_falls_back_to_caller_rule(tmp_path):
+    # Host-only state: nothing device-backed to record, so the manifest has
+    # no shardings key and resumes go through the caller's static rule.
+    path = str(tmp_path / "ckpt_2_0.ckpt")
+    save_checkpoint(path, {"agent": {"w": np.ones((4, 4), np.float32)}})
+    assert load_recorded_shardings(path) is None
+    mesh = _mesh(4)
+    sentinel = []
+
+    def default(leaf):
+        sentinel.append(True)
+        return mesh_lib.put_sharded(np.asarray(leaf), NamedSharding(mesh, PartitionSpec()))
+
+    placed = place_with_recorded_shardings(
+        load_checkpoint(path)["agent"], {}, mesh, prefix="agent", default=default
+    )
+    assert sentinel  # unrecorded leaves routed through the fallback
+    np.testing.assert_array_equal(np.asarray(placed["w"]), np.ones((4, 4), np.float32))

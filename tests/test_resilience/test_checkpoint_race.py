@@ -1,0 +1,62 @@
+"""Concurrent checkpoint traffic: two rank writers with rank-aware GC racing
+a reader running the auto-resume discovery path, all in real spawn processes.
+
+The invariant (same one test_checkpoint_atomic.py pins single-process): the
+reader never observes a half-deleted checkpoint — every path the discovery
+returns either digest-validates in full or has vanished atomically."""
+
+import multiprocessing as mp
+import os
+
+import pytest
+
+from sheeprl_tpu.utils.checkpoint import find_latest_valid_checkpoint, validate_checkpoint
+
+pytestmark = pytest.mark.chaos
+
+
+def test_writers_and_gc_never_expose_torn_latest_to_reader(tmp_path):
+    ckpt_dir = tmp_path / "checkpoint"
+    ckpt_dir.mkdir()
+    ctx = mp.get_context("spawn")
+    stop_evt = ctx.Event()
+    failures = ctx.Queue()
+
+    import ckpt_race_workers
+
+    reader = ctx.Process(
+        target=ckpt_race_workers.reader, args=(str(ckpt_dir), stop_evt, failures), daemon=True
+    )
+    writers = [
+        ctx.Process(
+            target=ckpt_race_workers.writer, args=(str(ckpt_dir), rank, 8, 2), daemon=True
+        )
+        for rank in (0, 1)
+    ]
+    reader.start()
+    for w in writers:
+        w.start()
+    for w in writers:
+        w.join(timeout=120)
+        assert w.exitcode == 0
+    stop_evt.set()
+    reader.join(timeout=30)
+
+    assert failures.empty(), f"reader observed a torn checkpoint: {failures.get()}"
+    # Reader exiting nonzero would mean it crashed rather than failed clean.
+    assert reader.exitcode == 0
+
+    # Quiesced end state: rank-aware GC kept exactly keep_last per rank, the
+    # survivors are the newest steps, and everything left fully validates.
+    names = sorted(os.listdir(ckpt_dir))
+    assert not [n for n in names if n.startswith(".tmp-") or n.startswith(".trash-")]
+    by_rank = {0: [], 1: []}
+    for n in names:
+        step, rank = n[len("ckpt_"):-len(".ckpt")].split("_")
+        by_rank[int(rank)].append(int(step))
+    for rank, steps in by_rank.items():
+        assert sorted(steps) == [7, 8], f"rank {rank} kept {steps}"
+    for n in names:
+        assert validate_checkpoint(str(ckpt_dir / n), verify_digest=True)
+    latest = find_latest_valid_checkpoint(str(ckpt_dir))
+    assert latest is not None and os.path.basename(latest).startswith("ckpt_8_")

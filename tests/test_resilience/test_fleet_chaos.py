@@ -1,0 +1,261 @@
+"""Fleet chaos e2e, driven through the CLI on the virtual 8-device CPU mesh:
+SIGKILL-grade replica death mid-run, learner preemption with a whole-fleet
+drain, and topology-elastic resume of the preemption checkpoint on a smaller
+mesh. The counters must agree with no-fault baselines — a supervised restart
+is a throughput dip, not a numerics event."""
+
+import os
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.cli import run
+from sheeprl_tpu.core import chaos
+from sheeprl_tpu.telemetry.registry import default_registry
+from sheeprl_tpu.utils.checkpoint import (
+    load_checkpoint,
+    load_recorded_shardings,
+    parse_ckpt_name,
+    read_manifest,
+    validate_checkpoint,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _isolated(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def _find_ckpts(root):
+    found = []
+    for r, dirs, _ in os.walk(root):
+        for d in dirs:
+            if d.startswith("ckpt_") and d.endswith(".ckpt"):
+                found.append(os.path.realpath(os.path.join(r, d)))
+    return sorted(found, key=lambda p: parse_ckpt_name(p)[0])
+
+
+def _restarts():
+    return default_registry().counter("fleet/replica_restarts").value
+
+
+def sac_fleet_args(total_steps=32, **extra):
+    args = [
+        "exp=sac_decoupled",
+        "env=dummy",
+        "env.id=continuous_dummy",
+        "env.wrapper.id=continuous_dummy",
+        "metric.log_level=0",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "algo.per_rank_batch_size=4",
+        "algo.learning_starts=2",
+        "algo.hidden_size=8",
+        "algo.run_test=False",
+        f"algo.total_steps={total_steps}",
+        "buffer.memmap=False",
+        "buffer.size=64",
+        "buffer.checkpoint=True",
+        "checkpoint.every=0",
+        "checkpoint.save_last=True",
+        "fabric.accelerator=cpu",
+        "fabric.devices=2",
+        "fleet.replicas=2",
+        "fleet.quorum=1",
+        "fleet.param_sync_every=4",
+    ]
+    for k, v in extra.items():
+        args.append(f"{k}={v}")
+    return args
+
+
+def ppo_fleet_args(total_steps=64, **extra):
+    args = [
+        "exp=ppo_decoupled",
+        "env=dummy",
+        "metric.log_level=0",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "algo.rollout_steps=8",
+        "algo.per_rank_batch_size=4",
+        "algo.update_epochs=2",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.encoder.cnn_features_dim=16",
+        "algo.encoder.mlp_features_dim=8",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.run_test=False",
+        f"algo.total_steps={total_steps}",
+        "buffer.memmap=False",
+        "checkpoint.every=0",
+        "checkpoint.save_last=True",
+        "fabric.accelerator=cpu",
+        "fabric.devices=2",
+        "fleet.replicas=2",
+        "fleet.quorum=1",
+    ]
+    for k, v in extra.items():
+        args.append(f"{k}={v}")
+    return args
+
+
+# ------------------------------------------------------- SIGKILL a replica
+def test_sac_fleet_kill9_replica_is_restarted_and_run_completes(tmp_path, monkeypatch):
+    # No-fault baseline fleet run.
+    base_dir = tmp_path / "baseline"
+    base_dir.mkdir()
+    monkeypatch.chdir(base_dir)
+    before = _restarts()
+    run(sac_fleet_args())
+    assert _restarts() == before  # healthy fleet never restarts
+    baseline = _find_ckpts(base_dir)[-1]
+    assert parse_ckpt_name(baseline)[0] == 32
+
+    # Same run, but replica 1 is SIGKILLed mid-shipping (no handlers, no
+    # drain — the supervisor must notice via pipe EOF and respawn it).
+    chaos_dir = tmp_path / "chaos"
+    chaos_dir.mkdir()
+    monkeypatch.chdir(chaos_dir)
+    before = _restarts()
+    run(
+        sac_fleet_args(
+            **{
+                "resilience.chaos.enabled": True,
+                "resilience.chaos.injectors": "[{kind: kill9, at_step: 12, replica: 1}]",
+            }
+        )
+    )
+    assert _restarts() == before + 1  # exactly one supervised restart
+    faulted = _find_ckpts(chaos_dir)[-1]
+    assert parse_ckpt_name(faulted)[0] == 32
+
+    # The fault run lands on the same training position as the baseline:
+    # same iteration counter, same replay write position (the learner
+    # ingested exactly one full shipment per iteration either way).
+    a, b = load_checkpoint(baseline), load_checkpoint(faulted)
+    assert a["iter_num"] == b["iter_num"]
+    assert a["rb"]._pos == b["rb"]._pos
+    assert a["rb"].buffer_size == b["rb"].buffer_size
+
+
+def test_ppo_fleet_kill9_shrinks_round_then_restart_rejoins(tmp_path):
+    before = _restarts()
+    run(
+        ppo_fleet_args(
+            **{
+                "resilience.chaos.enabled": True,
+                "resilience.chaos.injectors": "[{kind: kill9, at_step: 20, replica: 0}]",
+            }
+        )
+    )
+    assert _restarts() == before + 1
+    final = _find_ckpts(tmp_path)[-1]
+    # The run completed every iteration despite the mid-round death: dead
+    # replicas shrink a round (graceful degradation), they don't wedge it.
+    assert load_checkpoint(final)["iter_num"] >= 1
+    assert validate_checkpoint(final, verify_digest=True)
+
+
+# ------------------------------- learner preemption + topology-elastic resume
+def test_sac_fleet_sigterm_drains_then_auto_resumes_to_parity(tmp_path, monkeypatch):
+    base_dir = tmp_path / "baseline"
+    base_dir.mkdir()
+    monkeypatch.chdir(base_dir)
+    run(sac_fleet_args())
+    baseline = _find_ckpts(base_dir)[-1]
+
+    chaos_dir = tmp_path / "chaos"
+    chaos_dir.mkdir()
+    monkeypatch.chdir(chaos_dir)
+    run(
+        sac_fleet_args(
+            **{
+                "resilience.chaos.enabled": True,
+                "resilience.chaos.injectors": "[{kind: sigterm, at_step: 16}]",
+            }
+        )
+    )
+    preempt_ckpt = _find_ckpts(chaos_dir)[-1]
+    assert parse_ckpt_name(preempt_ckpt)[0] == 16
+    # The drain quiesced the fleet BEFORE the save: the checkpoint commit is
+    # the last thing the learner did, and it fully validates.
+    assert validate_checkpoint(preempt_ckpt, verify_digest=True)
+
+    chaos.reset()
+    run(sac_fleet_args(**{"checkpoint.resume_from": "auto:logs/runs"}))
+    resumed = _find_ckpts(chaos_dir)[-1]
+    assert parse_ckpt_name(resumed)[0] == 32
+
+    a, b = load_checkpoint(baseline), load_checkpoint(resumed)
+    assert a["iter_num"] == b["iter_num"]
+    assert a["rb"]._pos == b["rb"]._pos
+
+
+def test_sac_fleet_preempt_checkpoint_resumes_bit_exact_on_smaller_mesh(tmp_path, monkeypatch):
+    # Preempt on the 8-device mesh with TP engaged (1024-wide stacks shard
+    # over the model axis, so the recorded shardings are non-trivial).
+    save_dir = tmp_path / "wide"
+    save_dir.mkdir()
+    monkeypatch.chdir(save_dir)
+    run(
+        sac_fleet_args(
+            **{
+                "fabric.devices": 4,
+                "fabric.model_axis": 2,
+                "algo.hidden_size": 1024,
+                "resilience.chaos.enabled": True,
+                "resilience.chaos.injectors": "[{kind: sigterm, at_step: 16}]",
+            }
+        )
+    )
+    preempt_ckpt = _find_ckpts(save_dir)[-1]
+    manifest = read_manifest(preempt_ckpt)
+    recorded = load_recorded_shardings(preempt_ckpt)
+    assert recorded, "preemption save must record per-leaf shardings"
+    assert any(
+        "model" in str(rec["spec"]) for rec in recorded.values()
+    ), "TP layout should appear in at least one recorded spec"
+    assert int(manifest["schema_version"]) == 1  # sidecar key, same schema
+
+    # Resume the same checkpoint on HALF the mesh: the recorded specs adapt
+    # (model axis still present, data axis smaller) and the restored values
+    # are the saved host payload bit for bit — then training continues to
+    # the original horizon.
+    chaos.reset()
+    run(
+        sac_fleet_args(
+            **{
+                "fabric.devices": 2,
+                "fabric.model_axis": 2,
+                "algo.hidden_size": 1024,
+                "checkpoint.resume_from": "auto:logs/runs",
+            }
+        )
+    )
+    resumed = _find_ckpts(save_dir)[-1]
+    assert parse_ckpt_name(resumed)[0] == 32
+    assert load_checkpoint(resumed)["iter_num"] == 16
+
+    # Bit-exact reproduction on the smaller mesh: replay the exact elastic
+    # placement the resumed learner performed (recorded shardings from the
+    # 8-device save, adapted to a 4-device mesh) and compare every leaf to
+    # the checkpoint's host payload.
+    import jax
+
+    from sheeprl_tpu.core import mesh as mesh_lib
+    from sheeprl_tpu.utils.checkpoint import place_with_recorded_shardings
+
+    host_agent = load_checkpoint(preempt_ckpt)["agent"]
+    small_mesh = mesh_lib.build_mesh(jax.devices()[:4], model_axis_size=2)
+    placed = place_with_recorded_shardings(host_agent, recorded, small_mesh, prefix="agent")
+    for host_leaf, placed_leaf in zip(
+        jax.tree_util.tree_leaves(host_agent), jax.tree_util.tree_leaves(placed)
+    ):
+        np.testing.assert_array_equal(np.asarray(host_leaf), np.asarray(placed_leaf))
