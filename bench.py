@@ -584,6 +584,80 @@ def bench_sac_mesh8():
     }
 
 
+def _bench_anakin_shard8(metric_prefix, exp, baseline_sps, extra=()):
+    """Sharded-learner leg: a fused Anakin run on the virtual 8-device CPU
+    mesh (main() injects the device-count flag before the jax import) with
+    the shard_map'd superstep, the data-sharded device ring and the
+    explicitly-sharded train jit all on the measured path. Headline is
+    env-steps/s against the same reference wall-clock as the unsharded
+    Anakin row; the record embeds the per-shard MFU map plus the
+    perf/shard_imbalance gauge so a layout change that skews one shard is
+    visible to `telemetry perf --check`. SHEEPRL_SHARD_BENCH_STEPS shrinks
+    the run for the CI smoke leg."""
+    from sheeprl_tpu.cli import check_configs
+    from sheeprl_tpu.config.loader import compose
+    from sheeprl_tpu.core import fused_loop
+    from sheeprl_tpu.telemetry.perf import last_published
+
+    steps = int(os.environ.get("SHEEPRL_SHARD_BENCH_STEPS", "16384"))
+    overrides = [
+        f"exp={exp}",
+        "algo.fused_rollout=True",
+        "fabric.devices=8",
+        "env.num_envs=8",
+        "telemetry.enabled=True",
+        "metric.log_level=1",
+        "metric.disable_timer=True",
+        "algo.run_test=False",
+        f"algo.total_steps={steps}",
+        "checkpoint.every=0",
+        "checkpoint.save_last=False",
+        *extra,
+    ]
+    cfg = compose("config", overrides)
+    check_configs(cfg)
+    t0 = time.perf_counter()
+    _run_silent(cfg)
+    wall = time.perf_counter() - t0
+    stats = fused_loop.last_run_stats()
+    gauges = last_published() or {}
+    prefix = "perf/shard/"
+    shards = {
+        name[len(prefix) : -len("/mfu")]: round(float(v), 8)
+        for name, v in gauges.items()
+        if name.startswith(prefix) and name.endswith("/mfu")
+    }
+    value = round(stats["env_steps"] / max(wall, 1e-9), 2)
+    return {
+        "metric": f"{metric_prefix}_env_steps_per_sec",
+        "value": value,
+        "unit": "env_steps_per_sec",
+        "vs_baseline": round(value / baseline_sps, 3),
+        "devices": 8,
+        "shards": shards,
+        "aggregate_mfu": round(float(gauges.get("perf/mfu", 0.0)), 8),
+        "shard_imbalance": round(float(gauges.get("perf/shard_imbalance", 1.0)), 4),
+        "fused": {
+            "supersteps": stats["supersteps"],
+            "jit_dispatches": stats["jit_dispatches"],
+            "env_steps": stats["env_steps"],
+        },
+    }
+
+
+def bench_sac_shard8():
+    # Same reference wall-clock as the sac rows; fused_train_steps sized as
+    # in bench_sac_anakin so steady-state supersteps stay 2 dispatches.
+    return _bench_anakin_shard8(
+        "sac_shard8", "sac_anakin", 65536 / 320.21,
+        extra=("algo.learning_starts=1024", "algo.fused_train_steps=1024"),
+    )
+
+
+def bench_ppo_anakin_shard8():
+    return _bench_anakin_shard8("ppo_anakin_shard8", "ppo_anakin", 65536 / 81.27)
+
+
 def bench_serve_sac(traced: bool = False):
     """Closed-loop load test of the serving stack (sheeprl_tpu/serve): train
     a tiny SAC policy, export it to an artifact, host it in an
@@ -1071,14 +1145,14 @@ def main() -> None:
     # outright so the accelerator plugin is never initialized for them.
     # Accelerator workloads probe the device first and fall back to CPU
     # (recorded in the output) rather than hang on a wedged plugin.
-    if which in ("sac_mesh8", "sac_fleet"):
+    if which in ("sac_mesh8", "sac_fleet", "sac_shard8", "ppo_anakin_shard8"):
         # Virtual multi-device CPU legs: the flag must be in the environment
         # before the first jax import or the CPU backend initializes with one
         # device and the mesh build fails (fleet replicas inherit it too).
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
             os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-    if which in ("ppo", "a2c", "sac", "sac_health", "sac_flight", "sac_goodput", "sac_mesh8", "sac_fleet", "serve_sac", "serve_sac_traced"):
+    if which in ("ppo", "a2c", "sac", "sac_health", "sac_flight", "sac_goodput", "sac_mesh8", "sac_fleet", "sac_shard8", "ppo_anakin_shard8", "serve_sac", "serve_sac_traced"):
         platform = "cpu"
     elif os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
         platform = "cpu"  # already pinned: nothing to probe
@@ -1125,6 +1199,8 @@ def main() -> None:
         "ppo_anakin": bench_ppo_anakin,
         "sac_anakin": bench_sac_anakin,
         "dreamer_v3_anakin": bench_dreamer_v3_anakin,
+        "sac_shard8": bench_sac_shard8,
+        "ppo_anakin_shard8": bench_ppo_anakin_shard8,
     }[which]()
     result["backend"] = jax.default_backend()
     _emit(which, result)

@@ -39,4 +39,13 @@ python -m sheeprl_tpu.analysis --no-baseline \
     sheeprl_tpu/telemetry/perf.py sheeprl_tpu/telemetry/bench_db.py \
     sheeprl_tpu/telemetry/mesh_obs.py || rc=1
 
+# Sharded-learner gate: every core/ and data/ file the mesh-parallel train
+# path flows through (mesh plan -> runtime -> fused superstep -> device
+# ring) holds zero findings by name — the shardlint mesh/collective pack
+# (GL014-GL018) must stay clean on the SPMD hot path with no suppressions.
+echo "== graftlint (sharded learner hot path, zero findings) =="
+python -m sheeprl_tpu.analysis --no-baseline \
+    sheeprl_tpu/core/mesh.py sheeprl_tpu/core/runtime.py \
+    sheeprl_tpu/core/fused_loop.py sheeprl_tpu/data/device_buffer.py || rc=1
+
 exit "$rc"

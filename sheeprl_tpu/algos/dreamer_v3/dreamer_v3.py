@@ -42,6 +42,7 @@ from sheeprl_tpu.algos.ppo.agent import actions_metadata
 from sheeprl_tpu.config.instantiate import instantiate, locate
 from sheeprl_tpu.core.interact import InteractionPipeline
 from sheeprl_tpu.core.resilience import watch
+from sheeprl_tpu.core import mesh as mesh_lib
 from sheeprl_tpu.core.mesh import DATA_AXIS
 from sheeprl_tpu.core.player import PlayerPlacement
 from sheeprl_tpu.data.infeed import ReplayInfeed
@@ -74,6 +75,32 @@ def _make_optimizer(optim_cfg: Dict[str, Any], clip: float) -> optax.GradientTra
     if clip is not None and clip > 0:
         return optax.chain(optax.clip_by_global_norm(clip), inner)
     return inner
+
+
+def partition_specs(mesh) -> mesh_lib.PartitionPlan:
+    """DreamerV3's mesh partitioning: time-major ``[T, B, ...]`` batches are
+    sharded over the batch axis (``data``), params follow the wide-param rule
+    (tensor-parallel over ``model`` when enabled, replicated otherwise)."""
+    from jax.sharding import PartitionSpec as P
+
+    return mesh_lib.default_partition_plan(mesh, batch_specs={"batch": P(None, DATA_AXIS)})
+
+
+def _explicit_shardings(plan, state, opt_states, data_sharding):
+    """in/out_shardings for the 6-arg dreamer train jits.
+
+    Positional layout: (state, opt_states, moments_state, data-or-ring, key,
+    tau-or-taus) -> (state, opt_states, moments_state, metrics, next_key).
+    Param/opt entries mirror the *actual* placement of the already-sharded
+    trees so compilation never inserts a resharding copy; the moments pytree
+    and PRNG keys are replicated scalars."""
+    state_sh = mesh_lib.tree_shardings(state)
+    opt_sh = mesh_lib.tree_shardings(opt_states)
+    repl = plan.replicated()
+    return dict(
+        in_shardings=(state_sh, opt_sh, repl, data_sharding, repl, repl),
+        out_shardings=(state_sh, opt_sh, repl, None, repl),
+    )
 
 
 def make_step_core(agent: DV3Agent, txs: Dict[str, optax.GradientTransformation], cfg: Dict[str, Any], mesh):
@@ -380,11 +407,32 @@ def make_step_core(agent: DV3Agent, txs: Dict[str, optax.GradientTransformation]
     return step_core
 
 
-def make_train_step(agent: DV3Agent, txs: Dict[str, optax.GradientTransformation], cfg: Dict[str, Any], mesh):
-    """Build the jitted single-gradient-step function over a [T, B] batch."""
+def make_train_step(
+    agent: DV3Agent,
+    txs: Dict[str, optax.GradientTransformation],
+    cfg: Dict[str, Any],
+    mesh,
+    state=None,
+    opt_states=None,
+):
+    """Build the jitted single-gradient-step function over a [T, B] batch.
+
+    When the already-placed ``state``/``opt_states`` trees are passed, the jit
+    compiles with explicit ``in_shardings``/``out_shardings``: params/opt keep
+    their recorded layouts and the [T, B] batch is sharded over ``data`` on its
+    batch axis, so the gradient step is data-parallel end to end."""
     step_core = make_step_core(agent, txs, cfg, mesh)
 
-    @partial(jax.jit, donate_argnums=(0, 1, 2))
+    plan = partition_specs(mesh)
+    jit_kwargs = {}
+    if (
+        state is not None
+        and opt_states is not None
+        and int(cfg.algo.per_rank_batch_size) % plan.data_size == 0
+    ):
+        jit_kwargs = _explicit_shardings(plan, state, opt_states, plan.sharding("batch"))
+
+    @partial(jax.jit, donate_argnums=(0, 1, 2), **jit_kwargs)
     def train_step(state, opt_states, moments_state, data, key, tau):
         next_key, key = jax.random.split(key)
         state, opt_states, moments_state, metrics = step_core(
@@ -401,6 +449,9 @@ def make_fused_train_step(
     cfg: Dict[str, Any],
     mesh,
     sample_fn,
+    state=None,
+    opt_states=None,
+    ring_shardings=None,
 ):
     """Fuse K gradient steps (sampling included) into ONE jitted lax.scan.
 
@@ -413,7 +464,18 @@ def make_fused_train_step(
     """
     step_core = make_step_core(agent, txs, cfg, mesh)
 
-    @partial(jax.jit, donate_argnums=(0, 1, 2))
+    plan = partition_specs(mesh)
+    jit_kwargs = {}
+    if (
+        state is not None
+        and opt_states is not None
+        and int(cfg.algo.per_rank_batch_size) % plan.data_size == 0
+    ):
+        # ring_shardings (DeviceReplayRing.state_shardings()) pins the ring
+        # tree to its sharded-over-envs placement; None leaves it free.
+        jit_kwargs = _explicit_shardings(plan, state, opt_states, ring_shardings)
+
+    @partial(jax.jit, donate_argnums=(0, 1, 2), **jit_kwargs)
     def fused_train_step(state, opt_states, moments_state, ring_state, key, taus):
         next_key, key = jax.random.split(key)
         step_keys = jax.random.split(key, taus.shape[0])
@@ -548,6 +610,11 @@ def main(runtime, cfg: Dict[str, Any]):
     agent_state = runtime.shard_params(agent_state)
     opt_states = runtime.shard_params(opt_states)
 
+    # Arm per-shard goodput accounting: the observatory needs the mesh and the
+    # realised param layouts to attribute MFU/imbalance per data-shard.
+    telemetry.set_mesh(mesh)
+    telemetry.record_param_layouts(agent_state)
+
     moments_state = init_moments()
     if state_ckpt is not None and "moments" in state_ckpt:
         moments_state = jax.tree_util.tree_map(jnp.asarray, state_ckpt["moments"])
@@ -602,7 +669,7 @@ def main(runtime, cfg: Dict[str, Any]):
             "the checkpoint will be saved at the nearest greater multiple of the policy_steps_per_iter value."
         )
 
-    train_fn = make_train_step(agent, txs, cfg, mesh)
+    train_fn = make_train_step(agent, txs, cfg, mesh, state=agent_state, opt_states=opt_states)
 
     # Device-resident replay ring (data/device_buffer.py): rollout rows are
     # mirrored into HBM and the fused train step samples them inside its own
@@ -620,6 +687,7 @@ def main(runtime, cfg: Dict[str, Any]):
             obs_keys=tuple(obs_keys),
             hbm_fraction=float(cfg.buffer.get("device_hbm_fraction", 0.4)),
             device=mesh.devices.flat[0],
+            mesh=mesh,
         )
         if state_ckpt is not None and cfg.buffer.checkpoint and state_ckpt.get("rb") is not None:
             ring.load_host_buffer(rb)
@@ -628,7 +696,16 @@ def main(runtime, cfg: Dict[str, Any]):
             sequence_length=cfg.algo.per_rank_sequence_length,
             time_major=True,
         )
-        fused_train_fn = make_fused_train_step(agent, txs, cfg, mesh, ring_sample_fn)
+        fused_train_fn = make_fused_train_step(
+            agent,
+            txs,
+            cfg,
+            mesh,
+            ring_sample_fn,
+            state=agent_state,
+            opt_states=opt_states,
+            ring_shardings=ring.state_shardings(),
+        )
 
     # Async infeed (data/infeed.py): the next train call's sampled batches
     # are copied host->device by a worker thread while envs step, so the

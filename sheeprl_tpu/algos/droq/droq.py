@@ -31,6 +31,7 @@ from sheeprl_tpu.algos.sac.sac import _make_optimizer
 from sheeprl_tpu.config.instantiate import instantiate
 from sheeprl_tpu.core.interact import InteractionPipeline
 from sheeprl_tpu.core.resilience import watch
+from sheeprl_tpu.core import mesh as mesh_lib
 from sheeprl_tpu.core.mesh import DATA_AXIS
 from sheeprl_tpu.core.player import PlayerPlacement
 from sheeprl_tpu.data.buffers import ReplayBuffer
@@ -129,16 +130,51 @@ def make_actor_alpha_update(
     return actor_alpha_update
 
 
-def make_train_step(agent: DROQAgent, txs: Dict[str, optax.GradientTransformation], cfg: Dict[str, Any], mesh):
-    """Build the jitted (G critic steps + 1 actor step) update."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
+def partition_specs(mesh) -> mesh_lib.PartitionPlan:
+    """DroQ's partition-spec hook: scanned critic minibatches are
+    ``[G, B, ...]`` (batch dim 1 over `data`), the actor batch and
+    ring-sampled batches are flat ``[B, ...]``; params follow the default
+    wide-param model-sharding rule."""
+    from jax.sharding import PartitionSpec as P
 
+    return mesh_lib.default_partition_plan(
+        mesh,
+        batch_specs={"scan_batch": P(None, DATA_AXIS), "batch": P(DATA_AXIS)},
+    )
+
+
+def make_train_step(
+    agent: DROQAgent,
+    txs: Dict[str, optax.GradientTransformation],
+    cfg: Dict[str, Any],
+    mesh,
+    state=None,
+    opt_states=None,
+):
+    """Build the jitted (G critic steps + 1 actor step) update. With the
+    placed ``state``/``opt_states`` trees given, the jit compiles with
+    explicit ``in_shardings``/``out_shardings`` over the mesh."""
     critic_step = make_critic_step(agent, txs, cfg)
     actor_alpha_update = make_actor_alpha_update(agent, txs, cfg)
-    batch_sharding = NamedSharding(mesh, P(None, DATA_AXIS))
-    flat_sharding = NamedSharding(mesh, P(DATA_AXIS))
+    plan = partition_specs(mesh)
+    batch_sharding = plan.sharding("scan_batch")
+    flat_sharding = plan.sharding("batch")
 
-    @partial(jax.jit, donate_argnums=(0, 1))
+    jit_kwargs = {}
+    if (
+        state is not None
+        and opt_states is not None
+        and int(cfg.algo.per_rank_batch_size) % plan.data_size == 0
+    ):
+        state_sh = mesh_lib.tree_shardings(state)
+        opt_sh = mesh_lib.tree_shardings(opt_states)
+        repl = plan.replicated()
+        jit_kwargs = dict(
+            in_shardings=(state_sh, opt_sh, batch_sharding, flat_sharding, repl),
+            out_shardings=(state_sh, opt_sh, None, repl),
+        )
+
+    @partial(jax.jit, donate_argnums=(0, 1), **jit_kwargs)
     def train_step(state, opt_states, critic_data, actor_data, key):
         """critic_data: dict of [G, B, ...]; actor_data: dict of [B, ...]."""
         next_key, key = jax.random.split(key)
@@ -177,22 +213,43 @@ def make_fused_train_step(
     cfg: Dict[str, Any],
     mesh,
     sample_fn,
+    state=None,
+    opt_states=None,
+    ring_shardings=None,
 ):
     """Build the ring-sampled K-critic-step update: every critic minibatch —
     and the actor's separate batch — is drawn from the device-resident
     replay ring inside the jit. ``with_actor`` (static) runs the single
     actor+alpha update, so the caller enables it only on the LAST bucket of
-    an iteration, preserving the one-actor-step-per-env-step cadence."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    an iteration, preserving the one-actor-step-per-env-step cadence.
 
+    With the placed ``state``/``opt_states`` given, the jit compiles with
+    explicit ``in_shardings``/``out_shardings``; ``ring_shardings`` pins the
+    `data`-sharded ring layout across calls."""
     critic_step = make_critic_step(agent, txs, cfg)
     actor_alpha_update = make_actor_alpha_update(agent, txs, cfg)
-    flat_sharding = NamedSharding(mesh, P(DATA_AXIS))
+    plan = partition_specs(mesh)
+    flat_sharding = plan.sharding("batch")
 
     def _shard(batch):
         return jax.lax.with_sharding_constraint(batch, {k: flat_sharding for k in batch})
 
-    @partial(jax.jit, donate_argnums=(0, 1), static_argnums=(4, 5))
+    jit_kwargs = {}
+    if (
+        state is not None
+        and opt_states is not None
+        and int(cfg.algo.per_rank_batch_size) % plan.data_size == 0
+    ):
+        state_sh = mesh_lib.tree_shardings(state)
+        opt_sh = mesh_lib.tree_shardings(opt_states)
+        repl = plan.replicated()
+        # static args (k_steps, with_actor) are excluded from in_shardings.
+        jit_kwargs = dict(
+            in_shardings=(state_sh, opt_sh, ring_shardings, repl),
+            out_shardings=(state_sh, opt_sh, None, repl),
+        )
+
+    @partial(jax.jit, donate_argnums=(0, 1), static_argnums=(4, 5), **jit_kwargs)
     def fused_train_step(state, opt_states, ring_state, key, k_steps, with_actor):
         next_key, key = jax.random.split(key)
         k_scan, k_actor_sample, k_actor, k_actor_drop = jax.random.split(key, 4)
@@ -298,6 +355,10 @@ def main(runtime, cfg: Dict[str, Any]):
                 opt_states[name] = restore_opt_state(opt_states[name], state_ckpt[ckpt_key])
     agent_state = runtime.shard_params(agent_state)
     opt_states = runtime.shard_params(opt_states)
+    # Arm per-shard goodput accounting and record the topology + param
+    # layouts for the `telemetry mesh` inspector, now that both exist.
+    telemetry.set_mesh(mesh)
+    telemetry.record_param_layouts(agent_state)
 
     if runtime.is_global_zero:
         save_configs(cfg, log_dir)
@@ -353,7 +414,7 @@ def main(runtime, cfg: Dict[str, Any]):
         return agent.get_actions(p, o, sub, greedy=False), next_k
 
     player_fn = jax.jit(_player)
-    train_fn = make_train_step(agent, txs, cfg, mesh)
+    train_fn = make_train_step(agent, txs, cfg, mesh, state=agent_state, opt_states=opt_states)
 
     # Device-resident replay ring (data/device_buffer.py): transitions are
     # mirrored into HBM and sampled inside the fused train jit — the host
@@ -371,6 +432,7 @@ def main(runtime, cfg: Dict[str, Any]):
             obs_keys=("observations",),
             hbm_fraction=float(cfg.buffer.get("device_hbm_fraction", 0.4)),
             device=mesh.devices.flat[0],
+            mesh=mesh,
         )
         if state_ckpt is not None and cfg.buffer.checkpoint and state_ckpt.get("rb") is not None:
             ring.load_host_buffer(rb)
@@ -379,7 +441,10 @@ def main(runtime, cfg: Dict[str, Any]):
             sequence_length=1,
             sample_next_obs=bool(cfg.buffer.sample_next_obs),
         )
-        fused_train_fn = make_fused_train_step(agent, txs, cfg, mesh, ring_sample_fn)
+        fused_train_fn = make_fused_train_step(
+            agent, txs, cfg, mesh, ring_sample_fn,
+            state=agent_state, opt_states=opt_states, ring_shardings=ring.state_shardings(),
+        )
 
     # Latency-aware player placement (core/player.py); off-policy: honors
     # fabric.player_sync=async.

@@ -37,6 +37,7 @@ from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
 from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
 from sheeprl_tpu.core.interact import InteractionPipeline
 from sheeprl_tpu.core.resilience import watch
+from sheeprl_tpu.core import mesh as mesh_lib
 from sheeprl_tpu.core.mesh import DATA_AXIS
 from sheeprl_tpu.core.player import PlayerPlacement
 from sheeprl_tpu.core.rollout import fuse_gae_pool, ship_rollout
@@ -73,6 +74,19 @@ def make_optimizer(cfg: Dict[str, Any]) -> tuple:
     return optax.inject_hyperparams(make_tx)(lr=base_lr), base_lr
 
 
+def partition_specs(mesh) -> mesh_lib.PartitionPlan:
+    """PPO's partition-spec hook: the flat sample pool and its minibatches
+    split their leading dim over `data`; raw rollouts are ``[T, E, ...]``
+    with the env dim (1) over `data`; params follow the default wide-param
+    model-sharding rule."""
+    from jax.sharding import PartitionSpec as P
+
+    return mesh_lib.default_partition_plan(
+        mesh,
+        batch_specs={"batch": P(DATA_AXIS), "rollout": P(None, DATA_AXIS)},
+    )
+
+
 def make_update_pool(
     agent: PPOAgent,
     tx: optax.GradientTransformation,
@@ -83,8 +97,6 @@ def make_update_pool(
     ALL epochs × minibatches as nested `lax.scan`s, permutations drawn
     in-graph. Shared by :func:`make_train_step` (which jits it standalone)
     and core/fused_loop.py (which inlines it after the in-jit rollout)."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
     update_epochs = int(cfg.algo.update_epochs)
     mb_size = int(cfg.algo.per_rank_batch_size)
     cnn_keys = list(cfg.algo.cnn_keys.encoder)
@@ -96,6 +108,8 @@ def make_update_pool(
 
     gamma = float(cfg.algo.gamma)
     gae_lambda = float(cfg.algo.gae_lambda)
+
+    plan = partition_specs(mesh)
 
     def loss_fn(params, batch, clip_coef, ent_coef):
         obs = normalize_obs({k: batch[k] for k in obs_keys}, cnn_keys, obs_keys)
@@ -112,7 +126,7 @@ def make_update_pool(
         approx_kl = jnp.mean(batch["logprobs"] - new_logprobs)
         return total, (pg_loss, v_loss, ent_loss, jnp.mean(entropy), approx_kl)
 
-    batch_sharding = NamedSharding(mesh, P(DATA_AXIS))
+    batch_sharding = plan.sharding("batch")
 
     def update_pool(params, opt_state, pool, key, clip_coef, ent_coef):
         """Epoch × minibatch scans over the flat sample pool."""
@@ -169,6 +183,8 @@ def make_train_step(
     cfg: Dict[str, Any],
     mesh,
     fused_gae: bool = True,
+    params=None,
+    opt_state=None,
 ):
     """Build the jitted full-update function (epochs × minibatches in-graph).
 
@@ -179,22 +195,56 @@ def make_train_step(
     (ppo_decoupled, which computes GAE on the PLAYER device and scatters
     the finished pool to the trainer partition): the jit takes the flat
     pool with returns/advantages already present.
+
+    With the placed ``params``/``opt_state`` trees given, the jit compiles
+    with explicit ``in_shardings``/``out_shardings`` over the mesh (env dim
+    of the rollout over `data`, the params' own committed layouts carried
+    through), so gradient sync is XLA-inserted collectives by construction.
     """
     cnn_keys = list(cfg.algo.cnn_keys.encoder)
     obs_keys = cnn_keys + list(cfg.algo.mlp_keys.encoder)
     gamma = float(cfg.algo.gamma)
     gae_lambda = float(cfg.algo.gae_lambda)
     update_pool = make_update_pool(agent, tx, cfg, mesh)
+    plan = partition_specs(mesh)
+
+    explicit = params is not None and opt_state is not None
+    params_sh = mesh_lib.tree_shardings(params) if explicit else None
+    opt_sh = mesh_lib.tree_shardings(opt_state) if explicit else None
+    repl = plan.replicated()
 
     if not fused_gae:
+        jit_kwargs = {}
+        if explicit:
+            # The decoupled pool arrives pre-placed by the player->trainer
+            # scatter; leave it unconstrained and pin only state + scalars.
+            jit_kwargs = dict(
+                in_shardings=(params_sh, opt_sh, None, repl, repl, repl),
+                out_shardings=(params_sh, opt_sh, None, repl),
+            )
 
-        @partial(jax.jit, donate_argnums=(0, 1))
+        @partial(jax.jit, donate_argnums=(0, 1), **jit_kwargs)
         def train_step(params, opt_state, pool, key, clip_coef, ent_coef):
             return update_pool(params, opt_state, pool, key, clip_coef, ent_coef)
 
         return train_step
 
-    @partial(jax.jit, donate_argnums=(0, 1))
+    jit_kwargs = {}
+    if explicit and int(cfg.env.num_envs) % plan.data_size == 0:
+        jit_kwargs = dict(
+            in_shardings=(
+                params_sh,
+                opt_sh,
+                plan.sharding("rollout"),  # [T, E, ...]: env dim over `data`
+                plan.sharding("batch"),  # next_obs [E, ...]
+                repl,
+                repl,
+                repl,
+            ),
+            out_shardings=(params_sh, opt_sh, None, repl),
+        )
+
+    @partial(jax.jit, donate_argnums=(0, 1), **jit_kwargs)
     def train_step(params, opt_state, data, next_obs, key, clip_coef, ent_coef):
         # data is (T, E, ...) env-sharded (core/rollout.py); bootstrap +
         # GAE + flattening happen in-graph via the shared prologue.
@@ -269,6 +319,10 @@ def main(runtime, cfg: Dict[str, Any]):
             opt_state = restore_opt_state(opt_state, state["optimizer"])
     params = runtime.shard_params(params)
     opt_state = runtime.shard_params(opt_state)
+    # Arm per-shard goodput accounting and record the topology + param
+    # layouts for the `telemetry mesh` inspector, now that both exist.
+    telemetry.set_mesh(mesh)
+    telemetry.record_param_layouts(params)
 
     if runtime.is_global_zero:
         save_configs(cfg, log_dir)
@@ -331,7 +385,7 @@ def main(runtime, cfg: Dict[str, Any]):
     # get_values_fn survives only for the (rare) mid-rollout truncation
     # bootstrap; end-of-rollout bootstrap + GAE live inside train_fn.
     get_values_fn = jax.jit(agent.get_values)
-    train_fn = make_train_step(agent, tx, cfg, mesh)
+    train_fn = make_train_step(agent, tx, cfg, mesh, params=params, opt_state=opt_state)
 
     # Latency-aware player placement: the per-step policy forward runs where
     # dispatch is cheapest (core/player.py). On-policy => always-fresh mirror

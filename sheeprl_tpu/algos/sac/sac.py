@@ -34,6 +34,7 @@ from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.config.instantiate import instantiate, locate
 from sheeprl_tpu.core.interact import InteractionPipeline
 from sheeprl_tpu.core.resilience import watch
+from sheeprl_tpu.core import mesh as mesh_lib
 from sheeprl_tpu.core.mesh import DATA_AXIS
 from sheeprl_tpu.core.player import PlayerPlacement
 from sheeprl_tpu.data.buffers import ReplayBuffer
@@ -120,14 +121,57 @@ def make_gradient_step(agent: SACAgent, txs: Dict[str, optax.GradientTransformat
     return gradient_step
 
 
-def make_train_step(agent: SACAgent, txs: Dict[str, optax.GradientTransformation], cfg: Dict[str, Any], mesh):
-    """Build the jitted G-gradient-steps update."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
+def partition_specs(mesh) -> mesh_lib.PartitionPlan:
+    """SAC's partition-spec hook: scanned host minibatches are ``[G, B, ...]``
+    (batch dim 1 over `data`), ring-sampled batches are flat ``[B, ...]``;
+    params follow the default wide-param model-sharding rule."""
+    from jax.sharding import PartitionSpec as P
 
+    return mesh_lib.default_partition_plan(
+        mesh,
+        batch_specs={"scan_batch": P(None, DATA_AXIS), "batch": P(DATA_AXIS)},
+    )
+
+
+def _explicit_shardings(plan, state, opt_states, data_sharding):
+    """jit ``in_shardings``/``out_shardings`` for the (state, opt_states,
+    data, key, tau/taus) train-step signature, derived from the *placed*
+    trees so the compiled layout matches the placement byte for byte.
+    Gradient sync then lowers to XLA-inserted collectives over `data`
+    instead of relying on implicit layout propagation. ``data_sharding``
+    covers the third arg — a batch sharding prefix, a ring-state sharding
+    tree, or None (unconstrained)."""
+    state_sh = mesh_lib.tree_shardings(state)
+    opt_sh = mesh_lib.tree_shardings(opt_states)
+    repl = plan.replicated()
+    return dict(
+        in_shardings=(state_sh, opt_sh, data_sharding, repl, repl),
+        out_shardings=(state_sh, opt_sh, None, repl),
+    )
+
+
+def make_train_step(
+    agent: SACAgent,
+    txs: Dict[str, optax.GradientTransformation],
+    cfg: Dict[str, Any],
+    mesh,
+    state=None,
+    opt_states=None,
+):
+    """Build the jitted G-gradient-steps update. With the placed ``state`` /
+    ``opt_states`` trees given, the jit compiles with explicit
+    ``in_shardings``/``out_shardings`` over the mesh (data-sharded batch +
+    the params' own committed layouts)."""
     gradient_step = make_gradient_step(agent, txs, cfg)
-    batch_sharding = NamedSharding(mesh, P(None, DATA_AXIS))
+    plan = partition_specs(mesh)
+    batch_sharding = plan.sharding("scan_batch")
 
-    @partial(jax.jit, donate_argnums=(0, 1))
+    jit_kwargs = {}
+    divisible = int(cfg.algo.per_rank_batch_size) % plan.data_size == 0
+    if state is not None and opt_states is not None and divisible:
+        jit_kwargs = _explicit_shardings(plan, state, opt_states, batch_sharding)
+
+    @partial(jax.jit, donate_argnums=(0, 1), **jit_kwargs)
     def train_step(state, opt_states, data, key, tau_eff):
         """data: dict of [G, B, ...] minibatches; tau_eff: tau or 0.
         Returns the split-off next key so the caller never runs an eager
@@ -151,18 +195,30 @@ def make_fused_train_step(
     cfg: Dict[str, Any],
     mesh,
     sample_fn,
+    state=None,
+    opt_states=None,
+    ring_shardings=None,
 ):
     """Build the ring-sampled K-step update: each scan iteration draws its
     minibatch from the device-resident replay ring with the JAX PRNG, so the
     host samples nothing and ships no batch bytes. K rides on ``taus``'s
     length (one EMA coefficient per step — the host fills them all with the
-    iteration's tau_eff), so each power-of-two bucket compiles once."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    iteration's tau_eff), so each power-of-two bucket compiles once.
 
+    With the placed ``state``/``opt_states`` given, the jit compiles with
+    explicit ``in_shardings``/``out_shardings``; ``ring_shardings`` (from
+    :meth:`DeviceReplayRing.state_shardings`) pins the carried ring layout
+    so a `data`-sharded ring stays sharded across supersteps."""
     gradient_step = make_gradient_step(agent, txs, cfg)
-    flat_sharding = NamedSharding(mesh, P(DATA_AXIS))
+    plan = partition_specs(mesh)
+    flat_sharding = plan.sharding("batch")
 
-    @partial(jax.jit, donate_argnums=(0, 1))
+    jit_kwargs = {}
+    divisible = int(cfg.algo.per_rank_batch_size) % plan.data_size == 0
+    if state is not None and opt_states is not None and divisible:
+        jit_kwargs = _explicit_shardings(plan, state, opt_states, ring_shardings)
+
+    @partial(jax.jit, donate_argnums=(0, 1), **jit_kwargs)
     def fused_train_step(state, opt_states, ring_state, key, taus):
         next_key, key = jax.random.split(key)
         step_keys = jax.random.split(key, taus.shape[0])
@@ -316,7 +372,7 @@ def main(runtime, cfg: Dict[str, Any]):
         return agent.get_actions(p, o, sub, greedy=False), next_k
 
     player_fn = jax.jit(_player)
-    train_fn = make_train_step(agent, txs, cfg, mesh)
+    train_fn = make_train_step(agent, txs, cfg, mesh, state=agent_state, opt_states=opt_states)
     target_freq_iters = cfg.algo.critic.target_network_frequency // policy_steps_per_iter + 1
 
     # Device-resident replay ring (data/device_buffer.py): transitions are
@@ -335,6 +391,7 @@ def main(runtime, cfg: Dict[str, Any]):
             obs_keys=("observations",),
             hbm_fraction=float(cfg.buffer.get("device_hbm_fraction", 0.4)),
             device=mesh.devices.flat[0],
+            mesh=mesh,
         )
         if state_ckpt is not None and cfg.buffer.checkpoint and state_ckpt.get("rb") is not None:
             ring.load_host_buffer(rb)
@@ -343,7 +400,10 @@ def main(runtime, cfg: Dict[str, Any]):
             sequence_length=1,
             sample_next_obs=bool(cfg.buffer.sample_next_obs),
         )
-        fused_train_fn = make_fused_train_step(agent, txs, cfg, mesh, ring_sample_fn)
+        fused_train_fn = make_fused_train_step(
+            agent, txs, cfg, mesh, ring_sample_fn,
+            state=agent_state, opt_states=opt_states, ring_shardings=ring.state_shardings(),
+        )
 
     # Latency-aware player placement (core/player.py). Off-policy: honors
     # fabric.player_sync=async (the player may act on weights one update

@@ -47,8 +47,11 @@ import gymnasium as gym
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from sheeprl_tpu.config.instantiate import instantiate
+from sheeprl_tpu.core.mesh import DATA_AXIS, MODEL_AXIS
 from sheeprl_tpu.core.resilience import watch
 from sheeprl_tpu.core.rollout import fuse_gae_pool
 from sheeprl_tpu.data.device_buffer import DeviceReplayRing
@@ -89,6 +92,43 @@ def _reset_run_stats() -> None:
 
 
 # --------------------------------------------------------------- shared bits
+def _shard_superstep_enabled(cfg, mesh, num_envs: int) -> bool:
+    """True when the fused supersteps run SPMD via shard_map over ``data``.
+
+    The sharded program is the SAME program on every topology — a data axis of
+    size 1 still goes through shard_map (an identity partitioning), and every
+    per-env PRNG stream is keyed by the env's GLOBAL id — so enabling more
+    shards never changes the math, only where each env's rows live."""
+    if not bool(cfg.fabric.get("shard_superstep", True)):
+        return False
+    if int(mesh.shape[MODEL_AXIS]) > 1:
+        # Params enter the superstep replicated (in_spec P()); a model-sharded
+        # tree would be all-gathered every dispatch. Keep GSPMD placement.
+        return False
+    data_size = int(mesh.shape[DATA_AXIS])
+    if num_envs % data_size != 0:
+        warnings.warn(
+            f"fabric.shard_superstep: env.num_envs={num_envs} is not divisible by the "
+            f"`{DATA_AXIS}` mesh axis (size {data_size}); the superstep stays replicated."
+        )
+        return False
+    return True
+
+
+def _fold_env_keys(key: jax.Array, genv: jax.Array) -> jax.Array:
+    """One PRNG key per env, derived from the env's GLOBAL id (GL017): the
+    stream an env sees is invariant to how envs are split across shards."""
+    return jax.vmap(jax.random.fold_in, (None, 0))(key, genv)
+
+
+def _global_env_ids(e_local: int, sharded: bool) -> jax.Array:
+    """Global env ids for this shard's ``e_local`` rows. Under shard_map the
+    axis index recovers the shard's offset; unsharded it's just arange."""
+    if sharded:
+        return jax.lax.axis_index(DATA_AXIS) * e_local + jnp.arange(e_local)
+    return jnp.arange(e_local)
+
+
 def _where_done(done: jax.Array, a: jax.Array, b: jax.Array) -> jax.Array:
     """Per-env select on the done mask, broadcasting over feature dims."""
     return jnp.where(done.reshape(done.shape + (1,) * (a.ndim - 1)), a, b)
@@ -226,6 +266,11 @@ def ppo_fused_main(runtime, cfg: Dict[str, Any]):
     params = runtime.shard_params(params)
     opt_state = runtime.shard_params(opt_state)
 
+    # Arm per-shard goodput accounting: the observatory needs the mesh and the
+    # realised param layouts to attribute MFU/imbalance per data-shard.
+    telemetry.set_mesh(mesh)
+    telemetry.record_param_layouts(params)
+
     if runtime.is_global_zero:
         save_configs(cfg, log_dir)
 
@@ -255,19 +300,32 @@ def ppo_fused_main(runtime, cfg: Dict[str, Any]):
     update_pool = make_update_pool(agent, tx, cfg, mesh)
     step_v = jax.vmap(env.step)
     reset_v = jax.vmap(env.reset)
+    use_shard = _shard_superstep_enabled(cfg, mesh, num_envs)
 
-    def rollout_and_train(params, opt_state, env_state, obs, ep_ret, ep_len, key, clip_coef, ent_coef):
-        next_key, k_roll, k_train = jax.random.split(key, 3)
+    def rollout_core(params, env_state, obs, ep_ret, ep_len, k_roll):
+        # Local (per-shard) env width: under shard_map each shard traces with
+        # its E/data rows; unsharded this is just E. Every per-env PRNG stream
+        # is keyed by the env's GLOBAL id so both trace to the same streams.
+        e_local = obs.shape[0]
+        genv = _global_env_ids(e_local, use_shard)
 
         def body(carry, step_key):
             env_state, obs, ep_ret, ep_len = carry
             k_policy, k_step, k_reset = jax.random.split(step_key, 3)
-            actions_cat, real_actions, logprobs, values, _unused = agent.player_step(
-                params, {obs_key: obs}, k_policy
+
+            def _policy(o, k):
+                a_cat, a_real, lp, v, _next_k = agent.player_step(params, {obs_key: o[None]}, k)
+                return a_cat[0], a_real[0], lp[0], v[0]
+
+            # Per-env singleton-batch policy step: action sampling consumes
+            # the env's own key, so the draw is independent of batch width
+            # (the deterministic forward is row-independent either way).
+            actions_cat, real_actions, logprobs, values = jax.vmap(_policy)(
+                obs, _fold_env_keys(k_policy, genv)
             )
             new_state, new_obs, reward, done, info = step_v(
-                env_state, _env_actions(real_actions, env, to_env, is_continuous, E),
-                jax.random.split(k_step, E),
+                env_state, _env_actions(real_actions, env, to_env, is_continuous, e_local),
+                _fold_env_keys(k_step, genv),
             )
             # Truncation bootstrap on the TRUE next obs (pre-reset), exactly
             # the host lane's final_obs path; raw rewards feed episode stats.
@@ -279,7 +337,7 @@ def ppo_fused_main(runtime, cfg: Dict[str, Any]):
             ep_len = ep_len + 1
             # SAME_STEP autoreset: done envs restart immediately; the stored
             # transition keeps the pre-reset obs/reward.
-            r_state, r_obs = reset_v(jax.random.split(k_reset, E))
+            r_state, r_obs = reset_v(_fold_env_keys(k_reset, genv))
             env_state = jax.tree_util.tree_map(
                 lambda r, n: _where_done(done, r, n), r_state, new_state
             )
@@ -300,6 +358,30 @@ def ppo_fused_main(runtime, cfg: Dict[str, Any]):
         (env_state, obs, ep_ret, ep_len), (traj, ep_info) = jax.lax.scan(
             body, (env_state, obs, ep_ret, ep_len), jax.random.split(k_roll, T)
         )
+        return env_state, obs, ep_ret, ep_len, traj, ep_info
+
+    rollout_fn = rollout_core
+    if use_shard:
+        # SPMD rollout: each shard steps its own envs and accumulates its own
+        # [T, E/data] trajectory columns; GAE + the update pool downstream
+        # stay GSPMD over the `data`-sharded pool. check_rep=False because
+        # the unmentioned `model` axis (size 1 here) defeats replication
+        # inference; params and keys arrive replicated by construction.
+        p_env = P(DATA_AXIS)
+        p_traj = P(None, DATA_AXIS)
+        rollout_fn = shard_map(
+            rollout_core,
+            mesh=mesh,
+            in_specs=(P(), p_env, p_env, p_env, p_env, P()),
+            out_specs=(p_env, p_env, p_env, p_env, p_traj, p_traj),
+            check_rep=False,
+        )
+
+    def rollout_and_train(params, opt_state, env_state, obs, ep_ret, ep_len, key, clip_coef, ent_coef):
+        next_key, k_roll, k_train = jax.random.split(key, 3)
+        env_state, obs, ep_ret, ep_len, traj, ep_info = rollout_fn(
+            params, env_state, obs, ep_ret, ep_len, k_roll
+        )
         pool = fuse_gae_pool(
             agent, params, traj, {obs_key: obs}, flat_keys, gamma, gae_lambda, include_values=True
         )
@@ -311,9 +393,16 @@ def ppo_fused_main(runtime, cfg: Dict[str, Any]):
     superstep = jax.jit(rollout_and_train, donate_argnums=(0, 1, 2, 3, 4, 5))
 
     init_key, loop_key = jax.random.split(jax.random.fold_in(runtime.root_key, rank))
+    # Env init is computed from GLOBAL per-env keys (identical on every
+    # topology), then the carries land on their `data`-axis shards.
     env_state, obs = jax.jit(reset_v)(jax.random.split(init_key, E))
     ep_ret = jnp.zeros((E,), jnp.float32)
     ep_len = jnp.zeros((E,), jnp.int32)
+    if use_shard:
+        env_sharding = NamedSharding(mesh, P(DATA_AXIS))
+        env_state, obs, ep_ret, ep_len = jax.device_put(
+            (env_state, obs, ep_ret, ep_len), env_sharding
+        )
 
     train_timer = telemetry.step_timer("train", timer_key="Time/train_time")
     perf = telemetry.perf
@@ -493,6 +582,11 @@ def sac_fused_main(runtime, cfg: Dict[str, Any]):
     agent_state = runtime.shard_params(agent_state)
     opt_states = runtime.shard_params(opt_states)
 
+    # Arm per-shard goodput accounting: the observatory needs the mesh and the
+    # realised param layouts to attribute MFU/imbalance per data-shard.
+    telemetry.set_mesh(mesh)
+    telemetry.record_param_layouts(agent_state)
+
     if runtime.is_global_zero:
         save_configs(cfg, log_dir)
 
@@ -500,9 +594,13 @@ def sac_fused_main(runtime, cfg: Dict[str, Any]):
     if not MetricAggregator.disabled:
         aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
 
+    use_shard = _shard_superstep_enabled(cfg, mesh, num_envs)
+
     # ----------------------------------------------------------------- ring
     # The fused lane is ring-only: transitions are written in-scan and never
     # leave the device, so the ring must allocate up front (and fit HBM).
+    # Under the sharded superstep the ring storage itself is sharded over
+    # envs: each shard's in-scan writes land on the rows it owns.
     buffer_size = cfg.buffer.size // int(num_envs * world_size) if not cfg.dry_run else 1
     sample_next_obs = bool(cfg.buffer.sample_next_obs)
     ring = DeviceReplayRing(
@@ -511,6 +609,7 @@ def sac_fused_main(runtime, cfg: Dict[str, Any]):
         obs_keys=("observations",),
         hbm_fraction=float(cfg.buffer.get("device_hbm_fraction", 0.4)),
         device=mesh.devices.flat[0],
+        mesh=mesh if use_shard else None,
     )
     specs = {
         "observations": ((obs_dim,), np.float32),
@@ -538,7 +637,16 @@ def sac_fused_main(runtime, cfg: Dict[str, Any]):
         cfg.algo.per_rank_batch_size, sequence_length=1, sample_next_obs=sample_next_obs
     )
     ring_span = 1 + int(sample_next_obs)
-    fused_train_fn = make_fused_train_step(agent, txs, cfg, mesh, ring_sample_fn)
+    fused_train_fn = make_fused_train_step(
+        agent,
+        txs,
+        cfg,
+        mesh,
+        ring_sample_fn,
+        state=agent_state,
+        opt_states=opt_states,
+        ring_shardings=ring.state_shardings(),
+    )
     fused_train_steps = max(int(cfg.algo.get("fused_train_steps", 1)), 1)
 
     # ------------------------------------------------------------- counters
@@ -570,25 +678,39 @@ def sac_fused_main(runtime, cfg: Dict[str, Any]):
     reset_v = jax.vmap(env.reset)
 
     def _make_rollout(steps: int, random_actions: bool):
-        def rollout(actor_params, ring_state, env_state, obs, ep_ret, ep_len, key):
-            next_key, k_roll = jax.random.split(key)
+        def rollout_core(actor_params, ring_state, env_state, obs, ep_ret, ep_len, k_roll):
+            # Local (per-shard) env width: under shard_map each shard traces
+            # with its E/data rows (the ring's in-scan writes then touch only
+            # the rows this shard owns); unsharded this is just E. Per-env
+            # PRNG streams are keyed by the env's GLOBAL id on both paths.
+            e_local = obs.shape[0]
+            genv = _global_env_ids(e_local, use_shard)
 
             def body(carry, step_key):
                 env_state, obs, ep_ret, ep_len, ring_state = carry
                 k_act, k_step, k_reset = jax.random.split(step_key, 3)
+                act_keys = _fold_env_keys(k_act, genv)
                 if random_actions:
                     # Uniform over the canonical [-1, 1] box == the host
-                    # lane's envs.action_space.sample() after RescaleAction.
-                    actions = jax.random.uniform(k_act, (E, act_dim), minval=-1.0, maxval=1.0)
+                    # lane's envs.action_space.sample() after RescaleAction,
+                    # drawn per env from the env's own key.
+                    actions = jax.vmap(
+                        lambda k: jax.random.uniform(k, (act_dim,), minval=-1.0, maxval=1.0)
+                    )(act_keys)
                 else:
-                    actions = agent.get_actions(actor_params, obs.reshape(E, obs_dim), k_act, greedy=False)
+                    # Per-env singleton-batch policy call: the exploration
+                    # noise comes from the env's own key, so the draw is
+                    # independent of how envs are batched across shards.
+                    actions = jax.vmap(
+                        lambda o, k: agent.get_actions(actor_params, o[None, :], k, greedy=False)[0]
+                    )(obs.reshape(e_local, obs_dim), act_keys)
                 new_state, new_obs, reward, done, info = step_v(
-                    env_state, to_env(actions.reshape((E, *action_space.shape))),
-                    jax.random.split(k_step, E),
+                    env_state, to_env(actions.reshape((e_local, *action_space.shape))),
+                    _fold_env_keys(k_step, genv),
                 )
                 buf_reward = jnp.tanh(reward) if clip_rewards else reward
                 row = {
-                    "observations": obs.reshape(E, obs_dim),
+                    "observations": obs.reshape(e_local, obs_dim),
                     "actions": actions,
                     "rewards": buf_reward[:, None],
                     "terminated": info["terminated"][:, None],
@@ -596,11 +718,11 @@ def sac_fused_main(runtime, cfg: Dict[str, Any]):
                 }
                 if not sample_next_obs:
                     # TRUE next obs (pre-reset): the host lane's real_next_obs.
-                    row["next_observations"] = new_obs.reshape(E, obs_dim)
-                ring_state = write_fn(ring_state, row, jnp.ones((E,), jnp.bool_))
+                    row["next_observations"] = new_obs.reshape(e_local, obs_dim)
+                ring_state = write_fn(ring_state, row, jnp.ones((e_local,), jnp.bool_))
                 ep_ret = ep_ret + reward
                 ep_len = ep_len + 1
-                r_state, r_obs = reset_v(jax.random.split(k_reset, E))
+                r_state, r_obs = reset_v(_fold_env_keys(k_reset, genv))
                 env_state = jax.tree_util.tree_map(
                     lambda r, n: _where_done(done, r, n), r_state, new_state
                 )
@@ -612,6 +734,29 @@ def sac_fused_main(runtime, cfg: Dict[str, Any]):
 
             (env_state, obs, ep_ret, ep_len, ring_state), ep_info = jax.lax.scan(
                 body, (env_state, obs, ep_ret, ep_len, ring_state), jax.random.split(k_roll, steps)
+            )
+            return env_state, obs, ep_ret, ep_len, ring_state, ep_info
+
+        core = rollout_core
+        if use_shard:
+            # SPMD superstep: each shard steps its own envs and writes its own
+            # ring rows; no cross-shard traffic inside the scan. check_rep is
+            # off because the unmentioned `model` axis (size 1 here) defeats
+            # replication inference; params/keys arrive replicated.
+            p_env = P(DATA_AXIS)
+            ring_specs = jax.tree_util.tree_map(lambda s: s.spec, ring.state_shardings())
+            core = shard_map(
+                rollout_core,
+                mesh=mesh,
+                in_specs=(P(), ring_specs, p_env, p_env, p_env, p_env, P()),
+                out_specs=(p_env, p_env, p_env, p_env, ring_specs, P(None, DATA_AXIS)),
+                check_rep=False,
+            )
+
+        def rollout(actor_params, ring_state, env_state, obs, ep_ret, ep_len, key):
+            next_key, k_roll = jax.random.split(key)
+            env_state, obs, ep_ret, ep_len, ring_state, ep_info = core(
+                actor_params, ring_state, env_state, obs, ep_ret, ep_len, k_roll
             )
             return env_state, obs, ep_ret, ep_len, ring_state, ep_info, next_key
 
@@ -628,9 +773,16 @@ def sac_fused_main(runtime, cfg: Dict[str, Any]):
 
     init_key, loop_key = jax.random.split(jax.random.fold_in(runtime.root_key, rank))
     rollout_key, train_key = jax.random.split(loop_key)
+    # Env init is computed from GLOBAL per-env keys (identical on every
+    # topology), then the carries land on their `data`-axis shards.
     env_state, obs = jax.jit(reset_v)(jax.random.split(init_key, E))
     ep_ret = jnp.zeros((E,), jnp.float32)
     ep_len = jnp.zeros((E,), jnp.int32)
+    if use_shard:
+        env_sharding = NamedSharding(mesh, P(DATA_AXIS))
+        env_state, obs, ep_ret, ep_len = jax.device_put(
+            (env_state, obs, ep_ret, ep_len), env_sharding
+        )
     ring_state = ring.state
 
     cumulative_per_rank_gradient_steps = 0
@@ -860,6 +1012,11 @@ def dreamer_v3_fused_main(runtime, cfg: Dict[str, Any]):
     agent_state = runtime.shard_params(agent_state)
     opt_states = runtime.shard_params(opt_states)
 
+    # Arm per-shard goodput accounting: the observatory needs the mesh and the
+    # realised param layouts to attribute MFU/imbalance per data-shard.
+    telemetry.set_mesh(mesh)
+    telemetry.record_param_layouts(agent_state)
+
     moments_state = init_moments()
     if state_ckpt is not None and "moments" in state_ckpt:
         moments_state = jax.tree_util.tree_map(jnp.asarray, state_ckpt["moments"])
@@ -872,6 +1029,9 @@ def dreamer_v3_fused_main(runtime, cfg: Dict[str, Any]):
         aggregator: MetricAggregator = instantiate(cfg.metric.aggregator)
 
     # ----------------------------------------------------------------- ring
+    # Dreamer's superstep keeps GSPMD placement (the recurrent player latents
+    # and sparse reset rows make its carry sharding XLA's call); the ring is
+    # still env-sharded so the fused train jit samples per-shard minibatches.
     buffer_size = cfg.buffer.size // int(num_envs * world_size) if not cfg.dry_run else 2
     ring = DeviceReplayRing(
         buffer_size,
@@ -880,6 +1040,7 @@ def dreamer_v3_fused_main(runtime, cfg: Dict[str, Any]):
         obs_keys=tuple(obs_keys),
         hbm_fraction=float(cfg.buffer.get("device_hbm_fraction", 0.4)),
         device=mesh.devices.flat[0],
+        mesh=mesh,
     )
     obs_dtype = np.uint8 if pixel else np.float32
     specs = {
@@ -905,7 +1066,16 @@ def dreamer_v3_fused_main(runtime, cfg: Dict[str, Any]):
         sequence_length=cfg.algo.per_rank_sequence_length,
         time_major=True,
     )
-    fused_train_fn = make_fused_train_step(agent, txs, cfg, mesh, ring_sample_fn)
+    fused_train_fn = make_fused_train_step(
+        agent,
+        txs,
+        cfg,
+        mesh,
+        ring_sample_fn,
+        state=agent_state,
+        opt_states=opt_states,
+        ring_shardings=ring.state_shardings(),
+    )
     fused_train_steps = max(int(cfg.algo.get("fused_train_steps", 1)), 1)
 
     # ------------------------------------------------------------- counters

@@ -20,7 +20,8 @@ so the accounting adds one function call per put to the infeed hot path.
 
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence
+import dataclasses
+from typing import Any, Dict, Mapping, Optional, Sequence
 
 import jax
 import numpy as np
@@ -167,6 +168,7 @@ def shard_batch(tree: Any, mesh: Mesh, axis: int = 0) -> Any:
     placing each shard directly on its device (no gather on one chip).
     """
     moved = 0
+    data_size = int(mesh.shape[DATA_AXIS])
 
     def _put(x):
         nonlocal moved
@@ -174,6 +176,16 @@ def shard_batch(tree: Any, mesh: Mesh, axis: int = 0) -> Any:
         moved += x.nbytes
         spec = [None] * x.ndim
         if x.ndim > axis:
+            n = int(x.shape[axis])
+            if data_size > 1 and n % data_size != 0:
+                lo = (n // data_size) * data_size
+                hi = lo + data_size
+                nearest = str(hi) if lo == 0 else f"{lo} or {hi}"
+                raise ValueError(
+                    f"shard_batch: batch dim {axis} of size {n} is not divisible by "
+                    f"the `{DATA_AXIS}` mesh axis (size {data_size}); nearest valid "
+                    f"batch size: {nearest}."
+                )
             spec[axis] = DATA_AXIS
         return jax.device_put(x, NamedSharding(mesh, P(*spec)))
 
@@ -239,42 +251,131 @@ def constrain(tree: Any, sharding: Any) -> Any:
     return put_sharded(tree, sharding)
 
 
+def param_partition_spec(x: Any, mesh: Mesh, min_dim: int = 1024) -> P:
+    """The width-based model-parallel rule for a single param/opt leaf.
+
+    Any floating-point leaf whose trailing dim is >= ``min_dim`` and divisible
+    by the model-axis size is split along that dim over `model` (column-parallel
+    for a dense kernel, matching split for its bias / optimizer moments);
+    everything else replicates. Works on abstract leaves too — only ``shape``
+    and ``dtype`` are consulted — so the same rule yields jit
+    ``in_shardings``/``out_shardings`` and eager placements that agree.
+    """
+    model_size = int(mesh.shape[MODEL_AXIS])
+    x = np.asarray(x) if not hasattr(x, "shape") else x
+    ndim = getattr(x, "ndim", 0)
+    wide = (
+        model_size > 1
+        and ndim >= 1
+        and x.shape[-1] >= min_dim
+        and x.shape[-1] % model_size == 0
+        and jax.numpy.issubdtype(x.dtype, jax.numpy.floating)
+    )
+    if wide:
+        return P(*([None] * (ndim - 1) + [MODEL_AXIS]))
+    return P()
+
+
+def param_partition_specs(tree: Any, mesh: Mesh, min_dim: int = 1024) -> Any:
+    """Per-leaf :func:`param_partition_spec` over a whole pytree."""
+    return jax.tree_util.tree_map(lambda x: param_partition_spec(x, mesh, min_dim), tree)
+
+
+def param_shardings(tree: Any, mesh: Mesh, min_dim: int = 1024) -> Any:
+    """Per-leaf ``NamedSharding`` tree under the wide-param rule — the form
+    ``jax.jit(in_shardings=..., out_shardings=...)`` wants."""
+    return jax.tree_util.tree_map(
+        lambda x: NamedSharding(mesh, param_partition_spec(x, mesh, min_dim)), tree
+    )
+
+
+def tree_shardings(tree: Any) -> Any:
+    """Per-leaf committed shardings of an already-placed pytree (``None`` for
+    host leaves, which jit treats as unconstrained). Feeding a train jit's
+    ``in_shardings``/``out_shardings`` from the placed state guarantees the
+    compiled layout matches the actual placement byte for byte."""
+    return jax.tree_util.tree_map(lambda x: getattr(x, "sharding", None), tree)
+
+
 def shard_wide_params(tree: Any, mesh: Mesh, min_dim: int = 1024) -> Any:
     """Place a param/opt pytree on the mesh with wide leaves sharded over the
     `model` axis (tensor parallelism) and everything else replicated.
 
-    The rule is width-based, not name-based: any floating-point leaf whose
-    trailing dim is >= ``min_dim`` and divisible by the model-axis size is
-    split along that dim (column-parallel for a dense kernel, matching split
-    for its bias / optimizer moments). GSPMD propagates the layout through the
-    jitted computation and inserts the all-gathers / reduce-scatters — the
-    semantics are unchanged whatever the rule picks, only the layout varies.
-    This is what makes `fabric.model_axis > 1` real for the 1024–4096-wide
-    Dreamer dense stacks (SURVEY §2.1's TPU-native extra; the reference has no
-    TP of any kind).
+    The rule is :func:`param_partition_spec` — width-based, not name-based.
+    GSPMD propagates the layout through the jitted computation and inserts the
+    all-gathers / reduce-scatters — the semantics are unchanged whatever the
+    rule picks, only the layout varies. This is what makes
+    `fabric.model_axis > 1` real for the 1024–4096-wide Dreamer dense stacks
+    (SURVEY §2.1's TPU-native extra; the reference has no TP of any kind).
     """
-    model_size = int(mesh.shape[MODEL_AXIS])
     moved = 0
 
     def _put(x):
         nonlocal moved
         x = np.asarray(x) if not hasattr(x, "shape") else x
         moved += _leaf_nbytes(x)
-        wide = (
-            model_size > 1
-            and getattr(x, "ndim", 0) >= 1
-            and x.shape[-1] >= min_dim
-            and x.shape[-1] % model_size == 0
-            and jax.numpy.issubdtype(x.dtype, jax.numpy.floating)
-        )
-        if wide:
-            spec = [None] * (x.ndim - 1) + [MODEL_AXIS]
-            return jax.device_put(x, NamedSharding(mesh, P(*spec)))
-        return jax.device_put(x, NamedSharding(mesh, P()))
+        return jax.device_put(x, NamedSharding(mesh, param_partition_spec(x, mesh, min_dim)))
 
     out = jax.tree_util.tree_map(_put, tree)
     _account_transfer("h2d", moved)
     return out
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionPlan:
+    """An algorithm's partition specs in one object: named batch layouts plus
+    the wide-param rule, resolved against a concrete mesh.
+
+    Each algo module exposes a ``partition_specs(mesh)`` hook returning one of
+    these (the t5x axis-rules idea, scaled to this repo: layouts are data, not
+    scattered ``NamedSharding`` constructions). Train-jit builders pull their
+    batch/output shardings from the plan, and the runtime's
+    ``shard_params`` placement agrees with :meth:`param_shardings` by
+    construction, so explicit ``in_shardings`` never fight the placement.
+    """
+
+    mesh: Mesh
+    batch_specs: Mapping[str, P] = dataclasses.field(
+        default_factory=lambda: {"batch": P(DATA_AXIS)}
+    )
+    min_dim: int = 1024
+
+    def spec(self, name: str = "batch") -> P:
+        # Unregistered layouts resolve to replicated: a jit builder can ask
+        # for a spec its algo never declared and get the safe default.
+        return self.batch_specs.get(name, P())
+
+    def sharding(self, name: str = "batch") -> NamedSharding:
+        return NamedSharding(self.mesh, self.spec(name))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def param_specs(self, tree: Any) -> Any:
+        return param_partition_specs(tree, self.mesh, self.min_dim)
+
+    def param_shardings(self, tree: Any) -> Any:
+        return param_shardings(tree, self.mesh, self.min_dim)
+
+    def place_params(self, tree: Any) -> Any:
+        return shard_wide_params(tree, self.mesh, self.min_dim)
+
+    @property
+    def data_size(self) -> int:
+        return int(self.mesh.shape[DATA_AXIS])
+
+
+def default_partition_plan(
+    mesh: Mesh,
+    batch_specs: Optional[Mapping[str, P]] = None,
+    min_dim: int = 1024,
+) -> PartitionPlan:
+    """Data-sharded batch + wide-param model sharding — the default every
+    ``partition_specs()`` hook starts from."""
+    specs: Dict[str, P] = {"batch": P(DATA_AXIS)}
+    if batch_specs:
+        specs.update(batch_specs)
+    return PartitionPlan(mesh=mesh, batch_specs=specs, min_dim=min_dim)
 
 
 def local_batch_size(global_batch: int, mesh: Mesh) -> int:

@@ -257,6 +257,7 @@ class Runtime:
         model_axis: int = 1,
         player_device: str = "auto",
         player_sync: str = "fresh",
+        shard_superstep: bool = True,
         async_fetch: bool = False,
     ) -> None:
         self.requested_devices = devices
@@ -270,6 +271,8 @@ class Runtime:
         # mirrored here so `instantiate(cfg.fabric)` accepts the keys.
         self.player_device = str(player_device)
         self.player_sync = str(player_sync)
+        # Consumed by the fused Anakin lane via cfg.fabric (core/fused_loop.py).
+        self.shard_superstep = bool(shard_superstep)
         self.async_fetch = bool(async_fetch)
         self._mesh: Optional[mesh_lib.Mesh] = None
         self._launched = False

@@ -48,7 +48,9 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
 
+from sheeprl_tpu.core import mesh as mesh_lib
 from sheeprl_tpu.telemetry import tracer as tracer_mod
 
 __all__ = ["DeviceReplayRing", "next_power_of_two"]
@@ -74,6 +76,17 @@ class DeviceReplayRing:
     The ring is *additive*: the host buffer keeps receiving the same rows
     and remains the checkpoint source of truth. ``capacity`` is the per-env
     ring length (matching the host per-env sub-buffer size).
+
+    With ``mesh`` given (and ``n_envs`` divisible by its `data` axis) the
+    ring is **sharded across the mesh**: storage lives as
+    ``[capacity, n_envs/data, *f]`` per shard (env columns split over
+    `data`, no full-ring replication), :meth:`flush` stages rows onto the
+    shard that owns those envs, and the in-jit writer/sampler run SPMD.
+    Sampling keeps *global* uniform semantics — indices are computed from
+    replicated pos/added and the same PRNG bits on every topology (under
+    ``jax_threefry_partitionable``), so a sharded ring draws the identical
+    batch a single-device ring would; the sampled batch is then constrained
+    back onto the `data` axis so each shard trains on the rows it owns.
     """
 
     def __init__(
@@ -85,6 +98,7 @@ class DeviceReplayRing:
         hbm_fraction: float = 0.4,
         hbm_budget_bytes: Optional[int] = None,
         device: Any = None,
+        mesh: Any = None,
     ) -> None:
         if capacity < 1:
             raise ValueError(f"DeviceReplayRing capacity must be >= 1, got {capacity}")
@@ -97,6 +111,17 @@ class DeviceReplayRing:
         self.hbm_fraction = float(hbm_fraction)
         self.hbm_budget_bytes = hbm_budget_bytes if hbm_budget_bytes is None else int(hbm_budget_bytes)
         self._device = device
+        self._mesh = None
+        if mesh is not None:
+            data_size = int(mesh.shape[mesh_lib.DATA_AXIS])
+            if self.n_envs % data_size == 0:
+                self._mesh = mesh
+            else:
+                warnings.warn(
+                    f"DeviceReplayRing: n_envs {self.n_envs} not divisible by the "
+                    f"`{mesh_lib.DATA_AXIS}` mesh axis ({data_size}); the ring stays "
+                    "unsharded (single-device placement)."
+                )
         # Ring state (allocated lazily on the first add, when key shapes and
         # dtypes are known).
         self._specs: Optional[Dict[str, Tuple[Tuple[int, ...], np.dtype]]] = None
@@ -163,12 +188,24 @@ class DeviceReplayRing:
                 f"ring needs {needed / 2**20:.1f} MiB but the HBM budget is {budget / 2**20:.1f} MiB"
             )
             return
+        shardings = self.state_shardings()
         data: Dict[str, jax.Array] = {}
         for key, (feature, dtype) in self._specs.items():
-            data[key] = jnp.zeros((self.capacity, self.n_envs) + feature, dtype=dtype)
+            shape = (self.capacity, self.n_envs) + feature
+            if shardings is not None:
+                # Sharded allocation: each shard materializes only its own
+                # env columns — no full-ring replication across the mesh.
+                data[key] = jnp.zeros(shape, dtype=dtype, device=shardings["data"])
+            else:
+                data[key] = jnp.zeros(shape, dtype=dtype)
         self._data = data
-        self._pos = jnp.zeros(self.n_envs, dtype=jnp.int32)
-        self._added = jnp.zeros(self.n_envs, dtype=jnp.int32)
+        env_sharding = None if shardings is None else shardings["pos"]
+        if env_sharding is not None:
+            self._pos = jnp.zeros(self.n_envs, dtype=jnp.int32, device=env_sharding)
+            self._added = jnp.zeros(self.n_envs, dtype=jnp.int32, device=env_sharding)
+        else:
+            self._pos = jnp.zeros(self.n_envs, dtype=jnp.int32)
+            self._added = jnp.zeros(self.n_envs, dtype=jnp.int32)
         tracer_mod.current().set_gauge("replay_ring_bytes", float(needed))
 
     # ------------------------------------------------------------- staging
@@ -236,6 +273,26 @@ class DeviceReplayRing:
             if key in self._data:
                 patch = jnp.asarray(np.asarray(value).reshape(self._data[key].shape[2:]))
                 self._data[key] = self._data[key].at[t, env_idx].set(patch.astype(self._data[key].dtype))
+
+    # ----------------------------------------------------------- sharding
+    @property
+    def mesh(self) -> Any:
+        """The mesh the ring is sharded over, or None when unsharded."""
+        return self._mesh
+
+    def state_shardings(self) -> Optional[Dict[str, Any]]:
+        """Sharding pytree-prefix matching :attr:`state` when the ring is
+        mesh-sharded (None otherwise): ring storage is ``P(None, data)``
+        (env columns over `data`), pos/added ``P(data)``. The ``data`` entry
+        is a single sharding applied to every ring key (jit prefix
+        semantics), so this works before the specs are known too — feed it
+        to the fused train jit's ``in_shardings``/``out_shardings`` so the
+        carried ring state keeps its layout across supersteps."""
+        if self._mesh is None:
+            return None
+        row = NamedSharding(self._mesh, P(None, mesh_lib.DATA_AXIS))
+        env = NamedSharding(self._mesh, P(mesh_lib.DATA_AXIS))
+        return {"data": row, "pos": env, "added": env}
 
     # --------------------------------------------------------------- write
     def _build_write_fn(self):
@@ -306,6 +363,13 @@ class DeviceReplayRing:
             self._write_fn = self._build_write_fn()
         nbytes = int(sum(value.nbytes for value in rows.values()) + mask.nbytes)
         trc = tracer_mod.current()
+        if self._mesh is not None:
+            # Per-shard staging: each staged row lands directly on the shard
+            # that owns its env columns (env dim 1 split over `data`), so the
+            # donated SPMD write scatters locally — no full-row replication.
+            rows = mesh_lib.shard_batch(rows, self._mesh, axis=1)
+            mask = mesh_lib.shard_batch(mask, self._mesh, axis=1)
+            shift = mesh_lib.shard_batch(shift, self._mesh, axis=0)
         with trc.span("transfer/ring_write", "transfer", steps=n_staged, bytes=nbytes):
             self._data, self._pos, self._added = self._write_fn(
                 self._data, self._pos, self._added, rows, mask, shift
@@ -353,13 +417,18 @@ class DeviceReplayRing:
         are scattered out of bounds and dropped. Semantics match one
         staged ``add`` + ``flush`` per masked column, so the host mirror
         stays in lockstep via :meth:`advance_host`.
+
+        The writer derives its env width from the traced ``state`` (not the
+        ring's global ``n_envs``), so the same function works unchanged
+        inside a ``shard_map`` over `data`, where each shard carries only
+        its own ``n_envs/data`` env columns.
         """
         capacity = self.capacity
-        env_ids = jnp.arange(self.n_envs)
 
         def write(state: Dict[str, Any], row: Dict[str, jax.Array], mask: jax.Array) -> Dict[str, Any]:
             pos = state["pos"]
             added = state["added"]
+            env_ids = jnp.arange(pos.shape[0])  # local width under shard_map
             inc = mask.astype(jnp.int32)
             t_idx = jnp.where(mask, pos, capacity)  # out-of-bounds -> dropped
             data = {
@@ -425,7 +494,6 @@ class DeviceReplayRing:
         the window is one longer and each obs key ``k`` gains ``next_k``.
         """
         capacity = self.capacity
-        n_envs = self.n_envs
         cnn_keys = frozenset(self.cnn_keys)
         obs_keys = tuple(self.obs_keys)
         span = int(sequence_length) + int(bool(sample_next_obs))
@@ -435,6 +503,13 @@ class DeviceReplayRing:
             )
         batch_size = int(batch_size)
         sequence_length = int(sequence_length)
+        batch_constraint = None
+        if self._mesh is not None and int(self._mesh.shape[mesh_lib.DATA_AXIS]) > 1:
+            if batch_size % int(self._mesh.shape[mesh_lib.DATA_AXIS]) == 0:
+                # Sampled rows re-land on the shard that trains on them: the
+                # batch dim splits over `data` (dim 1 when time-major).
+                spec = P(None, mesh_lib.DATA_AXIS) if time_major else P(mesh_lib.DATA_AXIS)
+                batch_constraint = NamedSharding(self._mesh, spec)
 
         def _cast(key: str, value: jax.Array) -> jax.Array:
             return value if key in cnn_keys else value.astype(jnp.float32)
@@ -450,8 +525,11 @@ class DeviceReplayRing:
         def sample(state: Dict[str, Any], key: jax.Array) -> Dict[str, jax.Array]:
             pos = state["pos"]
             added = state["added"]
+            # Env width from the traced state, not the ring's global n_envs:
+            # the sampler stays correct if the caller hands it a sub-ring.
+            num_envs = pos.shape[0]
             k_env, k_start = jax.random.split(key)
-            env_idx = jax.random.randint(k_env, (batch_size,), 0, n_envs)
+            env_idx = jax.random.randint(k_env, (batch_size,), 0, num_envs)
             full = added >= capacity
             n_valid = jnp.where(
                 full,
@@ -468,6 +546,11 @@ class DeviceReplayRing:
                 batch[name] = _shape(_cast(name, window[:, :sequence_length]))
                 if sample_next_obs and name in obs_keys:
                     batch[f"next_{name}"] = _shape(_cast(name, window[:, 1:]))
+            if batch_constraint is not None:
+                batch = {
+                    name: jax.lax.with_sharding_constraint(value, batch_constraint)
+                    for name, value in batch.items()
+                }
             return batch
 
         return sample

@@ -206,6 +206,13 @@ def _arg_specs(tree: Any) -> Any:
     def spec(leaf: Any) -> Any:
         if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
             sharding = getattr(leaf, "sharding", None)
+            # An uncommitted array (fresh host transfer on the default
+            # device) is movable: the real dispatch lets jit place it next
+            # to the committed args, so pinning its SingleDeviceSharding
+            # here would lower a different — mixed-device, hence invalid —
+            # program when the other args live on a multi-device mesh.
+            if sharding is not None and not getattr(leaf, "_committed", True):
+                sharding = None
             try:
                 return jax.ShapeDtypeStruct(tuple(leaf.shape), leaf.dtype, sharding=sharding)
             except TypeError:

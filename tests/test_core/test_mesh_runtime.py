@@ -127,3 +127,64 @@ def test_split_player_trainer_auto_with_params():
         mesh, "auto", params={"w": jnp.zeros((8, 8))}
     )
     assert player is not None and trainer_mesh is not None
+
+
+def test_shard_batch_divisibility_error_names_axis_and_nearest():
+    """shard_batch must refuse an indivisible batch with a diagnosable
+    message: the axis name, its size, and the nearest valid batch sizes."""
+    mesh = build_mesh()
+    with pytest.raises(ValueError, match=r"`data` mesh axis \(size 8\)") as excinfo:
+        shard_batch(np.ones((12, 3), np.float32), mesh)
+    assert "8 or 16" in str(excinfo.value)
+
+
+def test_shard_batch_divisibility_nearest_rounds_up_from_tiny_batch():
+    mesh = build_mesh()
+    with pytest.raises(ValueError, match="nearest valid batch size: 8"):
+        shard_batch(np.ones((5, 3), np.float32), mesh)
+
+
+def test_partition_plan_default_specs_and_data_size():
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sheeprl_tpu.core.mesh import default_partition_plan
+
+    mesh = build_mesh()
+    plan = default_partition_plan(mesh)
+    assert plan.data_size == 8
+    assert plan.spec("batch") == P(DATA_AXIS)
+    assert plan.spec("unregistered") == P()
+    sh = plan.sharding("batch")
+    assert isinstance(sh, NamedSharding) and sh.spec == P(DATA_AXIS)
+    assert plan.replicated().spec == P()
+    # User specs merge over (and can override) the default batch spec.
+    plan2 = default_partition_plan(mesh, batch_specs={"rollout": P(None, DATA_AXIS)})
+    assert plan2.spec("rollout") == P(None, DATA_AXIS)
+    assert plan2.spec("batch") == P(DATA_AXIS)
+
+
+def test_param_partition_spec_wide_rule():
+    from jax.sharding import PartitionSpec as P
+
+    from sheeprl_tpu.core.mesh import param_partition_spec
+
+    mesh = build_mesh()  # model axis 1: everything replicated
+    assert param_partition_spec(jnp.zeros((4, 2048)), mesh) == P()
+    mesh2 = build_mesh(model_axis_size=2)
+    # Wide float matrices split their last dim over `model`.
+    assert param_partition_spec(jnp.zeros((4, 2048)), mesh2) == P(None, MODEL_AXIS)
+    # Narrow, integer, or indivisible leaves stay replicated.
+    assert param_partition_spec(jnp.zeros((4, 10)), mesh2) == P()
+    assert param_partition_spec(jnp.zeros((2048,), jnp.int32), mesh2) == P()
+
+
+def test_tree_shardings_mirrors_placement():
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sheeprl_tpu.core.mesh import tree_shardings
+
+    mesh = build_mesh()
+    placed = jax.device_put(jnp.zeros((16, 4)), NamedSharding(mesh, P(DATA_AXIS)))
+    tree = {"a": placed}
+    shardings = tree_shardings(tree)
+    assert shardings["a"].spec == P(DATA_AXIS)
